@@ -1,0 +1,23 @@
+"""kaminpar-tpu: a TPU-native balanced k-way graph partitioning framework.
+
+Re-implements the capabilities of KaMinPar (deep multilevel graph
+partitioning; see SURVEY.md) with a JAX/XLA/Pallas compute path: the hot
+kernels — size-constrained label propagation, cluster contraction, LP/Jet
+refinement, balancing — run as segmented sort/scatter array programs on a
+device-resident CSR graph; sequential initial bipartitioning and the
+multilevel orchestration run on the host; multi-chip scaling uses
+jax.sharding meshes with XLA collectives instead of MPI.
+"""
+
+from .graphs import (  # noqa: F401
+    HostGraph,
+    DeviceGraph,
+    from_edge_list,
+    from_csr,
+    device_graph_from_host,
+    host_graph_from_device,
+    validate,
+)
+from .io import load_graph  # noqa: F401
+
+__version__ = "0.1.0"

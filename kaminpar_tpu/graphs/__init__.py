@@ -1,0 +1,18 @@
+from .host import (  # noqa: F401
+    HostGraph,
+    from_csr,
+    from_edge_list,
+    validate,
+    degree_bucket_permutation,
+    apply_permutation,
+    remove_isolated_nodes,
+    count_isolated_nodes,
+    extract_block_subgraphs,
+    NodePermutation,
+)
+from .csr import (  # noqa: F401
+    DeviceGraph,
+    device_graph_from_host,
+    host_graph_from_device,
+)
+from . import factories  # noqa: F401

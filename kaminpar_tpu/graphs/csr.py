@@ -1,0 +1,184 @@
+"""Device-resident padded CSR graph (the TPU twin of CSRGraph).
+
+Design (SURVEY.md §7 step 1): a pytree of device arrays with *padded, shape-
+bucketed* sizes so the multilevel hierarchy (graph shrinks ~2x per level)
+re-uses O(log n) compiled executables instead of recompiling per level.
+Actual sizes `n`/`m` are traced int32 scalars; pad slots are inert:
+
+  * node pad slots: weight 0, degree 0 (row_ptr clamped to m);
+  * edge pad slots: src = dst = n_pad - 1 (a guaranteed-pad node), weight 0.
+
+With that convention most kernels need no explicit masks — zero-weight edges
+between pad nodes contribute nothing to ratings, cuts, or contractions.
+The builder always pads n to at least n+1 so slot n_pad-1 is never a real
+node.
+
+Unlike the reference's lambda-based adjacency iteration
+(kaminpar-shm/datastructures/csr_graph.h:171 adjacent_nodes), device kernels
+work on the flat COO view (`src`, `dst` = col) — gather/segment programs are
+the TPU-native idiom; XLA maps them onto vectorized scatter/sort units rather
+than per-node loops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.math import pad_size
+from .host import HostGraph
+
+NODE_DTYPE = jnp.int32
+WEIGHT_DTYPE = jnp.int32  # device weights; host keeps int64 (csr_graph.h uses
+# 32-bit IDs by default, CMakeLists.txt:67-75)
+ACC_DTYPE = jnp.int32  # weight accumulator dtype (see ops/segments.py)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DeviceGraph:
+    """Padded CSR + COO graph on device.
+
+    Fields (all jnp arrays):
+      row_ptr : i32[n_pad + 1]  CSR offsets; row_ptr[i] = m for i >= n
+      src     : i32[m_pad]      COO edge sources (pad: n_pad - 1)
+      dst     : i32[m_pad]      COO edge targets == CSR adjncy (pad: n_pad - 1)
+      edge_w  : i32[m_pad]      edge weights (pad: 0)
+      node_w  : i32[n_pad]      node weights (pad: 0)
+      n, m    : i32 scalars     true counts (traced, not static)
+    """
+
+    row_ptr: jax.Array
+    src: jax.Array
+    dst: jax.Array
+    edge_w: jax.Array
+    node_w: jax.Array
+    n: jax.Array
+    m: jax.Array
+
+    @property
+    def n_pad(self) -> int:
+        return self.node_w.shape[0]
+
+    @property
+    def m_pad(self) -> int:
+        return self.src.shape[0]
+
+    @property
+    def degrees(self) -> jax.Array:
+        return self.row_ptr[1:] - self.row_ptr[:-1]
+
+    def node_mask(self) -> jax.Array:
+        return jnp.arange(self.n_pad, dtype=NODE_DTYPE) < self.n
+
+    def edge_mask(self) -> jax.Array:
+        return jnp.arange(self.m_pad, dtype=NODE_DTYPE) < self.m
+
+    def total_node_weight(self) -> jax.Array:
+        return jnp.sum(self.node_w.astype(ACC_DTYPE))
+
+    def total_edge_weight(self) -> jax.Array:
+        return jnp.sum(self.edge_w.astype(ACC_DTYPE))
+
+
+def device_graph_from_host(
+    graph: HostGraph,
+    n_pad: Optional[int] = None,
+    m_pad: Optional[int] = None,
+    device=None,
+) -> DeviceGraph:
+    """Upload a HostGraph into the padded device layout."""
+    n, m = graph.n, graph.m
+    n_pad = n_pad if n_pad is not None else pad_size(n + 1)
+    m_pad = m_pad if m_pad is not None else pad_size(max(m, 1))
+    if n_pad < n + 1 or m_pad < m:
+        raise ValueError("pad sizes too small")
+
+    row_ptr = np.full(n_pad + 1, m, dtype=np.int32)
+    row_ptr[: n + 1] = graph.xadj.astype(np.int32)
+
+    pad_node = n_pad - 1
+    src = np.full(m_pad, pad_node, dtype=np.int32)
+    dst = np.full(m_pad, pad_node, dtype=np.int32)
+    edge_w = np.zeros(m_pad, dtype=np.int32)
+    src[:m] = graph.edge_sources()
+    dst[:m] = graph.adjncy
+    edge_w[:m] = graph.edge_weight_array().astype(np.int32)
+
+    node_w = np.zeros(n_pad, dtype=np.int32)
+    node_w[:n] = graph.node_weight_array().astype(np.int32)
+
+    put = partial(jax.device_put, device=device)
+    return DeviceGraph(
+        row_ptr=put(row_ptr),
+        src=put(src),
+        dst=put(dst),
+        edge_w=put(edge_w),
+        node_w=put(node_w),
+        n=put(np.int32(n)),
+        m=put(np.int32(m)),
+    )
+
+
+def host_graph_from_device(graph: DeviceGraph) -> HostGraph:
+    """Download a DeviceGraph back into a compact HostGraph (DLPack-free copy;
+    used when the coarsest graph moves to the CPU initial partitioner, per
+    BASELINE.json's north star)."""
+    n = int(graph.n)
+    m = int(graph.m)
+    xadj = np.asarray(graph.row_ptr[: n + 1], dtype=np.int64)
+    adjncy = np.asarray(graph.dst[:m], dtype=np.int32)
+    edge_w = np.asarray(graph.edge_w[:m], dtype=np.int64)
+    node_w = np.asarray(graph.node_w[:n], dtype=np.int64)
+    return HostGraph(
+        xadj=xadj,
+        adjncy=adjncy,
+        node_weights=None if (node_w == 1).all() else node_w,
+        edge_weights=None if m == 0 or (edge_w == 1).all() else edge_w,
+    )
+
+
+def pad_arrays_to(
+    n_pad: int, m_pad: int, graph: DeviceGraph
+) -> DeviceGraph:
+    """Re-pad a device graph into larger buffers (no-op if sizes match).
+    Only grows; used to keep hierarchy levels in shared shape buckets."""
+    if n_pad == graph.n_pad and m_pad == graph.m_pad:
+        return graph
+    if n_pad < graph.n_pad or m_pad < graph.m_pad:
+        raise ValueError("can only grow padding")
+    pad_node = n_pad - 1
+
+    def pad_edges(x, fill):
+        return jnp.concatenate(
+            [x, jnp.full(m_pad - graph.m_pad, fill, dtype=x.dtype)]
+        )
+
+    # re-point old pad slots at the new pad node
+    src = jnp.where(jnp.arange(graph.m_pad) < graph.m, graph.src, pad_node)
+    dst = jnp.where(jnp.arange(graph.m_pad) < graph.m, graph.dst, pad_node)
+    row_ptr = jnp.concatenate(
+        [
+            graph.row_ptr,
+            jnp.full(n_pad - graph.n_pad, graph.m, dtype=graph.row_ptr.dtype),
+        ]
+    )
+    row_ptr = jnp.where(
+        jnp.arange(n_pad + 1) <= graph.n, row_ptr, graph.m
+    ).astype(jnp.int32)
+    return DeviceGraph(
+        row_ptr=row_ptr,
+        src=pad_edges(src, pad_node),
+        dst=pad_edges(dst, pad_node),
+        edge_w=pad_edges(graph.edge_w, 0),
+        node_w=jnp.concatenate(
+            [graph.node_w, jnp.zeros(n_pad - graph.n_pad, dtype=graph.node_w.dtype)]
+        ),
+        n=graph.n,
+        m=graph.m,
+    )

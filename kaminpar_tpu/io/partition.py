@@ -1,0 +1,26 @@
+"""Partition file IO (analog of include/kaminpar-io/kaminpar_io.h:37-54).
+
+A partition file is one block id per line, node order = graph order.
+Block-size files store one block weight per line.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_partition(path: str) -> np.ndarray:
+    return np.loadtxt(path, dtype=np.int32, ndmin=1)
+
+
+def write_partition(path: str, partition: np.ndarray) -> None:
+    np.savetxt(path, np.asarray(partition, dtype=np.int32), fmt="%d")
+
+
+def write_block_sizes(path: str, partition: np.ndarray, k: int) -> None:
+    sizes = np.bincount(np.asarray(partition), minlength=k)
+    np.savetxt(path, sizes, fmt="%d")
+
+
+def read_block_sizes(path: str) -> np.ndarray:
+    return np.loadtxt(path, dtype=np.int64, ndmin=1)

@@ -1,0 +1,78 @@
+"""Partition quality metrics on device.
+
+Analog of kaminpar-shm/metrics.{h,cc}: edge_cut (metrics.cc:37, a TBB
+parallel reduction there — a masked segment sum here), imbalance,
+total_overload, is_feasible / is_balanced (metrics.h:17-86).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..graphs.csr import DeviceGraph
+from .segments import ACC_DTYPE
+
+
+def block_weights(
+    graph: DeviceGraph, partition: jax.Array, k: int
+) -> jax.Array:
+    """Sum of node weights per block, int64[k].  Pad nodes carry weight 0 so
+    no masking is needed (csr.py padding convention)."""
+    part = jnp.clip(partition, 0, k - 1)
+    return jax.ops.segment_sum(
+        graph.node_w.astype(ACC_DTYPE), part, num_segments=k
+    )
+
+
+def edge_cut(graph: DeviceGraph, partition: jax.Array) -> jax.Array:
+    """Total weight of cut edges (each undirected edge counted once).
+    Mirrors shm::metrics::edge_cut (metrics.cc:37)."""
+    cut2 = jnp.sum(
+        jnp.where(
+            partition[graph.src] != partition[graph.dst],
+            graph.edge_w.astype(ACC_DTYPE),
+            0,
+        )
+    )
+    return cut2 // 2
+
+
+def imbalance(graph: DeviceGraph, partition: jax.Array, k: int) -> jax.Array:
+    """max_b weight(b) / ceil(total/k) - 1 (metrics.h imbalance)."""
+    bw = block_weights(graph, partition, k)
+    total = graph.total_node_weight()
+    perfect = (total + k - 1) // k
+    return bw.max().astype(jnp.float32) / jnp.maximum(perfect, 1).astype(
+        jnp.float32
+    ) - 1.0
+
+
+def total_overload(
+    graph: DeviceGraph, partition: jax.Array, max_block_weights: jax.Array
+) -> jax.Array:
+    """Sum of max(0, weight(b) - L_max(b)) (metrics.h total_overload)."""
+    k = max_block_weights.shape[0]
+    bw = block_weights(graph, partition, k)
+    return jnp.sum(jnp.maximum(bw - max_block_weights.astype(ACC_DTYPE), 0))
+
+
+def is_balanced(
+    graph: DeviceGraph, partition: jax.Array, max_block_weights: jax.Array
+) -> jax.Array:
+    return total_overload(graph, partition, max_block_weights) == 0
+
+
+def is_feasible(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    max_block_weights: jax.Array,
+    min_block_weights: jax.Array | None = None,
+) -> jax.Array:
+    """Balanced above and (optionally) below (metrics.h is_feasible)."""
+    k = max_block_weights.shape[0]
+    bw = block_weights(graph, partition, k)
+    ok = jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
+    if min_block_weights is not None:
+        ok = ok & jnp.all(bw >= min_block_weights.astype(ACC_DTYPE))
+    return ok

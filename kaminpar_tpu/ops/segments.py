@@ -1,0 +1,183 @@
+"""Segmented sort/reduce primitives — the TPU-native RatingMap.
+
+The reference accumulates neighbor→cluster ratings in per-thread adaptive
+hash maps (kaminpar-common/datastructures/rating_map.h) inside a per-node
+loop (kaminpar-shm/label_propagation.h:461-541 find_best_cluster).  On TPU
+the same computation is expressed as whole-graph sort + segmented-reduction
+programs over the COO edge list: XLA lowers sorts and segment ops onto the
+vector units with static shapes, which beats any per-node control flow.
+
+Primitives:
+  * hash_u32               — stateless integer mixer for random tie-breaking
+                             (replaces per-thread RNG in find_best_cluster)
+  * aggregate_by_key       — group (seg, key) pairs, sum weights per group
+  * argmax_per_segment     — per-segment argmax with hashed tie-breaking
+  * accept_prefix_by_capacity — sort movers by (target, priority) and accept
+                             the maximal prefix per target under a capacity;
+                             the bulk-synchronous replacement for the
+                             reference's CAS cluster-weight updates
+                             (label_propagation.h:2139 move_cluster_weight)
+
+All functions are jit-safe with static shapes; "invalid" is encoded as -1.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INT32_MIN = jnp.iinfo(jnp.int32).min
+# Weight accumulator dtype.  int32 matches the reference's default 32-bit
+# weight build (CMakeLists.txt:67-75) and is TPU-native; callers partitioning
+# graphs whose total edge weight exceeds 2^31 need the (future) 64-bit build.
+ACC_DTYPE = jnp.int32
+
+
+def hash_u32(x: jax.Array, salt) -> jax.Array:
+    """murmur3-style finalizer; returns non-negative int32."""
+    x = x.astype(jnp.uint32) * jnp.uint32(0x9E3779B1) + jnp.uint32(salt)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return (x >> jnp.uint32(1)).astype(jnp.int32)
+
+
+def sort_by_two_keys(
+    primary: jax.Array, secondary: jax.Array, *values: jax.Array
+) -> Tuple[jax.Array, ...]:
+    """Lexicographic sort by (primary, secondary), carrying values."""
+    return lax.sort((primary, secondary) + values, num_keys=2)
+
+
+def aggregate_by_key(
+    seg: jax.Array, key: jax.Array, w: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Group entries by (seg, key) and sum weights per group.
+
+    Returns (seg_g, key_g, w_g), all of length len(seg); group g occupies
+    slot g, unused slots have seg_g == -1.  This is the whole-graph analog
+    of one RatingMap fill: for LP, seg = edge source node, key = neighbor's
+    cluster, w = edge weight, and (seg_g, key_g, w_g) enumerates each node's
+    adjacent clusters with their connection weights.
+    """
+    m = seg.shape[0]
+    seg_s, key_s, w_s = sort_by_two_keys(seg, key, w)
+    prev_seg = jnp.concatenate([jnp.array([-1], seg_s.dtype), seg_s[:-1]])
+    prev_key = jnp.concatenate([jnp.array([-1], key_s.dtype), key_s[:-1]])
+    is_new = (seg_s != prev_seg) | (key_s != prev_key)
+    gid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    w_g = jax.ops.segment_sum(w_s, gid, num_segments=m)
+    seg_g = jax.ops.segment_max(
+        jnp.where(is_new, seg_s, INT32_MIN), gid, num_segments=m
+    )
+    key_g = jax.ops.segment_max(
+        jnp.where(is_new, key_s, INT32_MIN), gid, num_segments=m
+    )
+    num_groups = gid[-1] + 1
+    valid = jnp.arange(m) < num_groups
+    seg_g = jnp.where(valid, seg_g, -1)
+    key_g = jnp.where(valid, key_g, -1)
+    w_g = jnp.where(valid, w_g, 0)
+    return seg_g, key_g, w_g
+
+
+def argmax_per_segment(
+    seg: jax.Array,
+    key: jax.Array,
+    score: jax.Array,
+    num_segments: int,
+    tie_salt,
+    feasible: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """For each segment, the key with max score among feasible entries,
+    ties broken by a hashed pseudo-random priority (the TPU analog of the
+    uniform random tie-breaking in label_propagation.h:461-541).
+
+    Entries with seg < 0 are ignored.  Returns (best_key, best_score) of
+    length num_segments; best_key = -1 / best_score = INT32_MIN where a
+    segment has no feasible entry.
+    """
+    ok = seg >= 0
+    if feasible is not None:
+        ok = ok & feasible
+    seg_c = jnp.where(ok, seg, num_segments)  # routed to an overflow slot
+    masked = jnp.where(ok, score, INT32_MIN)
+    best = jax.ops.segment_max(masked, seg_c, num_segments=num_segments + 1)[
+        :num_segments
+    ]
+    has = best > INT32_MIN
+    is_best = ok & (score == best[jnp.clip(seg_c, 0, num_segments - 1)]) & (
+        seg_c < num_segments
+    )
+    tb = hash_u32(key, tie_salt)
+    tb_m = jnp.where(is_best, tb, -1)
+    best_tb = jax.ops.segment_max(
+        jnp.where(is_best, tb_m, INT32_MIN), seg_c, num_segments=num_segments + 1
+    )[:num_segments]
+    winner = is_best & (tb == best_tb[jnp.clip(seg_c, 0, num_segments - 1)])
+    best_key = jax.ops.segment_max(
+        jnp.where(winner, key, INT32_MIN), seg_c, num_segments=num_segments + 1
+    )[:num_segments]
+    best_key = jnp.where(has, best_key, -1)
+    best_score = jnp.where(has, best, INT32_MIN)
+    return best_key, best_score
+
+
+def accept_prefix_by_capacity(
+    target: jax.Array,
+    priority: jax.Array,
+    weight: jax.Array,
+    capacity: jax.Array,
+) -> jax.Array:
+    """Capacity-respecting parallel commit.
+
+    Each entry i wants to add `weight[i]` to bucket `target[i]` (-1 = not
+    moving).  Entries are ordered by (target, priority) and the maximal
+    prefix per target whose cumulative weight fits `capacity[target]` is
+    accepted.  Returns a bool mask over entries.
+
+    This replaces the reference's relaxed CAS loop on cluster weights
+    (label_propagation.h:818 try_node_move / :2139 move_cluster_weight):
+    instead of racing threads, one deterministic sorted pass guarantees the
+    cap is never exceeded.
+    """
+    nbuckets = capacity.shape[0]
+    idx = jnp.arange(target.shape[0], dtype=jnp.int32)
+    t = jnp.where(target >= 0, target, nbuckets).astype(jnp.int32)
+    t_s, p_s, w_s, idx_s = lax.sort((t, priority, weight, idx), num_keys=2)
+    c = jnp.cumsum(w_s.astype(ACC_DTYPE))
+    prev_t = jnp.concatenate([jnp.array([-1], t_s.dtype), t_s[:-1]])
+    is_first = t_s != prev_t
+    gid = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    seg_base = jax.ops.segment_min(
+        jnp.where(is_first, c - w_s.astype(ACC_DTYPE), jnp.iinfo(ACC_DTYPE).max),
+        gid,
+        num_segments=target.shape[0],
+    )
+    cum_in_seg = c - seg_base[gid]
+    cap_here = jnp.where(
+        t_s < nbuckets, capacity[jnp.clip(t_s, 0, nbuckets - 1)], 0
+    ).astype(ACC_DTYPE)
+    accepted_sorted = (t_s < nbuckets) & (cum_in_seg <= cap_here)
+    accept = jnp.zeros(target.shape[0], dtype=bool).at[idx_s].set(accepted_sorted)
+    return accept
+
+
+def compact_unique(labels: jax.Array, n_pad: int) -> Tuple[jax.Array, jax.Array]:
+    """Remap arbitrary label values in [0, n_pad) to dense ids [0, c).
+
+    Returns (dense_label_per_slot, num_unique).  The analog of the
+    reference's fill_leader_mapping + prefix sum
+    (cluster_contraction_preprocessing.cc:17,69): mark used labels, prefix-
+    sum the marks, gather.
+    """
+    used = jnp.zeros(n_pad, dtype=jnp.int32).at[labels].max(1, mode="drop")
+    rank = jnp.cumsum(used) - used  # dense id of each used label
+    dense = rank[labels].astype(jnp.int32)
+    num = jnp.sum(used)
+    return dense, num

@@ -1,0 +1,3 @@
+from . import math, rng, timer, logger  # noqa: F401
+from .timer import GLOBAL_TIMER, Timer, scoped_timer  # noqa: F401
+from .logger import OutputLevel, set_output_level  # noqa: F401

@@ -1,0 +1,72 @@
+"""Leveled logger (analog of kaminpar-common/logger.{h,cc}).
+
+The reference exposes LOG/LOG_WARNING/LOG_ERROR stream macros with a global
+quiet switch (logger.h:225).  We wrap the stdlib logger with the same levels
+plus an OutputLevel knob matching include/kaminpar-shm/kaminpar.h:32-38.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import sys
+
+
+class OutputLevel(enum.IntEnum):
+    """Mirrors kaminpar::OutputLevel (include/kaminpar-shm/kaminpar.h:32-38)."""
+
+    QUIET = 0
+    PROGRESS = 1
+    APPLICATION = 2
+    EXPERIMENT = 3
+    DEBUG = 4
+
+
+_LOGGER = logging.getLogger("kaminpar_tpu")
+if not _LOGGER.handlers:
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter("[kaminpar-tpu] %(message)s"))
+    _LOGGER.addHandler(handler)
+    _LOGGER.setLevel(logging.WARNING)
+    _LOGGER.propagate = False
+
+_OUTPUT_LEVEL = OutputLevel.APPLICATION
+
+
+def set_output_level(level: OutputLevel) -> None:
+    global _OUTPUT_LEVEL
+    _OUTPUT_LEVEL = OutputLevel(level)
+    _LOGGER.setLevel(
+        logging.ERROR
+        if level == OutputLevel.QUIET
+        else logging.INFO
+        if level < OutputLevel.DEBUG
+        else logging.DEBUG
+    )
+
+
+def output_level() -> OutputLevel:
+    return _OUTPUT_LEVEL
+
+
+def log(msg: str) -> None:
+    if _OUTPUT_LEVEL >= OutputLevel.APPLICATION:
+        _LOGGER.info(msg)
+
+
+def log_progress(msg: str) -> None:
+    if _OUTPUT_LEVEL >= OutputLevel.PROGRESS:
+        _LOGGER.info(msg)
+
+
+def log_debug(msg: str) -> None:
+    if _OUTPUT_LEVEL >= OutputLevel.DEBUG:
+        _LOGGER.debug(msg)
+
+
+def log_warning(msg: str) -> None:
+    _LOGGER.warning("Warning: %s", msg)
+
+
+def log_error(msg: str) -> None:
+    _LOGGER.error("Error: %s", msg)

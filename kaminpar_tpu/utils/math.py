@@ -1,0 +1,40 @@
+"""Small math helpers (analog of kaminpar-common/math.h)."""
+
+from __future__ import annotations
+
+
+def ceil2(x: int) -> int:
+    """Smallest power of two >= x (kaminpar-common/math.h ceil2)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def floor2(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x.bit_length() - 1)
+
+
+def ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    return ceil_div(x, multiple) * multiple
+
+
+def pad_size(x: int, granularity: int = 256) -> int:
+    """Shape-bucketed padding: next power of two, but at least x rounded up to
+    `granularity`.  Bounds the number of distinct compiled shapes per graph to
+    O(log n) as the multilevel hierarchy shrinks the graph ~2x per level."""
+    if x <= granularity:
+        return granularity
+    return ceil2(x)
+
+
+def split_integral(total: int, ratio: float) -> tuple[int, int]:
+    """Split `total` into two integral parts by `ratio` (math.h split_integral)."""
+    first = int(total * ratio + 0.5)
+    first = max(0, min(total, first))
+    return first, total - first

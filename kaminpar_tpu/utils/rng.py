@@ -1,0 +1,44 @@
+"""Deterministic randomness (analog of kaminpar-common/random.{h,cc}).
+
+The reference seeds a global RNG and derives per-thread instances
+(random.h:21-76).  TPU-side we use jax.random PRNG keys derived from a global
+seed; host-side we use numpy Generators derived from the same seed.  Both are
+fully reproducible given the seed, which backs the rerun-determinism e2e test
+(tests/endtoend/shm_endtoend_test.cc in the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SEED: int = 0
+_HOST_COUNTER: int = 0
+
+
+def set_seed(seed: int) -> None:
+    global _SEED, _HOST_COUNTER
+    _SEED = int(seed)
+    _HOST_COUNTER = 0
+
+
+def get_seed() -> int:
+    return _SEED
+
+
+def device_key(salt: int = 0):
+    """A jax PRNG key derived from the global seed and a caller salt."""
+    import jax
+
+    return jax.random.key(np.uint32((_SEED * 0x9E3779B1 + salt) & 0xFFFFFFFF))
+
+
+def host_rng(salt: int = 0) -> np.random.Generator:
+    """A numpy Generator derived from the global seed and a caller salt."""
+    return np.random.default_rng(np.uint64((_SEED << 20) ^ salt))
+
+
+def fresh_host_rng() -> np.random.Generator:
+    """Sequence of distinct host RNGs (analog of per-thread Random instances)."""
+    global _HOST_COUNTER
+    _HOST_COUNTER += 1
+    return host_rng(_HOST_COUNTER)

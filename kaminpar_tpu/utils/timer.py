@@ -1,0 +1,103 @@
+"""Hierarchical timer (TPU-native analog of kaminpar-common/timer.{h,cc}).
+
+The reference keeps a global hierarchical timer singleton with SCOPED_TIMER
+macros (kaminpar-common/timer.h:20-62).  Here we keep a lightweight tree of
+named scopes; `scoped_timer` is a context manager.  Device work is made
+observable by calling `jax.block_until_ready` at scope exit when requested.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class TimerNode:
+    name: str
+    elapsed: float = 0.0
+    count: int = 0
+    children: Dict[str, "TimerNode"] = field(default_factory=dict)
+
+    def child(self, name: str) -> "TimerNode":
+        node = self.children.get(name)
+        if node is None:
+            node = TimerNode(name)
+            self.children[name] = node
+        return node
+
+
+class Timer:
+    """Hierarchical wall-clock timer tree.
+
+    Mirrors the reference's global Timer (kaminpar-common/timer.h) but is an
+    ordinary object; a module-level default instance stands in for the
+    singleton.  Disabled timers are ~free.
+    """
+
+    def __init__(self, name: str = "root", enabled: bool = True) -> None:
+        self.root = TimerNode(name)
+        self._stack = [self.root]
+        self.enabled = enabled
+
+    def reset(self) -> None:
+        self.root = TimerNode(self.root.name)
+        self._stack = [self.root]
+
+    @contextmanager
+    def scope(self, name: str, sync=None):
+        """Time a named scope. `sync` may be a value to block_until_ready on exit."""
+        if not self.enabled:
+            yield
+            return
+        node = self._stack[-1].child(name)
+        self._stack.append(node)
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(sync)
+                except Exception:
+                    pass
+            node.elapsed += time.perf_counter() - start
+            node.count += 1
+            self._stack.pop()
+
+    def elapsed(self, *path: str) -> float:
+        node = self.root
+        for name in path:
+            if name not in node.children:
+                return 0.0
+            node = node.children[name]
+        return node.elapsed
+
+    def render(self) -> str:
+        lines = []
+
+        def rec(node: TimerNode, depth: int) -> None:
+            if depth > 0:
+                lines.append(
+                    f"{'  ' * depth}{node.name}: {node.elapsed:.4f} s"
+                    + (f" ({node.count}x)" if node.count > 1 else "")
+                )
+            for child in node.children.values():
+                rec(child, depth + 1)
+
+        rec(self.root, 0)
+        return "\n".join(lines)
+
+
+GLOBAL_TIMER = Timer()
+
+
+@contextmanager
+def scoped_timer(name: str, timer: Optional[Timer] = None, sync=None):
+    t = timer if timer is not None else GLOBAL_TIMER
+    with t.scope(name, sync=sync):
+        yield

@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+This is the TPU analog of the reference's mpirun-on-one-box testing
+(tests/CMakeLists.txt:114-117 runs distributed tests with 1/2/4 ranks on a
+single machine): XLA's host platform is split into 8 virtual devices so the
+multi-chip sharding paths compile and execute without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from kaminpar_tpu.utils import rng
+
+    rng.set_seed(0)
+    yield
+
+
+@pytest.fixture
+def rgg2d():
+    from kaminpar_tpu.io import load_graph
+
+    return load_graph("/root/reference/misc/rgg2d.metis")

@@ -1,0 +1,115 @@
+"""Graph data structure tests (analog of tests/shm/datastructures + graphutils)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs import (
+    DeviceGraph,
+    HostGraph,
+    apply_permutation,
+    degree_bucket_permutation,
+    device_graph_from_host,
+    extract_block_subgraphs,
+    factories,
+    from_edge_list,
+    host_graph_from_device,
+    remove_isolated_nodes,
+    validate,
+)
+
+
+def test_path_graph_structure():
+    g = factories.make_path(5)
+    assert g.n == 5 and g.m == 8
+    assert list(g.neighbors(0)) == [1]
+    assert sorted(g.neighbors(2)) == [1, 3]
+    validate(g)
+
+
+def test_grid_graph_structure():
+    g = factories.make_grid_graph(3, 4)
+    assert g.n == 12
+    assert g.m == 2 * (3 * 3 + 2 * 4)  # horizontal + vertical, both dirs
+    assert sorted(g.neighbors(0)) == [1, 4]
+    validate(g)
+
+
+def test_star_and_complete():
+    star = factories.make_star(6)
+    assert star.n == 7 and star.m == 12
+    assert star.degrees()[0] == 6
+    comp = factories.make_complete_graph(5)
+    assert comp.m == 5 * 4
+    validate(star)
+    validate(comp)
+
+
+def test_from_edge_list_merges_duplicates_and_self_loops():
+    edges = np.array([[0, 1], [1, 0], [0, 0], [1, 2]])
+    g = from_edge_list(3, edges)
+    # (0,1) appears twice => merged with weight 2
+    assert g.m == 4
+    assert g.edge_weights is not None
+    w01 = g.edge_weights[g.xadj[0] : g.xadj[1]]
+    assert list(w01) == [2]
+
+
+def test_validate_rejects_asymmetric():
+    g = HostGraph(np.array([0, 1, 1]), np.array([1], dtype=np.int32))
+    with pytest.raises(ValueError):
+        validate(g)
+
+
+def test_degree_bucket_permutation_orders_by_degree():
+    g = factories.make_star(4)  # hub degree 4, leaves degree 1
+    perm = degree_bucket_permutation(g)
+    pg = apply_permutation(g, perm)
+    validate(pg)
+    assert pg.degrees().max() == 4
+    # hub should be last (highest bucket)
+    assert pg.degrees()[-1] == 4
+    # edge weights and structure preserved under round trip
+    assert pg.m == g.m and pg.n == g.n
+
+
+def test_remove_isolated_nodes():
+    # path 0-1-2 plus isolated nodes 3, 4
+    g = HostGraph(
+        np.array([0, 1, 3, 4, 4, 4]),
+        np.array([1, 0, 2, 1], dtype=np.int32),
+    )
+    core, perm, num_isolated = remove_isolated_nodes(g)
+    assert num_isolated == 2
+    assert core.n == 3 and core.m == 4
+    validate(core)
+
+
+def test_device_round_trip(rgg2d):
+    dg = device_graph_from_host(rgg2d)
+    assert dg.n_pad >= rgg2d.n + 1
+    back = host_graph_from_device(dg)
+    assert back.n == rgg2d.n and back.m == rgg2d.m
+    assert np.array_equal(back.xadj, rgg2d.xadj)
+    assert np.array_equal(back.adjncy, rgg2d.adjncy)
+
+
+def test_device_padding_is_inert(rgg2d):
+    import jax.numpy as jnp
+
+    dg = device_graph_from_host(rgg2d)
+    # pad edges carry zero weight and point at the pad node
+    assert int(dg.edge_w[rgg2d.m :].sum()) == 0
+    assert int(dg.node_w[rgg2d.n :].sum()) == 0
+    assert bool(jnp.all(dg.src[rgg2d.m :] == dg.n_pad - 1))
+
+
+def test_extract_block_subgraphs():
+    g = factories.make_grid_graph(2, 4)  # nodes 0..7
+    part = np.array([0, 0, 1, 1, 0, 0, 1, 1])
+    ext = extract_block_subgraphs(g, part, 2)
+    assert len(ext.subgraphs) == 2
+    for sub in ext.subgraphs:
+        assert sub.n == 4
+        validate(sub)
+    # block 0 = left 2x2 square => 4 undirected internal edges
+    assert ext.subgraphs[0].m == 8

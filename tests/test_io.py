@@ -1,0 +1,85 @@
+"""IO tests (analog of kaminpar-io usage in the reference test suite)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs import factories, validate
+from kaminpar_tpu.io import (
+    load_graph,
+    load_metis,
+    load_parhip,
+    parse_metis,
+    read_partition,
+    write_metis,
+    write_parhip,
+    write_partition,
+)
+
+
+def test_parse_metis_unweighted():
+    g = parse_metis("3 2\n2\n1 3\n2\n")
+    assert g.n == 3 and g.m == 4
+    assert list(g.neighbors(1)) == [0, 2]
+    assert g.node_weights is None and g.edge_weights is None
+
+
+def test_parse_metis_weighted():
+    text = "2 1 11\n5 2 7\n3 1 7\n"
+    g = parse_metis(text)
+    assert list(g.node_weights) == [5, 3]
+    assert list(g.edge_weights) == [7, 7]
+
+
+def test_parse_metis_comments_and_isolated():
+    g = parse_metis("% hello\n3 1\n2\n1\n\n")
+    assert g.n == 3 and g.m == 2
+    assert g.degrees()[2] == 0
+
+
+def test_reference_sample_graphs_agree():
+    metis = load_metis("/root/reference/misc/rgg2d.metis")
+    p32 = load_parhip("/root/reference/misc/rgg2d-32bit.parhip")
+    p64 = load_parhip("/root/reference/misc/rgg2d-64bit.parhip")
+    for other in (p32, p64):
+        assert np.array_equal(metis.xadj, other.xadj)
+        assert np.array_equal(metis.adjncy, other.adjncy)
+    validate(metis)
+    assert metis.n == 1024 and metis.m == 2 * 4113
+
+
+def test_metis_round_trip(tmp_path):
+    g = factories.make_grid_graph(5, 5)
+    path = str(tmp_path / "g.metis")
+    write_metis(g, path)
+    g2 = load_metis(path)
+    assert np.array_equal(g.xadj, g2.xadj)
+    assert np.array_equal(g.adjncy, g2.adjncy)
+
+
+def test_parhip_round_trip(tmp_path):
+    g = factories.make_rgg2d(200, seed=3)
+    nw = np.arange(1, g.n + 1, dtype=np.int64)
+    g.node_weights = nw
+    path = str(tmp_path / "g.parhip")
+    write_parhip(g, path)
+    g2 = load_parhip(path)
+    assert np.array_equal(g.xadj, g2.xadj)
+    assert np.array_equal(g.adjncy, g2.adjncy)
+    assert np.array_equal(g2.node_weights, nw)
+
+
+def test_partition_round_trip(tmp_path):
+    part = np.array([0, 1, 2, 1, 0], dtype=np.int32)
+    path = str(tmp_path / "part.txt")
+    write_partition(path, part)
+    assert np.array_equal(read_partition(path), part)
+
+
+def test_load_graph_auto_detect(tmp_path):
+    g = factories.make_path(10)
+    mp = str(tmp_path / "a.graph")
+    pp = str(tmp_path / "a.parhip")
+    write_metis(g, mp)
+    write_parhip(g, pp)
+    assert load_graph(mp).m == g.m
+    assert load_graph(pp).m == g.m

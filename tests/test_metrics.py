@@ -1,0 +1,52 @@
+"""Metric tests (analog of tests/shm/metrics_test.cc)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.graphs import device_graph_from_host, factories
+from kaminpar_tpu.ops import metrics
+
+
+def _dev(g):
+    return device_graph_from_host(g)
+
+
+def _part(dg, values):
+    p = np.zeros(dg.n_pad, dtype=np.int32)
+    p[: len(values)] = values
+    return jnp.asarray(p)
+
+
+def test_edge_cut_path():
+    g = factories.make_path(4)  # 0-1-2-3
+    dg = _dev(g)
+    assert int(metrics.edge_cut(dg, _part(dg, [0, 0, 1, 1]))) == 1
+    assert int(metrics.edge_cut(dg, _part(dg, [0, 1, 0, 1]))) == 3
+    assert int(metrics.edge_cut(dg, _part(dg, [0, 0, 0, 0]))) == 0
+
+
+def test_edge_cut_weighted():
+    g = factories.make_path(3, edge_weight=5)
+    dg = _dev(g)
+    assert int(metrics.edge_cut(dg, _part(dg, [0, 1, 1]))) == 5
+
+
+def test_block_weights_and_imbalance():
+    g = factories.make_path(4)
+    dg = _dev(g)
+    bw = metrics.block_weights(dg, _part(dg, [0, 0, 0, 1]), 2)
+    assert list(np.asarray(bw)) == [3, 1]
+    imb = float(metrics.imbalance(dg, _part(dg, [0, 0, 0, 1]), 2))
+    assert abs(imb - 0.5) < 1e-6  # max 3 vs perfect 2
+
+
+def test_feasibility():
+    g = factories.make_path(4)
+    dg = _dev(g)
+    part = _part(dg, [0, 0, 1, 1])
+    L = jnp.array([2, 2], dtype=jnp.int32)
+    assert bool(metrics.is_feasible(dg, part, L))
+    assert int(metrics.total_overload(dg, part, L)) == 0
+    part_bad = _part(dg, [0, 0, 0, 1])
+    assert not bool(metrics.is_feasible(dg, part_bad, L))
+    assert int(metrics.total_overload(dg, part_bad, L)) == 1
