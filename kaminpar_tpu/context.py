@@ -1,0 +1,403 @@
+"""Configuration tree (analog of include/kaminpar-shm/kaminpar.h Context).
+
+The reference models every algorithmic choice as an enum + plain-struct tree
+(Context -> PartitioningContext/CoarseningContext/InitialPartitioningContext/
+RefinementContext, include/kaminpar-shm/kaminpar.h:94-562).  We mirror that
+with dataclasses; presets.py builds filled-in trees by name.
+
+PartitionContext reproduces the block-weight semantics of
+include/kaminpar-shm/kaminpar.h:371-478: max block weights derived from
+(1+eps)*ceil(total/k), optional relaxation by the max node weight, inferred
+epsilon for custom weight vectors, optional min block weights.
+"""
+
+from __future__ import annotations
+
+import enum
+import math as pymath
+from dataclasses import dataclass, field, replace
+from typing import List, Optional
+
+import numpy as np
+
+
+class PartitioningMode(str, enum.Enum):
+    """include/kaminpar-shm/kaminpar.h:94-98."""
+
+    DEEP = "deep"
+    RB = "rb"
+    KWAY = "kway"
+    VCYCLE = "vcycle"
+
+
+class ClusteringAlgorithm(str, enum.Enum):
+    NOOP = "noop"
+    LABEL_PROPAGATION = "lp"
+
+
+class CoarseningAlgorithm(str, enum.Enum):
+    NOOP = "noop"
+    BASIC_CLUSTERING = "basic"
+    OVERLAY_CLUSTERING = "overlay"
+
+
+class ClusterWeightLimit(str, enum.Enum):
+    """max_cluster_weights.h ClusterWeightLimit."""
+
+    EPSILON_BLOCK_WEIGHT = "epsilon-block-weight"
+    BLOCK_WEIGHT = "static-block-weight"
+    ONE = "one"
+    ZERO = "zero"
+
+
+class RefinementAlgorithm(str, enum.Enum):
+    NOOP = "noop"
+    LABEL_PROPAGATION = "lp"
+    OVERLOAD_BALANCER = "overload-balancer"
+    UNDERLOAD_BALANCER = "underload-balancer"
+    JET = "jet"
+    GREEDY_FM = "fm"
+
+
+class TwoHopStrategy(str, enum.Enum):
+    DISABLE = "disable"
+    CLUSTER = "cluster"
+    MATCH = "match"
+
+
+class IsolatedNodesStrategy(str, enum.Enum):
+    KEEP = "keep"
+    CLUSTER = "cluster"
+    MATCH_DURING_TWO_HOP = "cluster-during-two-hop"
+
+
+class InitialPartitioningMode(str, enum.Enum):
+    SEQUENTIAL = "sequential"
+    ASYNCHRONOUS_PARALLEL = "async-parallel"
+    SYNCHRONOUS_PARALLEL = "sync-parallel"
+
+
+class FMStoppingRule(str, enum.Enum):
+    SIMPLE = "simple"
+    ADAPTIVE = "adaptive"
+
+
+@dataclass
+class LabelPropagationContext:
+    """kaminpar.h LabelPropagationCoarseningContext (+ bulk-sync knobs)."""
+
+    num_iterations: int = 5
+    # degree-skew knobs: the reference splits high-degree nodes into a
+    # second phase (label_propagation.h:1939); the TPU kernel's sorted
+    # segmented reduction handles skew uniformly, so these are accepted for
+    # context parity but have no effect on the device path
+    large_degree_threshold: int = 2**31 - 1
+    max_num_neighbors: int = 2**31 - 1
+    two_hop_strategy: TwoHopStrategy = TwoHopStrategy.CLUSTER
+    two_hop_threshold: float = 0.5
+    isolated_nodes_strategy: IsolatedNodesStrategy = (
+        IsolatedNodesStrategy.MATCH_DURING_TWO_HOP
+    )
+    # bulk-synchronous device LP specifics (no reference analog; see ops/lp.py)
+    participation: float = 0.5
+    allow_tie_moves: bool = True
+    use_active_set: bool = True
+
+
+@dataclass
+class ClusteringContext:
+    algorithm: ClusteringAlgorithm = ClusteringAlgorithm.LABEL_PROPAGATION
+    lp: LabelPropagationContext = field(default_factory=LabelPropagationContext)
+    cluster_weight_limit: ClusterWeightLimit = (
+        ClusterWeightLimit.EPSILON_BLOCK_WEIGHT
+    )
+    cluster_weight_multiplier: float = 1.0
+    # desired-cluster-count floor (n / shrink_factor); accepted for preset
+    # parity, not yet enforced by the bulk-sync clusterer
+    shrink_factor: float = float("inf")
+
+
+@dataclass
+class CoarseningContext:
+    """kaminpar.h CoarseningContext (presets.cc:178-179 defaults)."""
+
+    algorithm: CoarseningAlgorithm = CoarseningAlgorithm.BASIC_CLUSTERING
+    clustering: ClusteringContext = field(default_factory=ClusteringContext)
+    contraction_limit: int = 2000
+    convergence_threshold: float = 0.05
+
+    def max_cluster_weight(
+        self, n: int, total_node_weight: int, p_ctx: "PartitionContext"
+    ) -> int:
+        """compute_max_cluster_weight (max_cluster_weights.h)."""
+        limit = self.clustering.cluster_weight_limit
+        if limit == ClusterWeightLimit.EPSILON_BLOCK_WEIGHT:
+            divisor = min(max(n // max(self.contraction_limit, 1), 2), p_ctx.k)
+            w = (
+                p_ctx.infer_epsilon(total_node_weight) * total_node_weight
+            ) / divisor
+        elif limit == ClusterWeightLimit.BLOCK_WEIGHT:
+            w = (1.0 + p_ctx.inferred_epsilon()) * total_node_weight / p_ctx.k
+        elif limit == ClusterWeightLimit.ONE:
+            w = 1.0
+        else:
+            w = 0.0
+        return int(w * self.clustering.cluster_weight_multiplier)
+
+
+@dataclass
+class InitialCoarseningContext:
+    """presets.cc:185-189 defaults."""
+
+    contraction_limit: int = 20
+    convergence_threshold: float = 0.05
+    large_degree_threshold: int = 1_000_000
+    cluster_weight_limit: ClusterWeightLimit = ClusterWeightLimit.BLOCK_WEIGHT
+    cluster_weight_multiplier: float = 1.0 / 12.0
+
+
+@dataclass
+class InitialRefinementContext:
+    """Sequential 2-way FM knobs (presets.cc:196-201)."""
+
+    disabled: bool = False
+    stopping_rule: FMStoppingRule = FMStoppingRule.SIMPLE
+    num_fruitless_moves: int = 100
+    alpha: float = 1.0
+    num_iterations: int = 5
+    improvement_abortion_threshold: float = 0.0001
+
+
+@dataclass
+class InitialPoolContext:
+    """presets.cc:202-211 defaults."""
+
+    refinement: InitialRefinementContext = field(
+        default_factory=InitialRefinementContext
+    )
+    repetition_multiplier: float = 1.0
+    min_num_repetitions: int = 10
+    min_num_non_adaptive_repetitions: int = 5
+    max_num_repetitions: int = 50
+    use_adaptive_bipartitioner_selection: bool = True
+    enable_bfs_bipartitioner: bool = True
+    enable_ggg_bipartitioner: bool = True
+    enable_random_bipartitioner: bool = True
+
+
+@dataclass
+class InitialPartitioningContext:
+    coarsening: InitialCoarseningContext = field(
+        default_factory=InitialCoarseningContext
+    )
+    pool: InitialPoolContext = field(default_factory=InitialPoolContext)
+    refinement: InitialRefinementContext = field(
+        default_factory=InitialRefinementContext
+    )
+    use_adaptive_epsilon: bool = True
+
+
+@dataclass
+class LPRefinementContext:
+    num_iterations: int = 5
+    participation: float = 0.8
+
+
+@dataclass
+class JetRefinementContext:
+    """presets.cc jet defaults (num_iterations=0 means auto by level)."""
+
+    num_iterations: int = 0
+    num_fruitless_iterations: int = 12
+    fruitless_threshold: float = 0.999
+    num_rounds_on_fine_level: int = 1
+    num_rounds_on_coarse_level: int = 1
+    initial_gain_temp_on_fine_level: float = 0.25
+    final_gain_temp_on_fine_level: float = 0.25
+    initial_gain_temp_on_coarse_level: float = 0.75
+    final_gain_temp_on_coarse_level: float = 0.75
+
+
+@dataclass
+class BalancerContext:
+    max_rounds: int = 8
+
+
+@dataclass
+class FMRefinementContext:
+    """Host-side k-way FM (refinement/fm) knobs."""
+
+    num_iterations: int = 10
+    num_seed_nodes: int = 10
+    alpha: float = 1.0
+    num_fruitless_moves: int = 100
+
+
+@dataclass
+class RefinementContext:
+    algorithms: List[RefinementAlgorithm] = field(
+        default_factory=lambda: [
+            RefinementAlgorithm.OVERLOAD_BALANCER,
+            RefinementAlgorithm.LABEL_PROPAGATION,
+            RefinementAlgorithm.UNDERLOAD_BALANCER,
+        ]
+    )
+    lp: LPRefinementContext = field(default_factory=LPRefinementContext)
+    jet: JetRefinementContext = field(default_factory=JetRefinementContext)
+    balancer: BalancerContext = field(default_factory=BalancerContext)
+    fm: FMRefinementContext = field(default_factory=FMRefinementContext)
+
+    def includes_algorithm(self, algorithm: RefinementAlgorithm) -> bool:
+        return algorithm in self.algorithms
+
+
+@dataclass
+class PartitioningSchemeContext:
+    """kaminpar.h PartitioningContext."""
+
+    mode: PartitioningMode = PartitioningMode.DEEP
+    deep_initial_partitioning_mode: InitialPartitioningMode = (
+        InitialPartitioningMode.ASYNCHRONOUS_PARALLEL
+    )
+    deep_initial_partitioning_load: float = 1.0
+    refine_after_extending_partition: bool = False
+    vcycles: List[int] = field(default_factory=list)
+    restrict_vcycle_refinement: bool = False
+    rb_enable_kway_toplevel_refinement: bool = False
+
+
+@dataclass
+class ParallelContext:
+    num_workers: int = 1  # host worker threads for initial partitioning
+
+
+@dataclass
+class PartitionContext:
+    """Block count and weight constraints
+    (include/kaminpar-shm/kaminpar.h:371-478)."""
+
+    k: int = 2
+    epsilon: float = 0.03
+    n: int = 0
+    m: int = 0
+    total_node_weight: int = 0
+    total_edge_weight: int = 0
+    max_node_weight: int = 0
+    max_block_weights: Optional[np.ndarray] = None  # relaxed
+    unrelaxed_max_block_weights: Optional[np.ndarray] = None
+    min_block_weights: Optional[np.ndarray] = None
+    uniform_block_weights: bool = True
+
+    def setup(self, graph, k: Optional[int] = None, epsilon: Optional[float] = None,
+              max_block_weights: Optional[np.ndarray] = None,
+              relax_max_block_weights: bool = True) -> None:
+        """PartitionContext::setup (context.cc:27-70)."""
+        if k is not None:
+            self.k = int(k)
+        if epsilon is not None:
+            self.epsilon = float(epsilon)
+        self.n = graph.n
+        self.m = graph.m
+        self.total_node_weight = graph.total_node_weight
+        self.total_edge_weight = graph.total_edge_weight
+        nw = graph.node_weight_array()
+        self.max_node_weight = int(nw.max()) if len(nw) else 0
+
+        if max_block_weights is None:
+            perfect = pymath.ceil(self.total_node_weight / self.k)
+            max_block_weights = np.full(
+                self.k, int((1.0 + self.epsilon) * perfect), dtype=np.int64
+            )
+            self.uniform_block_weights = True
+        else:
+            max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
+            self.k = len(max_block_weights)
+            self.uniform_block_weights = False
+        self.unrelaxed_max_block_weights = max_block_weights.copy()
+
+        if relax_max_block_weights:
+            eps = self.inferred_epsilon()
+            relaxed = np.maximum(
+                max_block_weights,
+                np.ceil(max_block_weights / (1.0 + eps)).astype(np.int64)
+                + self.max_node_weight,
+            )
+            self.max_block_weights = relaxed
+        else:
+            self.max_block_weights = max_block_weights
+
+    def infer_epsilon(self, actual_total_node_weight: int) -> float:
+        """kaminpar.h:427-433."""
+        if self.unrelaxed_max_block_weights is None:
+            return self.epsilon
+        total_max = int(self.unrelaxed_max_block_weights.sum())
+        if actual_total_node_weight <= 0:
+            return self.epsilon
+        return max(total_max / actual_total_node_weight - 1.0, 0.0)
+
+    def inferred_epsilon(self) -> float:
+        return self.infer_epsilon(self.total_node_weight)
+
+    def perfectly_balanced_block_weight(self, block: int = 0) -> int:
+        if self.unrelaxed_max_block_weights is None:
+            return pymath.ceil(self.total_node_weight / self.k)
+        return pymath.ceil(
+            self.unrelaxed_max_block_weights[block] / (1.0 + self.inferred_epsilon())
+        )
+
+    def setup_min_block_weights(self, min_epsilon: float) -> None:
+        """context.cc:72-81."""
+        self.min_block_weights = np.array(
+            [
+                pymath.ceil(
+                    (1.0 - min_epsilon) * self.perfectly_balanced_block_weight(b)
+                )
+                for b in range(self.k)
+            ],
+            dtype=np.int64,
+        )
+
+    def total_max_block_weights(self, begin: int, end: int) -> int:
+        """kaminpar.h:398-408 (sum of unrelaxed max weights in [begin, end))."""
+        return int(self.unrelaxed_max_block_weights[begin:end].sum())
+
+    def max_block_weight(self, block: int = 0) -> int:
+        return int(self.max_block_weights[block])
+
+
+@dataclass
+class DebugContext:
+    """kaminpar.h:484-496."""
+
+    graph_name: str = ""
+    dump_toplevel_graph: bool = False
+    dump_toplevel_partition: bool = False
+    dump_coarsest_graph: bool = False
+    dump_coarsest_partition: bool = False
+    dump_graph_hierarchy: bool = False
+    dump_partition_hierarchy: bool = False
+    dump_dir: str = "."
+
+
+@dataclass
+class Context:
+    """Root context (include/kaminpar-shm/kaminpar.h:550-562)."""
+
+    preset_name: str = "default"
+    partitioning: PartitioningSchemeContext = field(
+        default_factory=PartitioningSchemeContext
+    )
+    partition: PartitionContext = field(default_factory=PartitionContext)
+    coarsening: CoarseningContext = field(default_factory=CoarseningContext)
+    initial_partitioning: InitialPartitioningContext = field(
+        default_factory=InitialPartitioningContext
+    )
+    refinement: RefinementContext = field(default_factory=RefinementContext)
+    parallel: ParallelContext = field(default_factory=ParallelContext)
+    debug: DebugContext = field(default_factory=DebugContext)
+    seed: int = 0
+
+    def copy(self) -> "Context":
+        import copy as pycopy
+
+        return pycopy.deepcopy(self)

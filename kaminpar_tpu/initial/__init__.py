@@ -1,0 +1,6 @@
+from .bipartitioner import (  # noqa: F401
+    InitialMultilevelBipartitioner,
+    PoolBipartitioner,
+    bipartition,
+)
+from .fm import fm_bipartition_refine  # noqa: F401

@@ -1,0 +1,140 @@
+"""Sequential host-side coarsening for initial bipartitioning.
+
+Analog of kaminpar-shm/initial_partitioning/initial_coarsener.cc (456 LoC):
+sequential size-constrained LP clustering interleaved with contraction,
+used only on already-small coarsest graphs (hundreds to thousands of nodes)
+before flat bipartitioning.  numpy-vectorized rounds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..context import InitialCoarseningContext
+from ..graphs.host import HostGraph
+
+
+@dataclass
+class HostCoarseLevel:
+    graph: HostGraph
+    cmap: np.ndarray  # fine node -> coarse node
+
+
+def host_lp_cluster(
+    graph: HostGraph,
+    max_cluster_weight: int,
+    rng: np.random.Generator,
+    num_iterations: int = 3,
+) -> np.ndarray:
+    """Sequential LP clustering (initial_coarsener's ClusteringAlgorithm):
+    visit nodes in random order, join the adjacent cluster with max
+    connection weight subject to the weight cap."""
+    n = graph.n
+    labels = np.arange(n, dtype=np.int64)
+    cw = graph.node_weight_array().copy()
+    node_w = graph.node_weight_array()
+    edge_w = graph.edge_weight_array()
+
+    for _ in range(num_iterations):
+        moved = False
+        for u in rng.permutation(n):
+            lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
+            if lo == hi:
+                continue
+            neigh = graph.adjncy[lo:hi]
+            w = edge_w[lo:hi]
+            cl = labels[neigh]
+            # rating map: sum weights per adjacent cluster
+            uniq, inv = np.unique(cl, return_inverse=True)
+            ratings = np.bincount(inv, weights=w)
+            cur = labels[u]
+            ok = (uniq == cur) | (cw[uniq] + node_w[u] <= max_cluster_weight)
+            if not ok.any():
+                continue
+            ratings = np.where(ok, ratings, -1)
+            best_rating = ratings.max()
+            ties = np.flatnonzero(ratings == best_rating)
+            best = int(uniq[ties[rng.integers(0, len(ties))]])
+            cur_rating = ratings[uniq == cur][0] if (uniq == cur).any() else 0
+            if best != cur and best_rating >= max(cur_rating, 1):
+                cw[cur] -= node_w[u]
+                cw[best] += node_w[u]
+                labels[u] = best
+                moved = True
+        if not moved:
+            break
+    return labels
+
+
+def host_contract(
+    graph: HostGraph, labels: np.ndarray
+) -> Tuple[HostGraph, np.ndarray]:
+    """Contract a clustering on the host (sequential analog of
+    contraction/cluster_contraction.h)."""
+    uniq, cmap = np.unique(labels, return_inverse=True)
+    c_n = len(uniq)
+    node_w = graph.node_weight_array()
+    c_node_w = np.zeros(c_n, dtype=np.int64)
+    np.add.at(c_node_w, cmap, node_w)
+
+    src = graph.edge_sources()
+    cu = cmap[src]
+    cv = cmap[graph.adjncy]
+    ew = graph.edge_weight_array()
+    keep = cu != cv
+    cu, cv, ew = cu[keep], cv[keep], ew[keep]
+    key = cu.astype(np.int64) * c_n + cv
+    order = np.argsort(key, kind="stable")
+    key, cu, cv, ew = key[order], cu[order], cv[order], ew[order]
+    if len(key):
+        new_group = np.empty(len(key), dtype=bool)
+        new_group[0] = True
+        new_group[1:] = key[1:] != key[:-1]
+        gid = np.cumsum(new_group) - 1
+        g_w = np.bincount(gid, weights=ew).astype(np.int64)
+        g_cu = cu[new_group]
+        g_cv = cv[new_group]
+    else:
+        g_w = np.zeros(0, dtype=np.int64)
+        g_cu = np.zeros(0, dtype=np.int64)
+        g_cv = np.zeros(0, dtype=np.int64)
+
+    xadj = np.zeros(c_n + 1, dtype=np.int64)
+    np.add.at(xadj, g_cu + 1, 1)
+    xadj = np.cumsum(xadj)
+    coarse = HostGraph(
+        xadj=xadj,
+        adjncy=g_cv.astype(np.int32),
+        node_weights=c_node_w,
+        edge_weights=g_w if len(g_w) and not (g_w == 1).all() else None,
+    )
+    return coarse, cmap
+
+
+def coarsen_for_bipartition(
+    graph: HostGraph,
+    ctx: InitialCoarseningContext,
+    rng: np.random.Generator,
+    max_block_weight: int,
+) -> List[HostCoarseLevel]:
+    """Build the sequential coarse hierarchy until n <= 2*contraction_limit
+    or convergence (initial_coarsener.cc loop).  Returns levels fine->coarse
+    (the input graph is not included)."""
+    levels: List[HostCoarseLevel] = []
+    current = graph
+    limit = 2 * ctx.contraction_limit
+    while current.n > limit:
+        # BLOCK_WEIGHT-style cluster cap (presets.cc:188-189)
+        max_cluster_weight = max(
+            1, int(ctx.cluster_weight_multiplier * max_block_weight)
+        )
+        labels = host_lp_cluster(current, max_cluster_weight, rng)
+        coarse, cmap = host_contract(current, labels)
+        if coarse.n >= (1.0 - ctx.convergence_threshold) * current.n:
+            break  # converged, not shrinking enough
+        levels.append(HostCoarseLevel(graph=coarse, cmap=cmap))
+        current = coarse
+    return levels

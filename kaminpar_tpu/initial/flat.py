@@ -1,0 +1,145 @@
+"""Flat (single-level) 2-way bipartitioners on the host.
+
+Analogs of kaminpar-shm/initial_partitioning/'s pool members:
+  * RandomBipartitioner  (initial_random_bipartitioner.h:16)
+  * BfsBipartitioner     (initial_bfs_bipartitioner.h:41, greedy BFS growth)
+  * GreedyGraphGrowing   (initial_ggg_bipartitioner.h:18, gain-ordered growth)
+
+These run on the coarsest graphs only (n <= ~2*contraction_limit after
+initial coarsening), so plain numpy/python is the right tool — exactly the
+reference's design point of keeping initial bipartitioning sequential on CPU
+(initial_bipartitioner_worker_pool.h:42, BASELINE.json north star).
+
+All bipartitioners take (graph, max_block_weights[2], rng) and return an
+int8 partition array; they may violate balance slightly if the graph forces
+it (the FM refiner + balancer repair later), matching reference behavior.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Tuple
+
+import numpy as np
+
+from ..graphs.host import HostGraph
+
+
+def _greedy_block(weights_sorted_idx, node_w, max_w0):
+    """Assign nodes in the given order to block 0 until it is full."""
+    part = np.ones(len(node_w), dtype=np.int8)
+    w0 = 0
+    for u in weights_sorted_idx:
+        if w0 + node_w[u] <= max_w0:
+            part[u] = 0
+            w0 += node_w[u]
+    return part
+
+
+def random_bipartition(
+    graph: HostGraph, max_block_weights: np.ndarray, rng: np.random.Generator
+) -> np.ndarray:
+    """Random assignment with capacity check: each node goes to a random
+    block that still has room, else the other (initial_random_bipartitioner
+    behavior)."""
+    n = graph.n
+    node_w = graph.node_weight_array()
+    part = np.zeros(n, dtype=np.int8)
+    weights = [0, 0]
+    order = rng.permutation(n)
+    choice = rng.integers(0, 2, size=n)
+    for u in order:
+        b = int(choice[u])
+        if weights[b] + node_w[u] > max_block_weights[b]:
+            b = 1 - b
+        part[u] = b
+        weights[b] += node_w[u]
+    return part
+
+
+def bfs_bipartition(
+    graph: HostGraph, max_block_weights: np.ndarray, rng: np.random.Generator
+) -> np.ndarray:
+    """Grow block 0 via BFS from a random seed until it reaches its
+    perfectly-balanced weight (initial_bfs_bipartitioner.h:41)."""
+    n = graph.n
+    if n == 0:
+        return np.zeros(0, dtype=np.int8)
+    node_w = graph.node_weight_array()
+    total = int(node_w.sum())
+    target0 = min(int(max_block_weights[0]), total - 0)
+    # stop growing once block 0 holds ~half the total weight
+    stop_at = max(total - int(max_block_weights[1]), (total + 1) // 2)
+
+    part = np.ones(n, dtype=np.int8)
+    visited = np.zeros(n, dtype=bool)
+    queue = [int(rng.integers(0, n))]
+    visited[queue[0]] = True
+    w0 = 0
+    while queue and w0 < stop_at:
+        u = queue.pop(0)
+        if w0 + node_w[u] > target0:
+            continue
+        part[u] = 0
+        w0 += node_w[u]
+        for v in graph.neighbors(u):
+            if not visited[v]:
+                visited[v] = True
+                queue.append(int(v))
+        if not queue:
+            remaining = np.flatnonzero(~visited)
+            if len(remaining):
+                s = int(rng.choice(remaining))
+                visited[s] = True
+                queue.append(s)
+    return part
+
+
+def ggg_bipartition(
+    graph: HostGraph, max_block_weights: np.ndarray, rng: np.random.Generator
+) -> np.ndarray:
+    """Greedy graph growing (initial_ggg_bipartitioner.h:18): grow block 0
+    from a random seed, always absorbing the frontier node with the highest
+    gain (connection to block 0 minus connection to block 1)."""
+    n = graph.n
+    if n == 0:
+        return np.zeros(0, dtype=np.int8)
+    node_w = graph.node_weight_array()
+    edge_w = graph.edge_weight_array()
+    total = int(node_w.sum())
+    stop_at = max(total - int(max_block_weights[1]), (total + 1) // 2)
+    target0 = int(max_block_weights[0])
+
+    part = np.ones(n, dtype=np.int8)
+    in_b0 = np.zeros(n, dtype=bool)
+    gain = np.zeros(n, dtype=np.int64)  # connection to block 0 (rest is b1)
+    pq: list = []
+    seed = int(rng.integers(0, n))
+    heapq.heappush(pq, (0, seed))
+    queued = np.zeros(n, dtype=bool)
+    queued[seed] = True
+    w0 = 0
+    while w0 < stop_at:
+        while pq:
+            negg, u = heapq.heappop(pq)
+            if not in_b0[u] and -negg == gain[u]:
+                break
+        else:
+            remaining = np.flatnonzero(~in_b0 & ~queued)
+            if len(remaining) == 0:
+                break
+            u = int(rng.choice(remaining))
+            queued[u] = True
+        if in_b0[u] or w0 + node_w[u] > target0:
+            continue
+        in_b0[u] = True
+        part[u] = 0
+        w0 += node_w[u]
+        lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
+        for e in range(lo, hi):
+            v = int(graph.adjncy[e])
+            if not in_b0[v]:
+                gain[v] += int(edge_w[e])
+                queued[v] = True
+                heapq.heappush(pq, (-int(gain[v]), v))
+    return part

@@ -1,0 +1,206 @@
+"""Sequential 2-way FM refinement on the host.
+
+Analog of kaminpar-shm/initial_partitioning/initial_fm_refiner.h:68 (466
+LoC): classic Fiduccia–Mattheyses with two priority queues, best-prefix
+rollback, and the reference's stopping policies (simple = abort after
+`num_fruitless_moves` non-improving moves; adaptive = Osipov/Sanders random
+walk model with parameter alpha, stopping_policies analog).
+
+Runs on coarsest-level graphs (tens to hundreds of nodes), so python/heapq
+is appropriate — this mirrors the reference keeping initial bipartitioning
+sequential per thread.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..context import FMStoppingRule, InitialRefinementContext
+from ..graphs.host import HostGraph
+
+
+@dataclass
+class _SimpleStopper:
+    """initial_fm_refiner stopping policy SIMPLE."""
+
+    num_fruitless_moves: int
+    fruitless: int = 0
+
+    def reset(self) -> None:
+        self.fruitless = 0
+
+    def update(self, gain: int) -> None:
+        if gain > 0:
+            self.fruitless = 0
+        else:
+            self.fruitless += 1
+
+    def should_stop(self) -> bool:
+        return self.fruitless >= self.num_fruitless_moves
+
+
+@dataclass
+class _AdaptiveStopper:
+    """Adaptive stopping rule (stopping_policies.h:16): stop when the
+    expected gain of continuing the random walk becomes negative, i.e.
+    num_steps * expected_gain^2 > alpha * variance + beta."""
+
+    alpha: float
+    beta: float = 10.0
+    num_steps: int = 0
+    mean: float = 0.0
+    m2: float = 0.0
+
+    def reset(self) -> None:
+        self.num_steps = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def update(self, gain: int) -> None:
+        self.num_steps += 1
+        delta = gain - self.mean
+        self.mean += delta / self.num_steps
+        self.m2 += delta * (gain - self.mean)
+
+    def should_stop(self) -> bool:
+        if self.num_steps < 2:
+            return False
+        variance = self.m2 / (self.num_steps - 1)
+        return (
+            self.mean < 0
+            and self.num_steps * self.mean * self.mean
+            > self.alpha * variance + self.beta
+        )
+
+
+def fm_bipartition_refine(
+    graph: HostGraph,
+    partition: np.ndarray,
+    max_block_weights: np.ndarray,
+    ctx: InitialRefinementContext,
+    rng: np.random.Generator,
+) -> int:
+    """Refine a 2-way partition in place; returns the total cut improvement.
+
+    One call runs up to ctx.num_iterations FM passes (initial_fm_refiner
+    num_iterations=5 default); each pass moves nodes one at a time picking
+    the max-gain feasible move, tracks the best prefix, and rolls back the
+    tail."""
+    if graph.n == 0:
+        return 0
+    node_w = graph.node_weight_array()
+    edge_w = graph.edge_weight_array()
+    total_improvement = 0
+
+    if ctx.stopping_rule == FMStoppingRule.ADAPTIVE:
+        stopper = _AdaptiveStopper(alpha=ctx.alpha)
+    else:
+        stopper = _SimpleStopper(num_fruitless_moves=ctx.num_fruitless_moves)
+
+    for _ in range(max(1, ctx.num_iterations)):
+        improvement = _fm_pass(
+            graph, partition, node_w, edge_w, max_block_weights, stopper, rng
+        )
+        total_improvement += improvement
+        if improvement == 0:
+            break
+    return total_improvement
+
+
+def _gains(graph, partition, edge_w):
+    """gain[u] = weight to other block - weight to own block."""
+    src = graph.edge_sources()
+    ext = np.zeros(graph.n, dtype=np.int64)
+    internal = np.zeros(graph.n, dtype=np.int64)
+    cut_mask = partition[src] != partition[graph.adjncy]
+    np.add.at(ext, src[cut_mask], edge_w[cut_mask])
+    np.add.at(internal, src[~cut_mask], edge_w[~cut_mask])
+    return ext - internal
+
+
+def _fm_pass(graph, partition, node_w, edge_w, max_block_weights, stopper, rng):
+    n = graph.n
+    gain = _gains(graph, partition, edge_w)
+    block_w = np.zeros(2, dtype=np.int64)
+    np.add.at(block_w, partition, node_w)
+
+    # two PQs keyed by gain with random tiebreak (lazy deletion)
+    pqs = ([], [])
+    tie = rng.random(n)
+    for u in range(n):
+        heapq.heappush(pqs[partition[u]], (-int(gain[u]), tie[u], u))
+    locked = np.zeros(n, dtype=bool)
+    stopper.reset()
+
+    moves = []
+    cur_delta = 0
+    best_delta = 0
+    best_len = 0
+
+    while True:
+        # choose source block: prefer the feasible move with higher gain
+        candidates = []
+        for b in (0, 1):
+            while pqs[b]:
+                negg, t, u = pqs[b][0]
+                if locked[u] or partition[u] != b or -negg != gain[u]:
+                    heapq.heappop(pqs[b])
+                    continue
+                candidates.append((negg, t, u, b))
+                break
+        feasible = [
+            c
+            for c in candidates
+            if block_w[1 - c[3]] + node_w[c[2]] <= max_block_weights[1 - c[3]]
+        ]
+        if feasible:
+            feasible.sort()
+            negg, _, u, b = feasible[0]
+        else:
+            # no balance-feasible move: move from the heavier block (the
+            # only direction that improves balance); candidates from the
+            # lighter block stay in their PQ for later
+            heavier = int(block_w[1] > block_w[0])
+            from_heavier = [c for c in candidates if c[3] == heavier]
+            if not from_heavier:
+                break
+            negg, _, u, b = from_heavier[0]
+        heapq.heappop(pqs[b])
+
+        # apply move u: b -> 1-b
+        locked[u] = True
+        partition[u] = 1 - b
+        block_w[b] -= node_w[u]
+        block_w[1 - b] += node_w[u]
+        g = -negg
+        cur_delta += g
+        moves.append(u)
+        stopper.update(g)
+        if cur_delta > best_delta:
+            best_delta = cur_delta
+            best_len = len(moves)
+
+        # update neighbor gains
+        lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
+        for e in range(lo, hi):
+            v = int(graph.adjncy[e])
+            w = int(edge_w[e])
+            # v's connection to u's old block fell, to new block rose
+            if partition[v] == b:
+                gain[v] += 2 * w
+            else:
+                gain[v] -= 2 * w
+            if not locked[v]:
+                heapq.heappush(pqs[partition[v]], (-int(gain[v]), tie[v], v))
+        gain[u] = -gain[u]
+
+        if stopper.should_stop():
+            break
+
+    # roll back to best prefix
+    for u in moves[best_len:]:
+        partition[u] = 1 - partition[u]
+    return best_delta

@@ -1,0 +1,295 @@
+"""Overload / underload balancers on device.
+
+Analog of kaminpar-shm/refinement/balancer/:
+  * OverloadBalancer (overload_balancer.h:25): the reference keeps one
+    priority queue per overloaded block, ordered by *relative gain*
+    (relative_gain.h: gain > 0 ? gain * weight : gain / weight) and pops
+    until the block is feasible.  The TPU version is bulk-synchronous
+    rounds: for every node of an overloaded block compute its best feasible
+    target block, rank movers per source block by relative gain, accept
+    per-source prefixes that cover the overload and per-target prefixes
+    that fit the headroom (both via sorted prefix sums).
+  * UnderloadBalancer: symmetric — pull weight into blocks below their min
+    weight from neighboring blocks.
+
+The device loop makes fast progress but may stall on adversarial instances
+(e.g. when all movers of an overloaded block are individually too heavy for
+every target); partitioning/refiner.py falls back to the exact host balancer
+(`host_balance`) to provide the reference's strict balance guarantee
+(README.MD:18).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..graphs.csr import DeviceGraph
+from .segments import (
+    ACC_DTYPE,
+    INT32_MIN,
+    accept_prefix_by_capacity,
+    aggregate_by_key,
+    argmax_per_segment,
+    connection_to_label,
+    hash_u32,
+)
+
+
+def _relative_gain_key(gain: jax.Array, weight: jax.Array) -> jax.Array:
+    """Sortable surrogate for compute_relative_gain (relative_gain.h):
+    gain>0 -> gain*weight, else gain/weight.  Returned as a float32 to be
+    used as a *descending* priority."""
+    w = jnp.maximum(weight.astype(jnp.float32), 1.0)
+    g = gain.astype(jnp.float32)
+    return jnp.where(g > 0, g * w, g / w)
+
+
+def _block_weights(graph: DeviceGraph, partition: jax.Array, k: int) -> jax.Array:
+    return jax.ops.segment_sum(
+        graph.node_w.astype(ACC_DTYPE),
+        jnp.clip(partition, 0, k - 1),
+        num_segments=k,
+    )
+
+
+def overload_balance_round(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    salt: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """One bulk-synchronous balancing round; returns (partition, moved)."""
+    n_pad = graph.n_pad
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    is_real = node_ids < graph.n
+    part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
+    bw = _block_weights(graph, part, k)
+    cap = max_block_weights.astype(ACC_DTYPE)
+    overload = jnp.maximum(bw - cap, 0)
+    headroom = jnp.maximum(cap - bw, 0)
+
+    in_overloaded = (overload[part] > 0) & is_real
+
+    # best feasible target per node: highest-connection non-overloaded block
+    # with room for the node
+    neigh_block = part[graph.dst]
+    seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
+    key_c = jnp.clip(key_g, 0, k - 1)
+    seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+    tgt_ok = (
+        (seg_g >= 0)
+        & (key_g != part[seg_c])
+        & (overload[key_c] == 0)
+        & (graph.node_w[seg_c].astype(ACC_DTYPE) <= headroom[key_c])
+    )
+    best, best_w = argmax_per_segment(
+        seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=tgt_ok
+    )
+    # connection to own block (for the gain of leaving)
+    w_own = connection_to_label(seg_g, key_g, w_g, part, n_pad)
+
+    # fallback target for nodes with no feasible adjacent block: the block
+    # with maximum headroom (reference moves into any non-overloaded block)
+    fallback = jnp.argmax(headroom).astype(jnp.int32)
+    fallback_ok = graph.node_w.astype(ACC_DTYPE) <= headroom[fallback]
+    use_fallback = (best < 0) & fallback_ok
+    target = jnp.where(use_fallback, fallback, best)
+    gain = jnp.where(use_fallback, -w_own, best_w - w_own)
+
+    mover = in_overloaded & (target >= 0)
+    target = jnp.where(mover, target, -1)
+
+    # per-source-block: accept movers by descending relative gain until the
+    # overload is covered.  Encode descending order as ascending int key.
+    rel = _relative_gain_key(gain, graph.node_w)
+    order_key = -rel  # float32; ascending sort = best relative gain first
+    src_block = jnp.where(mover, part, -1)
+    accept_out = accept_prefix_by_capacity(
+        src_block, order_key, graph.node_w, overload, reach=True
+    )
+
+    # per-target-block: STRICT headroom admission — a previously feasible
+    # block must never become overloaded by incoming movers
+    target2 = jnp.where(accept_out, target, -1)
+    accept_in = accept_prefix_by_capacity(
+        target2, order_key, graph.node_w, headroom
+    )
+    accept = accept_out & accept_in
+
+    new_part = jnp.where(accept, target, part)
+    return new_part, jnp.sum(accept.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("k", "max_rounds"))
+def overload_balance(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    seed: jax.Array,
+    max_rounds: int = 8,
+) -> jax.Array:
+    """Run balancing rounds until feasible or stalled (OverloadBalancer::
+    balance analog)."""
+
+    def cond(state):
+        i, part, moved = state
+        bw = _block_weights(graph, part, k)
+        over = jnp.sum(jnp.maximum(bw - max_block_weights.astype(ACC_DTYPE), 0))
+        return (i < max_rounds) & (over > 0) & (moved != 0)
+
+    def body(state):
+        i, part, _ = state
+        salt = (seed.astype(jnp.int32) * 48271 + i * 1566083941) & 0x7FFFFFFF
+        part, moved = overload_balance_round(
+            graph, part, k, max_block_weights, salt
+        )
+        return (i + 1, part, moved)
+
+    _, part, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.clip(partition, 0, k - 1), jnp.int32(1))
+    )
+    return part
+
+
+@partial(jax.jit, static_argnames=("k", "max_rounds"))
+def underload_balance(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    min_block_weights: jax.Array,
+    seed: jax.Array,
+    max_rounds: int = 8,
+) -> jax.Array:
+    """UnderloadBalancer analog: pull weight into blocks below their min
+    weight, taking the cheapest movers from blocks with surplus
+    (weight > min)."""
+
+    def body(state):
+        i, part, _ = state
+        salt = (seed.astype(jnp.int32) * 16807 + i * 1566083941) & 0x7FFFFFFF
+        n_pad = graph.n_pad
+        node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+        is_real = node_ids < graph.n
+        bw = _block_weights(graph, part, k)
+        deficit = jnp.maximum(min_block_weights.astype(ACC_DTYPE) - bw, 0)
+        surplus = jnp.maximum(bw - min_block_weights.astype(ACC_DTYPE), 0)
+
+        # candidates: nodes in surplus blocks adjacent to a deficit block
+        neigh_block = part[graph.dst]
+        seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
+        key_c = jnp.clip(key_g, 0, k - 1)
+        seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+        tgt_ok = (
+            (seg_g >= 0)
+            & (key_g != part[seg_c])
+            & (deficit[key_c] > 0)
+        )
+        best, best_w = argmax_per_segment(
+            seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=tgt_ok
+        )
+        # fallback for deficit blocks with no adjacent candidates (e.g. an
+        # empty block): pull arbitrary nodes into the most-deficient block
+        fallback = jnp.argmax(deficit).astype(jnp.int32)
+        use_fallback = (best < 0) & (deficit[fallback] > 0) & (part != fallback)
+        best = jnp.where(use_fallback, fallback, best)
+        best_w = jnp.where(use_fallback, 0, best_w)
+        mover = (
+            is_real
+            & (best >= 0)
+            & (surplus[part] >= graph.node_w.astype(ACC_DTYPE))
+        )
+        target = jnp.where(mover, best, -1)
+        rel = _relative_gain_key(best_w, graph.node_w)
+        order_key = -rel
+        # take out no more than the surplus, put in no more than the deficit
+        accept_out = accept_prefix_by_capacity(
+            jnp.where(mover, part, -1), order_key, graph.node_w, surplus
+        )
+        target2 = jnp.where(accept_out, target, -1)
+        accept_in = accept_prefix_by_capacity(
+            target2, order_key, graph.node_w, deficit, reach=True
+        )
+        accept = accept_out & accept_in
+        new_part = jnp.where(accept, target, part)
+        return (i + 1, new_part, jnp.sum(accept.astype(jnp.int32)))
+
+    def cond(state):
+        i, part, moved = state
+        bw = _block_weights(graph, part, k)
+        deficit = jnp.sum(
+            jnp.maximum(min_block_weights.astype(ACC_DTYPE) - bw, 0)
+        )
+        return (i < max_rounds) & (deficit > 0) & (moved != 0)
+
+    _, part, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), jnp.clip(partition, 0, k - 1), jnp.int32(1))
+    )
+    return part
+
+
+def host_balance(
+    node_w: np.ndarray,
+    adjacency: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    partition: np.ndarray,
+    max_block_weights: np.ndarray,
+) -> np.ndarray:
+    """Exact greedy host balancer — the strict-balance guarantee backstop
+    (README.MD:18).  Moves the relatively-cheapest nodes out of overloaded
+    blocks one at a time until feasible; always terminates feasible when
+    sum(node weights) <= sum(max block weights) and node weights fit."""
+    xadj, adjncy, edge_w = adjacency
+    part = partition.copy()
+    n = len(part)
+    k = len(max_block_weights)
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, part, node_w)
+
+    # internal connection weight per node: cut damage of moving it away
+    src = np.repeat(np.arange(n), np.diff(xadj))
+    internal = np.zeros(n, dtype=np.int64)
+    same = part[src] == part[adjncy]
+    np.add.at(internal, src[same], edge_w[same])
+
+    # movers ordered by (internal connection, weight): cheapest cut damage
+    # first, light nodes first
+    order = np.lexsort((node_w, internal))
+    for _ in range(n * 2):
+        over_blocks = np.flatnonzero(bw > max_block_weights)
+        if len(over_blocks) == 0:
+            break
+        b = int(
+            over_blocks[np.argmax(bw[over_blocks] - max_block_weights[over_blocks])]
+        )
+        movers = order[part[order] == b]
+        moved = False
+        for u in movers:
+            # best target with room: max connection among roomy blocks
+            room = max_block_weights - bw
+            room[b] = -1
+            lo, hi = int(xadj[u]), int(xadj[u + 1])
+            conn = np.zeros(k, dtype=np.int64)
+            np.add.at(conn, part[adjncy[lo:hi]], edge_w[lo:hi])
+            conn[room < node_w[u]] = -1
+            conn[b] = -1
+            t = int(np.argmax(conn))
+            if conn[t] < 0:  # no adjacent roomy block: any roomy block
+                t = int(np.argmax(room))
+                if room[t] < node_w[u]:
+                    continue
+            part[u] = t
+            bw[b] -= node_w[u]
+            bw[t] += node_w[u]
+            moved = True
+            break
+        if not moved:
+            break
+    return part

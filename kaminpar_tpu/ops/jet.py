@@ -1,0 +1,257 @@
+"""Jet refinement on device.
+
+Analog of kaminpar-shm/refinement/jet/jet_refiner.cc, itself an
+implementation of "Jet: Multilevel Graph Partitioning on GPUs" (Gilbert et
+al.) — the reference's most TPU-amenable refiner, and here it runs as a
+fully fused device loop.  Per iteration (jet_refiner.cc:100-214):
+
+  1. find:     every unlocked border node picks its best external block;
+               it becomes a candidate if best_gain > -floor(temp * conn_own)
+               (the gain temperature admits slightly-negative moves);
+  2. filter    ("afterburner"): each candidate's gain is re-evaluated
+               assuming every neighbor with strictly better (gain, id) order
+               is already at its tentative destination; only candidates with
+               positive adjusted gain are locked in;
+  3. execute:  apply locked moves in bulk;
+  4. rebalance with the overload balancer;
+  5. keep the best-cut partition seen; stop after `num_fruitless_iterations`
+     without sufficient improvement (fruitless_threshold) and roll back.
+
+The candidate/filter/execute steps are already bulk-synchronous in the
+reference (it is a GPU algorithm run on CPU threads); the TPU version
+expresses them as whole-graph segment reductions, and the iteration loop is
+a lax.while_loop so an entire Jet pass is one XLA program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..context import JetRefinementContext
+from ..graphs.csr import DeviceGraph
+from .balancer import overload_balance_round
+from .metrics import edge_cut
+from .segments import (
+    ACC_DTYPE,
+    INT32_MIN,
+    aggregate_by_key,
+    argmax_per_segment,
+    connection_to_label,
+)
+
+
+def _jet_iteration(
+    graph: DeviceGraph,
+    part: jax.Array,
+    lock: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    gain_temp: jax.Array,
+    salt: jax.Array,
+    balancer_rounds: int,
+) -> Tuple[jax.Array, jax.Array]:
+    n_pad = graph.n_pad
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    is_real = node_ids < graph.n
+
+    # ---- find moves (jet_refiner.cc:104-131) ----
+    neigh_block = part[graph.dst]
+    seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
+    seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+    is_ext = (seg_g >= 0) & (key_g != part[seg_c])
+    best, best_conn = argmax_per_segment(
+        seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=is_ext
+    )
+    conn_own = connection_to_label(seg_g, key_g, w_g, part, n_pad)
+    gain = best_conn - conn_own  # gain of moving to best external block
+    is_border = best >= 0
+    threshold = -jnp.floor(gain_temp * conn_own.astype(jnp.float32)).astype(
+        jnp.int32
+    )
+    candidate = (
+        is_real & is_border & (lock == 0) & (gain > threshold)
+    )
+    next_part = jnp.where(candidate, best, part)
+
+    # ---- filter: afterburner (jet_refiner.cc:133-170) ----
+    # neighbor ordering: v counts as moved iff v is a candidate and
+    # (gain_v, -v) orders strictly before (gain_u, -u)
+    gain_full = jnp.where(candidate, gain, INT32_MIN)
+    u = graph.src
+    v = graph.dst
+    gain_u = gain_full[u]
+    gain_v = gain_full[v]
+    v_is_cand = gain_v > INT32_MIN
+    v_before_u = v_is_cand & (
+        (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
+    )
+    block_v = jnp.where(v_before_u, next_part[v], part[v])
+    to_u = next_part[u]
+    from_u = part[u]
+    contrib = jnp.where(
+        to_u == block_v,
+        graph.edge_w,
+        jnp.where(from_u == block_v, -graph.edge_w, 0),
+    )
+    adj_gain = jax.ops.segment_sum(
+        jnp.where(candidate[u], contrib, 0), u, num_segments=n_pad
+    )
+    accept = candidate & (adj_gain > 0)
+
+    # ---- execute (jet_refiner.cc:172-183) ----
+    new_part = jnp.where(accept, next_part, part)
+    new_lock = accept.astype(jnp.int32)  # moved nodes rest next iteration
+
+    # ---- rebalance (jet_refiner.cc:185-187) ----
+    def bal_body(i, p):
+        s = (salt + i * 7919) & 0x7FFFFFFF
+        p2, _ = overload_balance_round(graph, p, k, max_block_weights, s)
+        return p2
+
+    new_part = lax.fori_loop(0, balancer_rounds, bal_body, new_part)
+    return new_part, new_lock
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "k",
+        "num_rounds",
+        "max_iterations",
+        "max_fruitless",
+        "balancer_rounds",
+    ),
+)
+def _jet_refine_impl(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    seed: jax.Array,
+    initial_gain_temp: jax.Array,
+    final_gain_temp: jax.Array,
+    fruitless_threshold: jax.Array,
+    num_rounds: int,
+    max_iterations: int,
+    max_fruitless: int,
+    balancer_rounds: int,
+) -> jax.Array:
+    part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
+    best0 = part0
+    best_cut0 = edge_cut(graph, part0)
+
+    def round_body(rnd, carry):
+        part, best, best_cut = carry
+        gain_temp = jnp.where(
+            num_rounds > 1,
+            initial_gain_temp
+            + (final_gain_temp - initial_gain_temp)
+            * rnd.astype(jnp.float32)
+            / jnp.float32(max(num_rounds - 1, 1)),
+            initial_gain_temp,
+        )
+
+        def iter_cond(state):
+            i, fruitless, part, lock, best, best_cut, last_best = state
+            return (i < max_iterations) & (fruitless < max_fruitless)
+
+        def iter_body(state):
+            i, fruitless, part, lock, best, best_cut, last_best = state
+            salt = (
+                seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
+            ) & 0x7FFFFFFF
+            part, lock = _jet_iteration(
+                graph,
+                part,
+                lock,
+                k,
+                max_block_weights,
+                gain_temp,
+                salt,
+                balancer_rounds,
+            )
+            cut = edge_cut(graph, part)
+            improved_enough = (best_cut - cut).astype(jnp.float32) > (
+                1.0 - fruitless_threshold
+            ) * best_cut.astype(jnp.float32)
+            fruitless = jnp.where(improved_enough, 0, fruitless + 1)
+            is_best = cut <= best_cut
+            best = jnp.where(is_best, part, best)
+            best_cut = jnp.minimum(best_cut, cut)
+            return (i + 1, fruitless, part, lock, best, best_cut, is_best)
+
+        lock0 = jnp.zeros(graph.n_pad, dtype=jnp.int32)
+        (_, _, part, _, best, best_cut, _) = lax.while_loop(
+            iter_cond,
+            iter_body,
+            (
+                jnp.int32(0),
+                jnp.int32(0),
+                part,
+                lock0,
+                best,
+                best_cut,
+                jnp.array(True),
+            ),
+        )
+        # rollback to best (jet_refiner.cc:221-227): the round continues
+        # from the best partition seen
+        return (best, best, best_cut)
+
+    part, best, _ = lax.fori_loop(
+        0, num_rounds, round_body, (part0, best0, best_cut0)
+    )
+    return best
+
+
+def jet_refine(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    seed: jax.Array,
+    ctx: JetRefinementContext,
+    level: int = 0,
+    num_levels: int = 1,
+    balancer_rounds: int = 4,
+) -> jax.Array:
+    """Jet refinement entry point; picks coarse/fine temperatures by level
+    (jet_refiner.cc:40-49: every level except the finest counts as coarse)."""
+    is_coarse = level > 0
+    if is_coarse:
+        rounds = ctx.num_rounds_on_coarse_level
+        t0, t1 = (
+            ctx.initial_gain_temp_on_coarse_level,
+            ctx.final_gain_temp_on_coarse_level,
+        )
+    else:
+        rounds = ctx.num_rounds_on_fine_level
+        t0, t1 = (
+            ctx.initial_gain_temp_on_fine_level,
+            ctx.final_gain_temp_on_fine_level,
+        )
+    max_iterations = ctx.num_iterations if ctx.num_iterations > 0 else 64
+    max_fruitless = (
+        ctx.num_fruitless_iterations
+        if ctx.num_fruitless_iterations > 0
+        else 2**30
+    )
+    return _jet_refine_impl(
+        graph,
+        partition,
+        k,
+        max_block_weights,
+        seed,
+        jnp.float32(t0),
+        jnp.float32(t1),
+        jnp.float32(ctx.fruitless_threshold),
+        int(rounds),
+        int(max_iterations),
+        int(max_fruitless),
+        int(balancer_rounds),
+    )

@@ -1,0 +1,412 @@
+"""Bulk-synchronous size-constrained label propagation on device.
+
+The TPU re-design of the reference's LP engine
+(kaminpar-shm/label_propagation.h:83 LabelPropagation<...>).  The reference
+runs an *asynchronous* LP: threads sweep shuffled chunks of nodes, rate each
+node's adjacent clusters in a per-thread hash map
+(find_best_cluster:461-541) and commit moves with CAS cluster-weight updates
+(try_node_move:818, move_cluster_weight:2139).  Fine-grained CAS does not
+map to TPUs, so this kernel makes the trade the reference's own Jet refiner
+makes (refinement/jet/jet_refiner.cc:1-8): *bulk-synchronous rounds* of
+
+  1. rate:    aggregate (node, neighbor-cluster) connection weights via the
+              sorted segmented reduction in ops/segments.py;
+  2. select:  per-node argmax over feasible clusters (weight cap), hashed
+              random tie-breaking — the analog of find_best_cluster;
+  3. commit:  capacity-respecting prefix acceptance per target cluster
+              (ops/segments.accept_prefix_by_capacity), so the max cluster
+              weight is *never* exceeded — stronger than the reference's
+              relaxed CAS, which tolerates transient overshoot;
+  4. apply:   scatter accepted labels, update cluster weights, refresh the
+              active set (the analog of label_propagation.h:507-513).
+
+Oscillation control (label_propagation.h avoids it by construction via
+async updates; bulk-sync must handle it explicitly):
+  * zero-gain ("tie") moves only follow a per-round hashed direction —
+    of two clusters that rate equally, only the one with smaller hash may
+    absorb the other, which turns 2-cycles into merges;
+  * per-round random participation mask (cfg.participation < 1) — the
+    bulk-sync analog of the reference's shuffled chunk scheduling
+    (ChunkRandomLabelPropagation:1529), breaking symmetric flip patterns.
+
+Whole multi-round loops run inside one jit via lax.while_loop with a
+moved-count convergence test, so a full clustering is a single device
+program launch.
+
+Post-passes mirroring the reference:
+  * cluster_isolated_nodes (label_propagation.h:872-917)
+  * two-hop clustering of leftover singletons by favored cluster
+    (label_propagation.h:919-1191)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graphs.csr import DeviceGraph
+from .segments import (
+    ACC_DTYPE,
+    INT32_MIN,
+    accept_prefix_by_capacity,
+    aggregate_by_key,
+    apply_move_weight_delta,
+    argmax_per_segment,
+    connection_to_label,
+    hash_u32,
+)
+
+
+@dataclass(frozen=True)
+class LPConfig:
+    """Knobs mirroring LabelPropagationConfig (label_propagation.h:36-74)
+    plus the bulk-sync-specific ones."""
+
+    num_iterations: int = 5  # lp_clusterer.cc default
+    participation: float = 0.5  # per-round node participation probability
+    allow_tie_moves: bool = True
+    use_active_set: bool = True
+    # post-pass toggles (two_hop_strategy / isolated_nodes_strategy enums)
+    two_hop: bool = True
+    cluster_isolated: bool = True
+    # refinement mode: labels are blocks, moves need positive gain
+    refinement: bool = False
+
+
+def lp_round(
+    graph: DeviceGraph,
+    labels: jax.Array,
+    cluster_weights: jax.Array,
+    max_cluster_weight: jax.Array,
+    active: jax.Array,
+    salt: jax.Array,
+    cfg: LPConfig,
+    communities: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One bulk-synchronous LP round.
+
+    Args:
+      labels:            i32[n_pad] cluster id per node (clusters are node
+                         ids during coarsening, block ids during refinement)
+      cluster_weights:   i32[C] current weight per cluster
+      max_cluster_weight:i32 scalar or i32[C] per-cluster cap
+      active:            bool[n_pad] active set
+      salt:              i32 per-round randomness salt
+
+    Returns (new_labels, new_cluster_weights, new_active, num_moved).
+    """
+    n_pad = graph.n_pad
+    C = cluster_weights.shape[0]
+
+    # -- rate ------------------------------------------------------------
+    neighbor_cluster = labels[graph.dst]
+    seg_g, key_g, w_g = aggregate_by_key(graph.src, neighbor_cluster, graph.edge_w)
+
+    # -- feasibility: stay always allowed; join only under the weight cap
+    key_c = jnp.clip(key_g, 0, C - 1)
+    seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+    cap = jnp.broadcast_to(max_cluster_weight, (C,))
+    fits = (
+        cluster_weights[key_c].astype(ACC_DTYPE)
+        + graph.node_w[seg_c].astype(ACC_DTYPE)
+        <= cap[key_c]
+    )
+    is_current = key_g == labels[seg_c]
+    feasible = (seg_g >= 0) & (is_current | fits)
+    if communities is not None:
+        # v-cycle community restriction: a cluster label is a node id, so
+        # the cluster's community is the label node's community
+        same_comm = communities[key_c] == communities[seg_c]
+        feasible = feasible & (is_current | same_comm)
+
+    best, best_w = argmax_per_segment(
+        seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=feasible
+    )
+    w_cur = connection_to_label(seg_g, key_g, w_g, labels, n_pad)
+
+    # -- select ----------------------------------------------------------
+    gain = best_w - w_cur
+    tie_dir_ok = hash_u32(best, salt ^ 0x5BD1) < hash_u32(labels, salt ^ 0x5BD1)
+    if cfg.refinement:
+        improves = gain > 0
+    else:
+        improves = (gain > 0) | (
+            cfg.allow_tie_moves & (gain == 0) & (best_w > 0) & tie_dir_ok
+        )
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    participate = hash_u32(node_ids, salt ^ 0x27D4) < jnp.int32(
+        cfg.participation * 2147483647.0
+    )
+    wants = (
+        (best >= 0) & (best != labels) & improves & active & (node_ids < graph.n)
+    )
+    target = jnp.where(wants & participate, best, -1)
+
+    # -- commit: never exceed the cap even under simultaneous joins ------
+    headroom = jnp.maximum(cap - cluster_weights.astype(ACC_DTYPE), 0)
+    prio = hash_u32(node_ids, salt ^ 0x165667B1)
+    accept = accept_prefix_by_capacity(target, prio, graph.node_w, headroom)
+
+    # -- apply -----------------------------------------------------------
+    new_labels = jnp.where(accept, target, labels)
+    new_cluster_weights = apply_move_weight_delta(
+        cluster_weights, labels, target, accept, graph.node_w
+    )
+
+    # -- active set refresh (label_propagation.h:507-513): a node is active
+    # next round iff it or one of its neighbors moved this round
+    if cfg.use_active_set:
+        moved_i32 = accept.astype(jnp.int32)
+        neigh_moved = jax.ops.segment_max(
+            moved_i32[graph.dst], graph.src, num_segments=n_pad
+        )
+        # wanting-but-unsampled (or capacity-rejected) nodes stay active;
+        # otherwise the participation mask could deactivate a node that
+        # still has an improving move
+        new_active = ((moved_i32 | neigh_moved) > 0) | (wants & ~accept)
+    else:
+        new_active = jnp.ones_like(active)
+
+    # convergence is judged on *wanting* nodes, not sampled movers: a round
+    # where the participation sample happens to move nobody must not stop
+    # the loop while unsampled nodes still have improving moves
+    num_wanting = jnp.sum(wants.astype(jnp.int32))
+    return new_labels, new_cluster_weights, new_active, num_wanting
+
+
+@partial(jax.jit, static_argnames=("cfg", "num_iterations", "has_communities"))
+def _lp_cluster_impl(
+    graph: DeviceGraph,
+    max_cluster_weight: jax.Array,
+    seed: jax.Array,
+    communities: jax.Array,
+    cfg: LPConfig,
+    num_iterations: int | None,
+    has_communities: bool,
+) -> jax.Array:
+    iters = num_iterations if num_iterations is not None else cfg.num_iterations
+    n_pad = graph.n_pad
+    labels0 = jnp.arange(n_pad, dtype=jnp.int32)
+    weights0 = graph.node_w.astype(jnp.int32)
+    active0 = jnp.ones(n_pad, dtype=bool)
+    comm = communities if has_communities else None
+
+    def cond(state):
+        i, _, _, _, moved = state
+        return (i < iters) & (moved != 0)
+
+    def body(state):
+        i, labels, weights, active, _ = state
+        salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
+        labels, weights, active, moved = lp_round(
+            graph,
+            labels,
+            weights,
+            max_cluster_weight,
+            active,
+            salt,
+            cfg,
+            communities=comm,
+        )
+        return (i + 1, labels, weights, active, moved)
+
+    init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1))
+    _, labels, weights, _, _ = lax.while_loop(cond, body, init)
+
+    if not has_communities:
+        # community-restricted clustering (v-cycles) skips the singleton
+        # post-passes: they could merge across community boundaries
+        if cfg.cluster_isolated:
+            labels, weights = cluster_isolated_nodes(
+                graph, labels, weights, max_cluster_weight
+            )
+        if cfg.two_hop:
+            labels, weights = two_hop_cluster(
+                graph, labels, weights, max_cluster_weight, seed
+            )
+    return labels
+
+
+def lp_cluster(
+    graph: DeviceGraph,
+    max_cluster_weight: jax.Array,
+    seed: jax.Array,
+    cfg: LPConfig = LPConfig(),
+    num_iterations: int | None = None,
+    communities: jax.Array | None = None,
+) -> jax.Array:
+    """Size-constrained LP clustering (analog of LPClustering::compute_
+    clustering, lp_clusterer.cc:90-110): every node starts as a singleton,
+    runs `num_iterations` rounds or until no node moves, then clusters
+    isolated nodes and two-hop-merges leftover singletons.
+
+    `communities` (optional i32[n_pad]) restricts clustering to within
+    communities — nodes only join clusters whose label node shares their
+    community (Clusterer::set_communities analog, used by v-cycles).
+
+    Returns i32[n_pad] cluster labels (values are node ids; pad slots keep
+    their own id)."""
+    has_comm = communities is not None
+    if communities is None:
+        communities = jnp.zeros(graph.n_pad, dtype=jnp.int32)
+    return _lp_cluster_impl(
+        graph,
+        max_cluster_weight,
+        seed,
+        communities,
+        cfg,
+        num_iterations,
+        has_comm,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "k", "num_iterations"))
+def lp_refine(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    seed: jax.Array,
+    cfg: LPConfig = LPConfig(refinement=True),
+    num_iterations: int | None = None,
+) -> jax.Array:
+    """LP refinement (analog of LabelPropagationRefiner,
+    kaminpar-shm/refinement/lp/lp_refiner.cc): the LP kernel with clusters
+    fixed to the k blocks, moves restricted to strictly positive gain under
+    the per-block max weights.  Returns the refined partition."""
+    iters = num_iterations if num_iterations is not None else cfg.num_iterations
+    if not cfg.refinement:
+        cfg = LPConfig(
+            num_iterations=cfg.num_iterations,
+            participation=cfg.participation,
+            allow_tie_moves=False,
+            use_active_set=cfg.use_active_set,
+            refinement=True,
+        )
+    n_pad = graph.n_pad
+    part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
+    bw0 = jax.ops.segment_sum(
+        graph.node_w.astype(ACC_DTYPE), part0, num_segments=k
+    ).astype(jnp.int32)
+    active0 = jnp.ones(n_pad, dtype=bool)
+
+    def cond(state):
+        i, _, _, _, moved = state
+        return (i < iters) & (moved != 0)
+
+    def body(state):
+        i, part, bw, active, _ = state
+        salt = (seed.astype(jnp.int32) * 92821 + i * 1566083941) & 0x7FFFFFFF
+        part, bw, active, moved = lp_round(
+            graph, part, bw, max_block_weights, active, salt, cfg
+        )
+        return (i + 1, part, bw, active, moved)
+
+    init = (jnp.int32(0), part0, bw0, active0, jnp.int32(1))
+    _, part, _, _, _ = lax.while_loop(cond, body, init)
+    return part
+
+
+def cluster_isolated_nodes(
+    graph: DeviceGraph,
+    labels: jax.Array,
+    cluster_weights: jax.Array,
+    max_cluster_weight: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Merge isolated singleton nodes into shared clusters under the weight
+    cap (label_propagation.h:872-917).
+
+    Isolated nodes are ordered by id; node i's tentative bin is
+    floor(prefix_weight / cap); within each bin the capacity-respecting
+    prefix pass rejects overflow (exactness), rejected nodes stay singleton.
+    The first member of each bin is its leader; members adopt the leader's
+    label."""
+    n_pad = graph.n_pad
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    is_real = node_ids < graph.n
+    deg = graph.degrees
+    iso = (deg == 0) & is_real & (labels == node_ids)
+
+    cap = jnp.maximum(jnp.broadcast_to(max_cluster_weight, ()).astype(ACC_DTYPE), 1)
+    w = jnp.where(iso, graph.node_w, 0).astype(ACC_DTYPE)
+    cum_before = jnp.cumsum(w) - w
+    bin_id = jnp.where(iso, (cum_before // cap).astype(jnp.int32), -1)
+
+    # leader of each bin = first isolated node in it
+    first_in_bin = jax.ops.segment_min(
+        jnp.where(iso, node_ids, jnp.iinfo(jnp.int32).max),
+        jnp.clip(bin_id, 0, n_pad - 1),
+        num_segments=n_pad,
+    )
+    leader = jnp.where(iso, first_in_bin[jnp.clip(bin_id, 0, n_pad - 1)], -1)
+    # joiners (non-leaders) move into the leader's cluster, capacity-checked
+    joiner = iso & (leader != node_ids) & (leader >= 0)
+    target = jnp.where(joiner, leader, -1)
+    headroom = jnp.maximum(
+        jnp.broadcast_to(max_cluster_weight, (n_pad,)).astype(ACC_DTYPE)
+        - cluster_weights.astype(ACC_DTYPE),
+        0,
+    )
+    accept = accept_prefix_by_capacity(
+        target, node_ids, graph.node_w, headroom
+    )
+    new_labels = jnp.where(accept, target, labels)
+    return new_labels, apply_move_weight_delta(
+        cluster_weights, labels, target, accept, graph.node_w
+    )
+
+
+def two_hop_cluster(
+    graph: DeviceGraph,
+    labels: jax.Array,
+    cluster_weights: jax.Array,
+    max_cluster_weight: jax.Array,
+    seed: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Two-hop clustering of leftover singletons (label_propagation.h:919-
+    1191): singleton nodes that share the same *favored cluster* (their
+    best-rated cluster, ignoring the weight cap) are merged with each other
+    — they are two hops apart through that cluster.  The smallest singleton
+    id per favored cluster becomes the leader; the rest join it under the
+    weight cap."""
+    n_pad = graph.n_pad
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    is_real = node_ids < graph.n
+    singleton = (
+        (labels == node_ids)
+        & (cluster_weights[jnp.clip(labels, 0, n_pad - 1)] == graph.node_w)
+        & is_real
+        & (graph.degrees > 0)
+    )
+
+    # favored cluster = unconstrained best-rated cluster
+    neighbor_cluster = labels[graph.dst]
+    seg_g, key_g, w_g = aggregate_by_key(graph.src, neighbor_cluster, graph.edge_w)
+    favored, _ = argmax_per_segment(seg_g, key_g, w_g, n_pad, tie_salt=seed)
+
+    fav = jnp.where(singleton & (favored >= 0), favored, -1)
+    fav_c = jnp.clip(fav, 0, n_pad - 1)
+    leader = jax.ops.segment_min(
+        jnp.where(fav >= 0, node_ids, jnp.iinfo(jnp.int32).max),
+        fav_c,
+        num_segments=n_pad,
+    )
+    my_leader = jnp.where(fav >= 0, leader[fav_c], -1)
+    joiner = (fav >= 0) & (my_leader != node_ids) & (my_leader >= 0)
+    target = jnp.where(joiner, my_leader, -1)
+
+    headroom = jnp.maximum(
+        jnp.broadcast_to(max_cluster_weight, (n_pad,)).astype(ACC_DTYPE)
+        - cluster_weights.astype(ACC_DTYPE),
+        0,
+    )
+    accept = accept_prefix_by_capacity(target, node_ids, graph.node_w, headroom)
+    new_labels = jnp.where(accept, target, labels)
+    return new_labels, apply_move_weight_delta(
+        cluster_weights, labels, target, accept, graph.node_w
+    )

@@ -133,6 +133,7 @@ def accept_prefix_by_capacity(
     priority: jax.Array,
     weight: jax.Array,
     capacity: jax.Array,
+    reach: bool = False,
 ) -> jax.Array:
     """Capacity-respecting parallel commit.
 
@@ -140,6 +141,12 @@ def accept_prefix_by_capacity(
     moving).  Entries are ordered by (target, priority) and the maximal
     prefix per target whose cumulative weight fits `capacity[target]` is
     accepted.  Returns a bool mask over entries.
+
+    With `reach=True` the prefix instead *reaches* the capacity: the last
+    accepted entry may cross it (used by the balancer when shedding an
+    overloaded block — the reference moves nodes until the block becomes
+    feasible, overload_balancer.h:25).  The default strict mode never
+    exceeds the capacity.
 
     This replaces the reference's relaxed CAS loop on cluster weights
     (label_propagation.h:818 try_node_move / :2139 move_cluster_weight):
@@ -163,9 +170,54 @@ def accept_prefix_by_capacity(
     cap_here = jnp.where(
         t_s < nbuckets, capacity[jnp.clip(t_s, 0, nbuckets - 1)], 0
     ).astype(ACC_DTYPE)
-    accepted_sorted = (t_s < nbuckets) & (cum_in_seg <= cap_here)
+    if reach:
+        accepted_sorted = (t_s < nbuckets) & (
+            cum_in_seg - w_s.astype(ACC_DTYPE) < cap_here
+        )
+    else:
+        accepted_sorted = (t_s < nbuckets) & (cum_in_seg <= cap_here)
     accept = jnp.zeros(target.shape[0], dtype=bool).at[idx_s].set(accepted_sorted)
     return accept
+
+
+def apply_move_weight_delta(
+    cluster_weights: jax.Array,
+    labels: jax.Array,
+    target: jax.Array,
+    accept: jax.Array,
+    node_w: jax.Array,
+) -> jax.Array:
+    """Update per-cluster weights after a bulk move: subtract movers from
+    their old cluster, add them to the new one.  Shared by LP rounds,
+    isolated-node clustering, and two-hop clustering."""
+    C = cluster_weights.shape[0]
+    moved_w = jnp.where(accept, node_w, 0).astype(ACC_DTYPE)
+    out_w = jax.ops.segment_sum(
+        moved_w, jnp.clip(labels, 0, C - 1), num_segments=C
+    )
+    in_w = jax.ops.segment_sum(
+        moved_w, jnp.clip(target, 0, C - 1), num_segments=C
+    )
+    return (cluster_weights + in_w - out_w).astype(cluster_weights.dtype)
+
+
+def connection_to_label(
+    seg_g: jax.Array,
+    key_g: jax.Array,
+    w_g: jax.Array,
+    labels: jax.Array,
+    n_pad: int,
+) -> jax.Array:
+    """Per-node connection weight to its own current label (0 if none).
+    Shared by LP, the balancers, and Jet."""
+    cur_of_group = labels[jnp.clip(seg_g, 0, n_pad - 1)]
+    match = (seg_g >= 0) & (key_g == cur_of_group)
+    seg_c = jnp.where(match, seg_g, n_pad)
+    w_cur = jax.ops.segment_max(
+        jnp.where(match, w_g, 0), seg_c, num_segments=n_pad + 1
+    )[:n_pad]
+    # segment_max identity is INT32_MIN; empty segments mean no connection
+    return jnp.maximum(w_cur, 0)
 
 
 def compact_unique(labels: jax.Array, n_pad: int) -> Tuple[jax.Array, jax.Array]:

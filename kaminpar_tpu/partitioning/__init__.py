@@ -1,0 +1,3 @@
+from .coarsener import Coarsener  # noqa: F401
+from .refiner import RefinerPipeline  # noqa: F401
+from .rb import recursive_bipartition  # noqa: F401

@@ -1,0 +1,111 @@
+"""Device multilevel coarsener.
+
+Analog of kaminpar-shm/coarsening/abstract_cluster_coarsener.cc (+
+BasicClusterCoarsener): drives lp_cluster -> contract_clustering level by
+level, keeps the hierarchy for projection, applies the max-cluster-weight
+formula (max_cluster_weights.h) and the shrink/convergence checks
+(abstract_cluster_coarsener.cc:98-147).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..graphs.csr import DeviceGraph
+from ..ops.contraction import CoarseGraph, contract_clustering
+from ..ops.lp import LPConfig, lp_cluster
+from ..utils import timer
+
+
+@dataclass
+class CoarseningLevel:
+    fine_graph: DeviceGraph
+    coarse: CoarseGraph
+    fine_n: int
+    coarse_n: int
+    coarse_m: int
+
+
+class Coarsener:
+    """Cluster coarsener with hierarchy (Coarsener interface,
+    kaminpar-shm/coarsening/coarsener.h:20-88)."""
+
+    def __init__(self, ctx: Context, graph: DeviceGraph, n: int):
+        self.ctx = ctx
+        self.levels: List[CoarseningLevel] = []
+        self.current = graph
+        self.current_n = n
+        self.total_node_weight = int(ctx.partition.total_node_weight)
+        lp_ctx = ctx.coarsening.clustering.lp
+        from ..context import IsolatedNodesStrategy, TwoHopStrategy
+
+        self._lp_cfg = LPConfig(
+            num_iterations=lp_ctx.num_iterations,
+            participation=lp_ctx.participation,
+            allow_tie_moves=lp_ctx.allow_tie_moves,
+            use_active_set=lp_ctx.use_active_set,
+            two_hop=lp_ctx.two_hop_strategy != TwoHopStrategy.DISABLE,
+            cluster_isolated=lp_ctx.isolated_nodes_strategy
+            != IsolatedNodesStrategy.KEEP,
+        )
+
+    @property
+    def level(self) -> int:
+        return len(self.levels)
+
+    def empty(self) -> bool:
+        return not self.levels
+
+    def coarsen(self) -> bool:
+        """One coarsening step; returns False when converged (shrink factor
+        below convergence_threshold, abstract_cluster_coarsener.cc:118-142)."""
+        c_ctx = self.ctx.coarsening
+        max_cluster_weight = max(
+            1,
+            c_ctx.max_cluster_weight(
+                self.current_n, self.total_node_weight, self.ctx.partition
+            ),
+        )
+        seed = jnp.int32(
+            (self.ctx.seed * 7919 + self.level * 31337) & 0x7FFFFFFF
+        )
+        with timer.scoped_timer("lp-clustering"):
+            labels = lp_cluster(
+                self.current,
+                jnp.int32(min(max_cluster_weight, 2**31 - 1)),
+                seed,
+                self._lp_cfg,
+            )
+        with timer.scoped_timer("contraction"):
+            coarse, c_n, c_m = contract_clustering(self.current, labels)
+
+        if c_n >= (1.0 - c_ctx.convergence_threshold) * self.current_n:
+            # converged: drop this level (not enough shrinkage)
+            return False
+        self.levels.append(
+            CoarseningLevel(
+                fine_graph=self.current,
+                coarse=coarse,
+                fine_n=self.current_n,
+                coarse_n=c_n,
+                coarse_m=c_m,
+            )
+        )
+        self.current = coarse.graph
+        self.current_n = c_n
+        return True
+
+    def uncoarsen(self, partition: jnp.ndarray) -> Tuple[DeviceGraph, jnp.ndarray]:
+        """Pop one level; project the coarse partition up
+        (abstract_cluster_coarsener.cc:149-171).  Returns (fine graph,
+        fine partition)."""
+        level = self.levels.pop()
+        fine_part = level.coarse.project_up(partition)
+        self.current = level.fine_graph
+        self.current_n = level.fine_n
+        return level.fine_graph, fine_part

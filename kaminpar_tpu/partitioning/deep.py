@@ -1,0 +1,270 @@
+"""Deep multilevel partitioner — the flagship scheme (ESA'21).
+
+Analog of kaminpar-shm/partitioning/deep/deep_multilevel.cc: coarsen on
+device until n <= 2 * contraction_limit (the sequential initial-partitioning
+threshold, deep_multilevel.cc:170-183 — the host pool bipartitioner plays
+the role of the reference's sequential mode), bipartition the coarsest graph
+(initial_partition:185), then uncoarsen while *doubling k*: after each
+projection, if the graph is large enough for more blocks
+(compute_k_for_n, partition_utils.cc:94-101), extend the partition by
+bipartitioning each block's induced subgraph (extend_partition,
+helper.cc:220-349), then refine at the current k.
+
+Block bookkeeping: each current block b spans the final blocks
+[first(b), first(b)+count(b)); extension splits a block into ceil/floor
+halves (split_k = math::split_integral), preserving block order, so when
+current_k reaches the input k the block ids coincide with final ids.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..graphs.csr import (
+    DeviceGraph,
+    device_graph_from_host,
+    host_graph_from_device,
+)
+from ..graphs.host import HostGraph, extract_block_subgraphs
+from ..initial import InitialMultilevelBipartitioner
+from ..utils import rng as rng_mod
+from ..utils import timer
+from ..utils.logger import log_progress
+from .coarsener import Coarsener
+from .refiner import RefinerPipeline
+from .rb import bipartition_max_block_weights, split_k
+
+
+@dataclass
+class _BlockSpan:
+    first: int  # first final block
+    count: int  # number of final blocks
+
+
+def compute_k_for_n(n: int, ctx: Context) -> int:
+    """partition_utils.cc:94-101."""
+    C = ctx.coarsening.contraction_limit
+    if n < 2 * C:
+        return 2
+    k_prime = 1 << max(1, (int(np.ceil(np.log2(max(n / C, 2.0))))))
+    return int(np.clip(k_prime, 2, ctx.partition.k))
+
+
+class DeepMultilevelPartitioner:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self._spans: List[_BlockSpan] = []
+
+    def partition(self, graph: HostGraph) -> np.ndarray:
+        ctx = self.ctx
+        input_k = ctx.partition.k
+        rng = rng_mod.host_rng(ctx.seed ^ 0xDEE9)
+
+        with timer.scoped_timer("device-upload"):
+            dgraph = device_graph_from_host(graph)
+
+        # --- coarsen (deep_multilevel.cc:69-183) ---
+        coarsener = Coarsener(ctx, dgraph, graph.n)
+        threshold = max(2 * ctx.coarsening.contraction_limit, 2)
+        with timer.scoped_timer("coarsening"):
+            while coarsener.current_n > threshold:
+                if not coarsener.coarsen():
+                    break
+                log_progress(
+                    f"deep coarsening level {coarsener.level}: "
+                    f"n={coarsener.current_n}"
+                )
+
+        # --- initial bipartition of the coarsest graph (:185) ---
+        with timer.scoped_timer("initial-partitioning"):
+            coarsest_host = host_graph_from_device(coarsener.current)
+            k0, k1 = split_k(input_k)
+            spans = [_BlockSpan(0, k0), _BlockSpan(k0, k1)] if input_k > 1 else [
+                _BlockSpan(0, 1)
+            ]
+            if input_k == 1:
+                part_host = np.zeros(coarsest_host.n, dtype=np.int32)
+            else:
+                max_w = bipartition_max_block_weights(
+                    ctx, 0, input_k, coarsest_host.total_node_weight
+                )
+                part_host = (
+                    InitialMultilevelBipartitioner(ctx.initial_partitioning)
+                    .bipartition(coarsest_host, max_w, rng)
+                    .astype(np.int32)
+                )
+            current_k = len(spans)
+            self._spans = spans
+            padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
+            padded[: coarsest_host.n] = part_host
+            partition = jnp.asarray(padded)
+
+        # --- uncoarsen: refine / extend / repeat (:275-365) ---
+        num_levels = coarsener.level + 1
+        with timer.scoped_timer("uncoarsening"):
+            level = coarsener.level
+            partition, spans, current_k = self._extend_and_refine(
+                coarsener.current,
+                coarsener.current_n,
+                partition,
+                spans,
+                current_k,
+                rng,
+                level,
+                num_levels,
+            )
+            while not coarsener.empty():
+                fine_graph, partition = coarsener.uncoarsen(partition)
+                level -= 1
+                partition, spans, current_k = self._extend_and_refine(
+                    fine_graph,
+                    coarsener.current_n,
+                    partition,
+                    spans,
+                    current_k,
+                    rng,
+                    level,
+                    num_levels,
+                )
+
+        # final extensions to input_k if not there yet
+        while current_k < input_k:
+            partition, spans, current_k = self._extend_partition(
+                coarsener.current, partition, spans, input_k, rng
+            )
+            partition = self._refine(
+                coarsener.current, partition, current_k, 0, num_levels
+            )
+
+        refiner = RefinerPipeline(self.ctx, current_k)
+        partition = refiner.enforce_balance_host(
+            dgraph, partition, np.asarray(self.ctx.partition.max_block_weights)
+        )
+        return np.asarray(partition)[: graph.n]
+
+    # ------------------------------------------------------------------
+    def _extend_and_refine(
+        self,
+        dgraph: DeviceGraph,
+        n: int,
+        partition,
+        spans: List[_BlockSpan],
+        current_k: int,
+        rng,
+        level: int,
+        num_levels: int,
+    ):
+        ctx = self.ctx
+        partition = self._refine(dgraph, partition, current_k, level, num_levels)
+        desired_k = compute_k_for_n(n, ctx)
+        while current_k < min(desired_k, ctx.partition.k):
+            partition, spans, current_k = self._extend_partition(
+                dgraph, partition, spans, min(2 * current_k, ctx.partition.k), rng
+            )
+            if ctx.partitioning.refine_after_extending_partition:
+                partition = self._refine(
+                    dgraph, partition, current_k, level, num_levels
+                )
+        return partition, spans, current_k
+
+    def _refine(self, dgraph, partition, k, level, num_levels):
+        ctx = self.ctx
+        # block weight caps for the *current* k: each current block's cap is
+        # the sum of its final sub-blocks' caps (helper.cc block splitting)
+        max_bw, min_bw = self._current_block_weights(k)
+        refiner = RefinerPipeline(ctx, k)
+        return refiner.refine(
+            dgraph,
+            partition,
+            max_bw,
+            min_bw,
+            seed=ctx.seed + level,
+            level=level,
+            num_levels=num_levels,
+        )
+
+    def _current_block_weights(self, k: int):
+        ctx = self.ctx
+        spans = self._spans
+        assert len(spans) == k, (len(spans), k)
+        p = ctx.partition
+        caps = np.array(
+            [
+                p.total_max_block_weights(s.first, s.first + s.count)
+                for s in spans
+            ],
+            dtype=np.int64,
+        )
+        max_bw = jnp.asarray(np.minimum(caps, 2**31 - 1), dtype=jnp.int32)
+        min_bw = None
+        if p.min_block_weights is not None:
+            mins = np.array(
+                [
+                    int(p.min_block_weights[s.first : s.first + s.count].sum())
+                    for s in spans
+                ],
+                dtype=np.int64,
+            )
+            min_bw = jnp.asarray(np.minimum(mins, 2**31 - 1), dtype=jnp.int32)
+        return max_bw, min_bw
+
+    def _extend_partition(
+        self, dgraph: DeviceGraph, partition, spans, next_k: int, rng
+    ):
+        """extend_partition (helper.cc:220,349): bipartition each block that
+        still spans more than one final block, until current_k == next_k."""
+        ctx = self.ctx
+        with timer.scoped_timer("extend-partition"):
+            host = host_graph_from_device(dgraph)
+            part = np.asarray(partition)[: host.n].astype(np.int64)
+            current_k = len(spans)
+            ext = extract_block_subgraphs(host, part, current_k)
+
+            new_spans: List[_BlockSpan] = []
+            new_ids_base: List[Tuple[int, int]] = []  # (id0, id1 or -1)
+            bipartitioner = InitialMultilevelBipartitioner(
+                ctx.initial_partitioning
+            )
+            sub_parts = []
+            next_id = 0
+            for b, span in enumerate(spans):
+                # split only while we have not reached next_k blocks overall
+                if span.count > 1:
+                    sub = ext.subgraphs[b]
+                    max_w = bipartition_max_block_weights(
+                        ctx, span.first, span.count, sub.total_node_weight
+                    )
+                    bp = bipartitioner.bipartition(sub, max_w, rng)
+                    k0, k1 = split_k(span.count)
+                    new_ids_base.append((next_id, next_id + 1))
+                    new_spans.append(_BlockSpan(span.first, k0))
+                    new_spans.append(_BlockSpan(span.first + k0, k1))
+                    sub_parts.append(bp)
+                    next_id += 2
+                else:
+                    new_ids_base.append((next_id, -1))
+                    new_spans.append(span)
+                    sub_parts.append(None)
+                    next_id += 1
+
+            new_part = np.zeros(host.n, dtype=np.int32)
+            for b, span in enumerate(spans):
+                mask = part == b
+                id0, id1 = new_ids_base[b]
+                if id1 < 0:
+                    new_part[mask] = id0
+                else:
+                    bp = sub_parts[b]
+                    new_part[mask] = np.where(
+                        bp[ext.node_mapping[mask]] == 0, id0, id1
+                    )
+
+            padded = np.zeros(dgraph.n_pad, dtype=np.int32)
+            padded[: host.n] = new_part
+            self._spans = new_spans
+            return jnp.asarray(padded), new_spans, len(new_spans)

@@ -1,0 +1,104 @@
+"""k-way multilevel partitioner.
+
+Analog of kaminpar-shm/partitioning/kway/kway_multilevel.cc: coarsen on
+device until n <= k * contraction_limit (kway_multilevel.cc:144-146), move
+the coarsest graph to the host for direct k-way initial partitioning via
+recursive bisection, then uncoarsen with device refinement at every level.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..graphs.csr import (
+    DeviceGraph,
+    device_graph_from_host,
+    host_graph_from_device,
+)
+from ..graphs.host import HostGraph
+from ..utils import rng as rng_mod
+from ..utils import timer
+from ..utils.logger import log_progress
+from .coarsener import Coarsener
+from .refiner import RefinerPipeline
+from .rb import recursive_bipartition
+
+
+class KWayMultilevelPartitioner:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def partition(self, graph: HostGraph) -> np.ndarray:
+        ctx = self.ctx
+        k = ctx.partition.k
+        rng = rng_mod.host_rng(ctx.seed)
+
+        with timer.scoped_timer("device-upload"):
+            dgraph = device_graph_from_host(graph)
+
+        max_bw = jnp.asarray(
+            np.minimum(ctx.partition.max_block_weights, 2**31 - 1),
+            dtype=jnp.int32,
+        )
+        min_bw = (
+            jnp.asarray(ctx.partition.min_block_weights, dtype=jnp.int32)
+            if ctx.partition.min_block_weights is not None
+            else None
+        )
+
+        # --- coarsening (kway_multilevel.cc:91-142) ---
+        coarsener = Coarsener(ctx, dgraph, graph.n)
+        threshold = max(k * ctx.coarsening.contraction_limit, 1)
+        with timer.scoped_timer("coarsening"):
+            while coarsener.current_n > threshold:
+                if not coarsener.coarsen():
+                    break
+                log_progress(
+                    f"coarsening level {coarsener.level}: "
+                    f"n={coarsener.current_n}"
+                )
+
+        # --- initial partitioning on host (rb to k) ---
+        with timer.scoped_timer("initial-partitioning"):
+            coarsest_host = host_graph_from_device(coarsener.current)
+            init_part = recursive_bipartition(coarsest_host, k, ctx, rng)
+            part_padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
+            part_padded[: coarsest_host.n] = init_part
+            partition = jnp.asarray(part_padded)
+
+        # --- uncoarsening + refinement (kway_multilevel.cc:70-89) ---
+        refiner = RefinerPipeline(ctx, k)
+        num_levels = coarsener.level + 1
+        with timer.scoped_timer("uncoarsening"):
+            level = coarsener.level
+            partition = refiner.refine(
+                coarsener.current,
+                partition,
+                max_bw,
+                min_bw,
+                seed=ctx.seed,
+                level=level,
+                num_levels=num_levels,
+            )
+            while not coarsener.empty():
+                fine_graph, partition = coarsener.uncoarsen(partition)
+                level -= 1
+                partition = refiner.refine(
+                    fine_graph,
+                    partition,
+                    max_bw,
+                    min_bw,
+                    seed=ctx.seed,
+                    level=level,
+                    num_levels=num_levels,
+                )
+
+        # strict balance backstop on the finest level
+        partition = refiner.enforce_balance_host(
+            dgraph, partition, np.asarray(ctx.partition.max_block_weights)
+        )
+        return np.asarray(partition)[: graph.n]

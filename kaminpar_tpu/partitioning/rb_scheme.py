@@ -1,0 +1,56 @@
+"""Recursive bipartitioning scheme.
+
+Analog of kaminpar-shm/partitioning/rb/rb_multilevel.cc: partition into 2,
+recurse per block.  Each bisection is a full sequential multilevel
+bipartition (partitioning/rb.py); the finest-level partition is then refined
+on device with the context's refiner pipeline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..graphs.csr import device_graph_from_host
+from ..graphs.host import HostGraph
+from ..utils import rng as rng_mod
+from ..utils import timer
+from .refiner import RefinerPipeline
+from .rb import recursive_bipartition
+
+
+class RBMultilevelPartitioner:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def partition(self, graph: HostGraph) -> np.ndarray:
+        ctx = self.ctx
+        k = ctx.partition.k
+        rng = rng_mod.host_rng(ctx.seed ^ 0x5B)
+        with timer.scoped_timer("recursive-bipartitioning"):
+            part = recursive_bipartition(graph, k, ctx, rng)
+
+        if ctx.partitioning.rb_enable_kway_toplevel_refinement:
+            with timer.scoped_timer("toplevel-refinement"):
+                dgraph = device_graph_from_host(graph)
+                padded = np.zeros(dgraph.n_pad, dtype=np.int32)
+                padded[: graph.n] = part
+                max_bw = jnp.asarray(
+                    np.minimum(ctx.partition.max_block_weights, 2**31 - 1),
+                    dtype=jnp.int32,
+                )
+                min_bw = (
+                    jnp.asarray(ctx.partition.min_block_weights, dtype=jnp.int32)
+                    if ctx.partition.min_block_weights is not None
+                    else None
+                )
+                refiner = RefinerPipeline(ctx, k)
+                refined = refiner.refine(
+                    dgraph, jnp.asarray(padded), max_bw, min_bw, seed=ctx.seed
+                )
+                refined = refiner.enforce_balance_host(
+                    dgraph, refined, np.asarray(ctx.partition.max_block_weights)
+                )
+                part = np.asarray(refined)[: graph.n]
+        return part

@@ -1,0 +1,140 @@
+"""Refinement pipeline (analog of kaminpar-shm/refinement/multi_refiner.cc
++ factories.cc:96-145 create_refiner).
+
+Maps the ordered RefinementAlgorithm list from the context onto the device
+kernels: LP refinement (ops/lp.lp_refine), overload/underload balancing
+(ops/balancer), Jet (ops/jet).  The host FM refiner plugs in here as well.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context, RefinementAlgorithm
+from ..graphs.csr import DeviceGraph, host_graph_from_device
+from ..ops import balancer as balancer_ops
+from ..ops import metrics
+from ..ops.lp import LPConfig, lp_refine
+from ..utils import timer
+from ..utils.logger import log_debug, log_warning
+
+
+class RefinerPipeline:
+    """Runs the context's refiner list in order (MultiRefiner analog)."""
+
+    def __init__(self, ctx: Context, k: int):
+        self.ctx = ctx
+        self.k = k
+        self._lp_cfg = LPConfig(
+            num_iterations=ctx.refinement.lp.num_iterations,
+            participation=ctx.refinement.lp.participation,
+            allow_tie_moves=False,
+            use_active_set=True,
+            refinement=True,
+        )
+
+    def refine(
+        self,
+        graph: DeviceGraph,
+        partition: jax.Array,
+        max_block_weights: jax.Array,
+        min_block_weights: Optional[jax.Array],
+        seed: int,
+        level: int = 0,
+        num_levels: int = 1,
+    ) -> jax.Array:
+        k = self.k
+        for i, algorithm in enumerate(self.ctx.refinement.algorithms):
+            salt = jnp.int32((seed * 2654435761 + i * 40503 + level) & 0x7FFFFFFF)
+            if algorithm == RefinementAlgorithm.NOOP:
+                continue
+            elif algorithm == RefinementAlgorithm.LABEL_PROPAGATION:
+                with timer.scoped_timer("lp-refinement"):
+                    partition = lp_refine(
+                        graph, partition, k, max_block_weights, salt, self._lp_cfg
+                    )
+            elif algorithm == RefinementAlgorithm.OVERLOAD_BALANCER:
+                with timer.scoped_timer("overload-balancer"):
+                    partition = balancer_ops.overload_balance(
+                        graph,
+                        partition,
+                        k,
+                        max_block_weights,
+                        salt,
+                        max_rounds=self.ctx.refinement.balancer.max_rounds,
+                    )
+            elif algorithm == RefinementAlgorithm.UNDERLOAD_BALANCER:
+                if min_block_weights is None:
+                    continue
+                with timer.scoped_timer("underload-balancer"):
+                    partition = balancer_ops.underload_balance(
+                        graph,
+                        partition,
+                        k,
+                        max_block_weights,
+                        min_block_weights,
+                        salt,
+                        max_rounds=self.ctx.refinement.balancer.max_rounds,
+                    )
+            elif algorithm == RefinementAlgorithm.JET:
+                from ..ops.jet import jet_refine
+
+                with timer.scoped_timer("jet"):
+                    partition = jet_refine(
+                        graph,
+                        partition,
+                        k,
+                        max_block_weights,
+                        salt,
+                        self.ctx.refinement.jet,
+                        level=level,
+                        num_levels=num_levels,
+                    )
+            elif algorithm == RefinementAlgorithm.GREEDY_FM:
+                from ..refinement.fm import fm_refine_host
+
+                with timer.scoped_timer("kway-fm"):
+                    partition = fm_refine_host(
+                        graph,
+                        partition,
+                        k,
+                        max_block_weights,
+                        self.ctx.refinement.fm,
+                        seed=seed + i,
+                    )
+            else:
+                log_warning(f"unknown refinement algorithm: {algorithm}")
+        return partition
+
+    def enforce_balance_host(
+        self,
+        graph: DeviceGraph,
+        partition: jax.Array,
+        max_block_weights: np.ndarray,
+    ) -> jax.Array:
+        """Exact host fallback for the strict balance guarantee
+        (README.MD:18) when device balancing rounds stall."""
+        over = int(
+            metrics.total_overload(
+                graph, partition, jnp.asarray(max_block_weights)
+            )
+        )
+        if over == 0:
+            return partition
+        log_debug(f"host balance fallback, residual overload {over}")
+        host = host_graph_from_device(graph)
+        n = host.n
+        part_h = np.asarray(partition)[:n].copy()
+        balanced = balancer_ops.host_balance(
+            host.node_weight_array(),
+            (host.xadj, host.adjncy, host.edge_weight_array()),
+            part_h,
+            np.asarray(max_block_weights),
+        )
+        full = np.zeros(graph.n_pad, dtype=np.int32)
+        full[:n] = balanced
+        return jnp.asarray(full)

@@ -1,0 +1,140 @@
+"""V-cycle partitioner.
+
+Analog of kaminpar-shm/partitioning/deep/vcycle_deep_multilevel.cc:
+iterated deep multilevel with community restriction — run deep multilevel
+once, then for each configured v-cycle re-coarsen the graph with clustering
+restricted to the current blocks (communities), project the partition down,
+and refine back up.  Each cycle can only improve the cut because the
+community restriction keeps the projected partition valid at every level.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..context import Context
+from ..graphs.csr import device_graph_from_host
+from ..graphs.host import HostGraph
+from ..ops.contraction import contract_clustering
+from ..ops.lp import LPConfig, lp_cluster
+from ..utils import timer
+from ..utils.logger import log_progress
+from .deep import DeepMultilevelPartitioner
+from .refiner import RefinerPipeline
+
+
+class VcycleDeepMultilevelPartitioner:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def partition(self, graph: HostGraph) -> np.ndarray:
+        ctx = self.ctx
+        k = ctx.partition.k
+
+        # initial partition via one full deep multilevel run
+        deep_ctx = ctx.copy()
+        from ..context import PartitioningMode
+
+        deep_ctx.partitioning.mode = PartitioningMode.DEEP
+        deep_ctx.partition = ctx.partition  # share the configured weights
+        part = DeepMultilevelPartitioner(deep_ctx).partition(graph)
+
+        num_cycles = max(len(ctx.partitioning.vcycles), 1)
+        for cycle in range(num_cycles):
+            with timer.scoped_timer(f"vcycle-{cycle}"):
+                part = self._one_vcycle(graph, part, cycle)
+        return part
+
+    def _one_vcycle(
+        self, graph: HostGraph, part: np.ndarray, cycle: int
+    ) -> np.ndarray:
+        """Community-restricted coarsen -> project down -> refine up."""
+        ctx = self.ctx
+        k = ctx.partition.k
+        dgraph = device_graph_from_host(graph)
+        padded = np.zeros(dgraph.n_pad, dtype=np.int32)
+        padded[: graph.n] = part
+        partition = jnp.asarray(padded)
+
+        max_bw = jnp.asarray(
+            np.minimum(ctx.partition.max_block_weights, 2**31 - 1),
+            dtype=jnp.int32,
+        )
+        min_bw = (
+            jnp.asarray(ctx.partition.min_block_weights, dtype=jnp.int32)
+            if ctx.partition.min_block_weights is not None
+            else None
+        )
+        lp_cfg = LPConfig(
+            num_iterations=ctx.coarsening.clustering.lp.num_iterations,
+            participation=ctx.coarsening.clustering.lp.participation,
+        )
+
+        # coarsen with community restriction
+        levels = []
+        current = dgraph
+        current_part = partition
+        current_n = graph.n
+        threshold = max(2 * ctx.coarsening.contraction_limit, 2)
+        level = 0
+        while current_n > threshold:
+            max_cw = max(
+                1,
+                ctx.coarsening.max_cluster_weight(
+                    current_n, ctx.partition.total_node_weight, ctx.partition
+                ),
+            )
+            seed = jnp.int32(
+                (ctx.seed * 65713 + cycle * 977 + level * 31337) & 0x7FFFFFFF
+            )
+            labels = lp_cluster(
+                current,
+                jnp.int32(min(max_cw, 2**31 - 1)),
+                seed,
+                lp_cfg,
+                communities=current_part,
+            )
+            coarse, c_n, c_m = contract_clustering(current, labels)
+            if c_n >= (1.0 - ctx.coarsening.convergence_threshold) * current_n:
+                break
+            # project the partition down: clusters never span blocks
+            coarse_part = coarse.project_down(current_part)
+            levels.append((current, coarse, current_part))
+            current = coarse.graph
+            current_part = coarse_part
+            current_n = c_n
+            level += 1
+            log_progress(f"vcycle coarsening level {level}: n={c_n}")
+
+        # refine back up
+        refiner = RefinerPipeline(ctx, k)
+        num_levels = len(levels) + 1
+        current_part = refiner.refine(
+            current,
+            current_part,
+            max_bw,
+            min_bw,
+            seed=ctx.seed + cycle,
+            level=len(levels),
+            num_levels=num_levels,
+        )
+        for lvl in range(len(levels) - 1, -1, -1):
+            fine_graph, coarse, _ = levels[lvl]
+            current_part = coarse.project_up(current_part)
+            current_part = refiner.refine(
+                fine_graph,
+                current_part,
+                max_bw,
+                min_bw,
+                seed=ctx.seed + cycle,
+                level=lvl,
+                num_levels=num_levels,
+            )
+
+        current_part = refiner.enforce_balance_host(
+            dgraph, current_part, np.asarray(ctx.partition.max_block_weights)
+        )
+        return np.asarray(current_part)[: graph.n]

@@ -1,0 +1,174 @@
+"""Named presets (analog of kaminpar-shm/presets.cc:18-100).
+
+Each preset builds a fully-populated Context; values mirror the reference's
+defaults (presets.cc:102-301) where the corresponding knob exists in the TPU
+design.  Reference-only knobs that have no TPU analog (e.g. per-thread
+rating-map implementation choices) are intentionally absent — the TPU
+equivalents are the bulk-sync LP knobs on LabelPropagationContext.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+from .context import (
+    ClusterWeightLimit,
+    Context,
+    PartitioningMode,
+    RefinementAlgorithm,
+    TwoHopStrategy,
+)
+
+
+def create_default_context() -> Context:
+    """presets.cc:102-301 (deep multilevel, LP coarsening, balancer+LP
+    refinement)."""
+    return Context(preset_name="default")
+
+
+def create_fast_context() -> Context:
+    """presets.cc:301-309: single LP iteration, single IP repetition."""
+    ctx = create_default_context()
+    ctx.preset_name = "fast"
+    ctx.coarsening.clustering.lp.num_iterations = 1
+    ctx.initial_partitioning.pool.min_num_repetitions = 1
+    ctx.initial_partitioning.pool.min_num_non_adaptive_repetitions = 1
+    ctx.initial_partitioning.pool.max_num_repetitions = 1
+    return ctx
+
+
+def create_strong_context() -> Context:
+    """presets.cc:311-324: adds k-way FM between LP and final balancing."""
+    ctx = create_default_context()
+    ctx.preset_name = "strong"
+    ctx.refinement.algorithms = [
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+        RefinementAlgorithm.LABEL_PROPAGATION,
+        RefinementAlgorithm.GREEDY_FM,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+    ]
+    return ctx
+
+
+def create_largek_context() -> Context:
+    """presets.cc:326-334: fewer IP repetitions for huge k."""
+    ctx = create_default_context()
+    ctx.preset_name = "largek"
+    ctx.initial_partitioning.pool.min_num_repetitions = 4
+    ctx.initial_partitioning.pool.min_num_non_adaptive_repetitions = 2
+    ctx.initial_partitioning.pool.max_num_repetitions = 4
+    return ctx
+
+
+def create_largek_fast_context() -> Context:
+    ctx = create_largek_context()
+    ctx.preset_name = "largek-fast"
+    pool = ctx.initial_partitioning.pool
+    pool.min_num_repetitions = 2
+    pool.min_num_non_adaptive_repetitions = 1
+    pool.max_num_repetitions = 2
+    pool.enable_ggg_bipartitioner = False
+    pool.refinement.disabled = True
+    pool.refinement.num_iterations = 1
+    return ctx
+
+
+def create_largek_strong_context() -> Context:
+    ctx = create_largek_context()
+    ctx.preset_name = "largek-strong"
+    ctx.refinement.algorithms = [
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+        RefinementAlgorithm.LABEL_PROPAGATION,
+        RefinementAlgorithm.GREEDY_FM,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+    ]
+    return ctx
+
+
+def create_jet_context(rounds: int = 1) -> Context:
+    """presets.cc:372-391: Jet instead of LP refinement — the preset most
+    aligned with the TPU execution model."""
+    ctx = create_default_context()
+    ctx.preset_name = "jet" if rounds == 1 else f"{rounds}xjet"
+    ctx.refinement.algorithms = [
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+        RefinementAlgorithm.JET,
+    ]
+    if rounds > 1:
+        jet = ctx.refinement.jet
+        jet.num_rounds_on_coarse_level = rounds
+        jet.num_rounds_on_fine_level = rounds
+        jet.initial_gain_temp_on_coarse_level = 0.75
+        jet.initial_gain_temp_on_fine_level = 0.75
+        jet.final_gain_temp_on_coarse_level = 0.25
+        jet.final_gain_temp_on_fine_level = 0.25
+    return ctx
+
+
+def create_noref_context() -> Context:
+    ctx = create_default_context()
+    ctx.preset_name = "noref"
+    ctx.refinement.algorithms = []
+    return ctx
+
+
+def create_vcycle_context(restrict_refinement: bool = False) -> Context:
+    """presets.cc:422-436."""
+    ctx = create_default_context()
+    ctx.preset_name = "restricted-vcycle" if restrict_refinement else "vcycle"
+    ctx.partitioning.mode = PartitioningMode.VCYCLE
+    if restrict_refinement:
+        ctx.partitioning.restrict_vcycle_refinement = True
+        ctx.refinement.algorithms = [RefinementAlgorithm.LABEL_PROPAGATION]
+    return ctx
+
+
+def create_mtkahypar_kway_context() -> Context:
+    """presets.cc:488-499: Mt-KaHyPar-style coarsening + direct k-way."""
+    ctx = create_default_context()
+    ctx.preset_name = "mtkahypar-kway"
+    cl = ctx.coarsening.clustering
+    cl.lp.num_iterations = 1
+    cl.cluster_weight_limit = ClusterWeightLimit.BLOCK_WEIGHT
+    cl.cluster_weight_multiplier = 1.0 / 160.0
+    cl.shrink_factor = 2.5
+    cl.lp.two_hop_strategy = TwoHopStrategy.CLUSTER
+    ctx.coarsening.contraction_limit = 160
+    ctx.partitioning.mode = PartitioningMode.KWAY
+    return ctx
+
+
+_PRESETS = {
+    "default": create_default_context,
+    "fast": create_fast_context,
+    "strong": create_strong_context,
+    "fm": create_strong_context,
+    "largek": create_largek_context,
+    "largek-fast": create_largek_fast_context,
+    "largek-strong": create_largek_strong_context,
+    "jet": create_jet_context,
+    "4xjet": lambda: create_jet_context(4),
+    "noref": create_noref_context,
+    "vcycle": lambda: create_vcycle_context(False),
+    "restricted-vcycle": lambda: create_vcycle_context(True),
+    "mtkahypar-kway": create_mtkahypar_kway_context,
+}
+
+
+def create_context_by_preset_name(name: str) -> Context:
+    """presets.cc:18-73."""
+    if name not in _PRESETS:
+        raise ValueError(
+            f"invalid preset name: {name!r} (available: {sorted(_PRESETS)})"
+        )
+    return _PRESETS[name]()
+
+
+def get_preset_names() -> Set[str]:
+    """presets.cc:76-99."""
+    return set(_PRESETS)

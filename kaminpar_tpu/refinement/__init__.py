@@ -1,0 +1,1 @@
+from .fm import fm_refine_host  # noqa: F401

@@ -1,0 +1,93 @@
+"""Cluster contraction tests (analog of tests/shm/coarsening/
+cluster_contraction_test.cc: contract known toy clusterings, check the
+coarse CSR)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.graphs import (
+    device_graph_from_host,
+    factories,
+    host_graph_from_device,
+)
+from kaminpar_tpu.ops.contraction import contract_clustering
+
+
+def _contract(graph, labels_small):
+    dg = device_graph_from_host(graph)
+    labels = np.arange(dg.n_pad, dtype=np.int32)
+    labels[: len(labels_small)] = labels_small
+    cg, cn, cm = contract_clustering(dg, jnp.asarray(labels))
+    return dg, cg, cn, cm
+
+
+def test_contract_path_pairs():
+    # path 0-1-2-3, clusters {0,1} {2,3} -> coarse path of 2 nodes, 1 edge
+    g = factories.make_path(4)
+    _, cg, cn, cm = _contract(g, [0, 0, 2, 2])
+    assert cn == 2 and cm == 2  # one undirected edge, both directions
+    h = host_graph_from_device(cg.graph)
+    assert list(h.node_weight_array()) == [2, 2]
+    assert h.total_edge_weight == 2
+
+
+def test_contract_aggregates_parallel_edges():
+    # square 0-1-2-3-0; clusters {0,1}, {2,3}: edges (1,2) and (3,0) merge
+    g = factories.make_cycle(4)
+    _, cg, cn, cm = _contract(g, [0, 0, 2, 2])
+    h = host_graph_from_device(cg.graph)
+    assert cn == 2 and cm == 2
+    assert list(h.edge_weight_array()) == [2, 2]
+
+
+def test_contract_all_to_one():
+    g = factories.make_complete_graph(5)
+    _, cg, cn, cm = _contract(g, [0] * 5)
+    assert cn == 1 and cm == 0
+    h = host_graph_from_device(cg.graph)
+    assert list(h.node_weight_array()) == [5]
+
+
+def test_contract_identity():
+    g = factories.make_grid_graph(3, 3)
+    _, cg, cn, cm = _contract(g, list(range(9)))
+    assert cn == 9 and cm == g.m
+    h = host_graph_from_device(cg.graph)
+    assert np.array_equal(h.xadj, g.xadj)
+    assert np.array_equal(h.adjncy, g.adjncy)
+
+
+def test_projection_round_trip():
+    g = factories.make_grid_graph(4, 4)
+    dg, cg, cn, cm = _contract(
+        g, np.repeat(np.arange(4), 4).astype(np.int32) * 4
+    )
+    coarse_part = jnp.asarray(
+        np.arange(cg.graph.n_pad, dtype=np.int32) % max(cn, 1)
+    )
+    fine_part = cg.project_up(coarse_part)
+    # all nodes in the same cluster share the fine partition value
+    fp = np.asarray(fine_part)[:16]
+    labels = np.repeat(np.arange(4), 4) * 4
+    for c in np.unique(labels):
+        assert len(set(fp[labels == c])) == 1
+    # project_down inverts project_up
+    down = np.asarray(cg.project_down(fine_part))[:cn]
+    up_again = np.asarray(cg.project_up(jnp.asarray(np.concatenate([
+        down, np.zeros(cg.graph.n_pad - cn, dtype=down.dtype)]))))[:16]
+    assert np.array_equal(fp, up_again)
+
+
+def test_edge_weight_conservation():
+    g = factories.make_rgg2d(300, seed=2)
+    dg = device_graph_from_host(g)
+    import kaminpar_tpu.ops.lp as lp
+
+    labels = lp.lp_cluster(dg, jnp.int32(15), jnp.int32(3))
+    cg, cn, cm = contract_clustering(dg, labels)
+    l = np.asarray(labels)[: g.n]
+    src = g.edge_sources()
+    inter = int((l[src] != l[g.adjncy]).sum())
+    h = host_graph_from_device(cg.graph)
+    assert h.total_edge_weight == inter
+    assert int(h.node_weight_array().sum()) == g.n

@@ -1,0 +1,101 @@
+"""End-to-end partition tests.
+
+Analog of tests/endtoend/shm_endtoend_test.cc:28-80: partitions empty,
+unweighted, and weighted graphs plus the checked-in real graph; asserts cut
+quality, feasibility, and rerun determinism.
+"""
+
+import numpy as np
+import pytest
+
+import kaminpar_tpu as ktp
+from kaminpar_tpu.context import PartitioningMode
+from kaminpar_tpu.graphs import factories
+
+
+def _cut(g, part):
+    src = g.edge_sources()
+    ew = g.edge_weight_array()
+    return int(ew[part[src] != part[g.adjncy]].sum()) // 2
+
+
+def _check(g, part, ctx, k):
+    assert len(part) == g.n
+    assert part.min() >= 0 and part.max() < k
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, part, g.node_weight_array())
+    assert (bw <= ctx.partition.max_block_weights).all(), (
+        bw,
+        ctx.partition.max_block_weights,
+    )
+
+
+@pytest.mark.parametrize("mode", [PartitioningMode.DEEP, PartitioningMode.KWAY])
+def test_rgg2d_partition(rgg2d, mode):
+    ctx = ktp.context_from_preset("default")
+    ctx.partitioning.mode = mode
+    p = ktp.KaMinPar(ctx).set_graph(rgg2d)
+    part = p.compute_partition(k=4, epsilon=0.03, seed=1)
+    _check(rgg2d, part, ctx, 4)
+    # sane quality: random 4-way cut on rgg2d is ~6100; multilevel < 150
+    assert _cut(rgg2d, part) < 200
+
+
+def test_determinism(rgg2d):
+    ctx = ktp.context_from_preset("default")
+    parts = [
+        ktp.KaMinPar(ctx).set_graph(rgg2d).compute_partition(k=4, seed=7)
+        for _ in range(2)
+    ]
+    assert np.array_equal(parts[0], parts[1])
+
+
+def test_weighted_graph():
+    g = factories.make_grid_graph(12, 12)
+    rng = np.random.default_rng(5)
+    g.node_weights = rng.integers(1, 5, g.n).astype(np.int64)
+    g.edge_weights = None
+    ctx = ktp.context_from_preset("default")
+    p = ktp.KaMinPar(ctx).set_graph(g)
+    part = p.compute_partition(k=3, epsilon=0.05, seed=2)
+    _check(g, part, ctx, 3)
+
+
+def test_graph_with_isolated_nodes():
+    # grid + isolated tail
+    g = factories.make_grid_graph(6, 6)
+    n = g.n + 4
+    xadj = np.concatenate([g.xadj, np.full(4, g.m)])
+    g2 = ktp.HostGraph(xadj, g.adjncy)
+    ctx = ktp.context_from_preset("default")
+    part = ktp.KaMinPar(ctx).set_graph(g2).compute_partition(k=2, seed=1)
+    _check(g2, part, ctx, 2)
+
+
+def test_only_isolated_nodes():
+    g = factories.make_empty_graph(10)
+    ctx = ktp.context_from_preset("default")
+    part = ktp.KaMinPar(ctx).set_graph(g).compute_partition(k=3, seed=1)
+    _check(g, part, ctx, 3)
+
+
+def test_k1():
+    g = factories.make_grid_graph(4, 4)
+    ctx = ktp.context_from_preset("default")
+    part = ktp.KaMinPar(ctx).set_graph(g).compute_partition(k=1, seed=1)
+    assert (part == 0).all()
+
+
+def test_nonpow2_k(rgg2d):
+    ctx = ktp.context_from_preset("default")
+    part = ktp.KaMinPar(ctx).set_graph(rgg2d).compute_partition(k=6, seed=4)
+    _check(rgg2d, part, ctx, 6)
+    assert len(np.unique(part)) == 6
+
+
+def test_infeasible_raises():
+    g = factories.make_grid_graph(4, 4)
+    ctx = ktp.context_from_preset("default")
+    p = ktp.KaMinPar(ctx).set_graph(g)
+    with pytest.raises(ValueError):
+        p.compute_partition(k=2, max_block_weights=np.array([4, 4]))

@@ -1,0 +1,69 @@
+"""Initial bipartitioning tests (analog of the reference's initial
+partitioning coverage inside e2e tests)."""
+
+import numpy as np
+
+from kaminpar_tpu.context import InitialPartitioningContext, InitialRefinementContext
+from kaminpar_tpu.graphs import factories
+from kaminpar_tpu.initial import bipartition, fm_bipartition_refine
+from kaminpar_tpu.initial.bipartitioner import _host_block_weights, _host_cut
+from kaminpar_tpu.initial.flat import (
+    bfs_bipartition,
+    ggg_bipartition,
+    random_bipartition,
+)
+
+
+def test_flat_bipartitioners_produce_valid_partitions():
+    g = factories.make_grid_graph(10, 10)
+    mw = np.array([55, 55])
+    rng = np.random.default_rng(0)
+    for fn in (random_bipartition, bfs_bipartition, ggg_bipartition):
+        part = fn(g, mw, rng)
+        assert set(np.unique(part)) <= {0, 1}
+        bw = _host_block_weights(g, part)
+        assert bw.sum() == 100
+
+
+def test_fm_refine_reduces_cut():
+    g = factories.make_grid_graph(8, 8)
+    rng = np.random.default_rng(1)
+    part = rng.integers(0, 2, 64).astype(np.int8)
+    before = _host_cut(g, part)
+    imp = fm_bipartition_refine(
+        g, part, np.array([40, 40]), InitialRefinementContext(), rng
+    )
+    after = _host_cut(g, part)
+    assert imp >= 0 and after <= before
+    assert (_host_block_weights(g, part) <= 40).all()
+
+
+def test_multilevel_bipartition_quality_path():
+    g = factories.make_path(200)
+    part = bipartition(
+        g, np.array([103, 103]), InitialPartitioningContext(),
+        np.random.default_rng(0),
+    )
+    assert _host_cut(g, part) <= 3  # optimum is 1
+
+
+def test_multilevel_bipartition_grid():
+    g = factories.make_grid_graph(16, 16)
+    part = bipartition(
+        g, np.array([135, 135]), InitialPartitioningContext(),
+        np.random.default_rng(0),
+    )
+    cut = _host_cut(g, part)
+    bw = _host_block_weights(g, part)
+    assert (bw <= 135).all()
+    assert cut <= 32  # optimum 16
+
+def test_weighted_bipartition():
+    g = factories.make_path(20)
+    g.node_weights = np.ones(20, dtype=np.int64)
+    g.node_weights[0] = 10
+    part = bipartition(
+        g, np.array([16, 16]), InitialPartitioningContext(),
+        np.random.default_rng(3),
+    )
+    assert (_host_block_weights(g, part) <= 16).all()

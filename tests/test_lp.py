@@ -1,0 +1,99 @@
+"""LP clustering kernel tests (analog of the reference's lp_clusterer
+coverage via cluster_contraction_test + e2e)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs import device_graph_from_host, factories, from_edge_list
+from kaminpar_tpu.ops.lp import LPConfig, lp_cluster, lp_refine
+
+
+def _labels(graph, cap, seed=42, **kw):
+    dg = device_graph_from_host(graph)
+    return dg, np.asarray(lp_cluster(dg, jnp.int32(cap), jnp.int32(seed), **kw))
+
+
+def test_disjoint_triangles_merge():
+    g = from_edge_list(6, np.array([[0, 1], [1, 2], [0, 2], [3, 4], [4, 5], [3, 5]]))
+    _, l = _labels(g, 100)
+    l = l[:6]
+    assert len(set(l[:3])) == 1
+    assert len(set(l[3:6])) == 1
+    assert l[0] != l[3]
+
+
+def test_weight_cap_respected():
+    g = factories.make_path(16)
+    _, l = _labels(g, 3)
+    sizes = np.bincount(l[:16])
+    assert sizes.max() <= 3
+
+
+def test_weighted_nodes_cap():
+    g = factories.make_path(6)
+    g.node_weights = np.array([5, 1, 1, 1, 1, 5], dtype=np.int64)
+    dg = device_graph_from_host(g)
+    l = np.asarray(lp_cluster(dg, jnp.int32(6), jnp.int32(1)))[:6]
+    w = np.zeros(6, dtype=np.int64)
+    np.add.at(w, l, np.asarray(g.node_weights))
+    assert w.max() <= 6
+
+
+def test_isolated_nodes_clustered():
+    g = factories.make_empty_graph(12)
+    _, l = _labels(g, 4)
+    sizes = np.bincount(l[:12], minlength=12)
+    assert sizes.max() <= 4
+    assert (sizes > 0).sum() == 3  # 12 unit nodes / cap 4
+
+
+def test_star_cap():
+    g = factories.make_star(9)
+    _, l = _labels(g, 3)
+    sizes = np.bincount(l[:10], minlength=10)
+    assert sizes.max() <= 3
+
+
+def test_determinism():
+    g = factories.make_rgg2d(400, seed=7)
+    _, l1 = _labels(g, 20, seed=5)
+    _, l2 = _labels(g, 20, seed=5)
+    assert np.array_equal(l1, l2)
+
+
+def test_community_restriction():
+    # two triangles bridged by an edge; communities forbid merging across
+    g = from_edge_list(
+        6, np.array([[0, 1], [1, 2], [0, 2], [3, 4], [4, 5], [3, 5], [2, 3]])
+    )
+    dg = device_graph_from_host(g)
+    comm = np.zeros(dg.n_pad, dtype=np.int32)
+    comm[3:6] = 1
+    l = np.asarray(
+        lp_cluster(dg, jnp.int32(100), jnp.int32(1), communities=jnp.asarray(comm))
+    )[:6]
+    # no cluster spans both communities
+    for c in set(l):
+        members = np.flatnonzero(l == c)
+        assert len(set(comm[members])) == 1
+
+
+def test_lp_refine_improves_cut():
+    from kaminpar_tpu.ops import metrics
+
+    g = factories.make_grid_graph(8, 8)
+    dg = device_graph_from_host(g)
+    rng = np.random.default_rng(0)
+    part = np.zeros(dg.n_pad, dtype=np.int32)
+    part[:64] = rng.integers(0, 2, 64)
+    part_j = jnp.asarray(part)
+    cut_before = int(metrics.edge_cut(dg, part_j))
+    refined = lp_refine(
+        dg, part_j, 2, jnp.array([40, 40], dtype=jnp.int32), jnp.int32(3)
+    )
+    cut_after = int(metrics.edge_cut(dg, refined))
+    assert cut_after < cut_before
+    bw = np.bincount(np.asarray(refined)[:64], minlength=2,
+                     weights=np.ones(64)).astype(int)
+    assert bw.max() <= 40

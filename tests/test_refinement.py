@@ -1,0 +1,95 @@
+"""Balancer / Jet / FM refinement tests (analog of the reference's
+refinement unit coverage, e.g. gain_cache_test.cc validating gains against
+recomputation)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.context import FMRefinementContext, JetRefinementContext
+from kaminpar_tpu.graphs import device_graph_from_host, factories
+from kaminpar_tpu.ops import metrics
+from kaminpar_tpu.ops.balancer import overload_balance, underload_balance
+from kaminpar_tpu.ops.jet import jet_refine
+from kaminpar_tpu.refinement.fm import fm_refine_host
+
+
+def _pad_part(dg, values):
+    p = np.zeros(dg.n_pad, dtype=np.int32)
+    p[: len(values)] = values
+    return jnp.asarray(p)
+
+
+def test_overload_balancer_restores_feasibility():
+    g = factories.make_grid_graph(8, 8)
+    dg = device_graph_from_host(g)
+    # all 64 nodes in block 0 of 4
+    part = _pad_part(dg, np.zeros(64, dtype=np.int32))
+    caps = jnp.array([17, 17, 17, 17], dtype=jnp.int32)
+    balanced = overload_balance(dg, part, 4, caps, jnp.int32(1))
+    bw = np.asarray(metrics.block_weights(dg, balanced, 4))
+    assert (bw <= 17).all(), bw
+
+
+def test_overload_balancer_never_overloads_feasible_block():
+    # regression: k=3, block 0 heavily overloaded, block 1 has small
+    # headroom — incoming movers must not push block 1 over its cap
+    g = factories.make_path(12)
+    g.node_weights = np.full(12, 10, dtype=np.int64)
+    dg = device_graph_from_host(g)
+    part = _pad_part(dg, np.array([0] * 8 + [1, 1, 2, 2], dtype=np.int32))
+    caps = jnp.array([55, 25, 1000], dtype=jnp.int32)
+    from kaminpar_tpu.ops.balancer import overload_balance_round
+
+    out, _ = overload_balance_round(dg, part, 3, caps, jnp.int32(7))
+    bw = np.asarray(metrics.block_weights(dg, out, 3))
+    assert bw[1] <= 25, bw  # previously-feasible block must stay feasible
+
+
+def test_overload_balancer_noop_when_feasible():
+    g = factories.make_grid_graph(4, 4)
+    dg = device_graph_from_host(g)
+    part = _pad_part(dg, np.arange(16) // 4)
+    caps = jnp.array([5, 5, 5, 5], dtype=jnp.int32)
+    out = overload_balance(dg, part, 4, caps, jnp.int32(1))
+    assert np.array_equal(np.asarray(out)[:16], np.asarray(part)[:16])
+
+
+def test_underload_balancer_fills_min_weights():
+    g = factories.make_grid_graph(8, 8)
+    dg = device_graph_from_host(g)
+    part = _pad_part(dg, np.zeros(64, dtype=np.int32))  # block 1 empty
+    caps = jnp.array([64, 64], dtype=jnp.int32)
+    mins = jnp.array([10, 10], dtype=jnp.int32)
+    out = underload_balance(dg, part, 2, caps, mins, jnp.int32(1))
+    bw = np.asarray(metrics.block_weights(dg, out, 2))
+    assert (bw >= 10).all(), bw
+
+
+def test_jet_improves_random_partition():
+    g = factories.make_grid_graph(10, 10)
+    dg = device_graph_from_host(g)
+    rng = np.random.default_rng(1)
+    part = _pad_part(dg, rng.integers(0, 4, 100))
+    caps = jnp.array([30, 30, 30, 30], dtype=jnp.int32)
+    before = int(metrics.edge_cut(dg, part))
+    out = jet_refine(
+        dg, part, 4, caps, jnp.int32(1), JetRefinementContext(), level=0
+    )
+    after = int(metrics.edge_cut(dg, out))
+    assert after < before
+    bw = np.asarray(metrics.block_weights(dg, out, 4))
+    assert (bw <= 30).all()
+
+
+def test_fm_host_improves_partition():
+    g = factories.make_grid_graph(8, 8)
+    dg = device_graph_from_host(g)
+    rng = np.random.default_rng(2)
+    part = _pad_part(dg, rng.integers(0, 2, 64))
+    caps = np.array([40, 40])
+    before = int(metrics.edge_cut(dg, part))
+    out = fm_refine_host(dg, part, 2, caps, FMRefinementContext(), seed=1)
+    after = int(metrics.edge_cut(dg, out))
+    assert after < before
+    bw = np.asarray(metrics.block_weights(dg, out, 2))
+    assert (bw <= 40).all()
