@@ -8,12 +8,22 @@ multi-chip sharding paths compile and execute without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize registers the axon (remote-TPU tunnel) PJRT
+# plugin at interpreter start and overrides jax_platforms to "axon,cpu";
+# the env var alone cannot opt out, and initializing the axon backend
+# blocks for minutes establishing the tunnel.  Force the config back to
+# CPU before any backend is initialized so the suite runs on the 8
+# virtual CPU devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
