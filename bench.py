@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Round benchmark: device LP clustering + contraction wall-clock.
+
+Measures the framework's hot phases (SURVEY.md §3.3: LP iteration +
+cluster contraction — HOT LOOP 1 and 2 of the reference's call stack) on a
+10M-edge RMAT graph, the BASELINE.md workload class, over two multilevel
+coarsening levels.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+vs_baseline is the CPU reference speedup factor: cpu_seconds / our_seconds,
+where cpu_seconds comes from BASELINE_CPU.json (measured once with the
+reference KaMinPar binary's coarsening timer on the same graph; see
+scripts/measure_cpu_baseline.py).  Target per BASELINE.md: >= 4x.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+RMAT_N = 1 << 20
+RMAT_M = 10_000_000
+SEED = 42
+LEVELS = 2
+SHRINK = 64  # max cluster weight = total weight / SHRINK, per level
+
+
+def build_graph():
+    from kaminpar_tpu.graphs.factories import make_rmat
+
+    return make_rmat(RMAT_N, RMAT_M, seed=SEED)
+
+
+def run_pipeline(graph, seed: int):
+    """LEVELS x (LP cluster + contract); returns final coarse n."""
+    import jax
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.ops.contraction import contract_clustering
+    from kaminpar_tpu.ops.lp import lp_cluster
+
+    g = graph
+    c_n = None
+    for level in range(LEVELS):
+        total_w = int(jax.device_get(g.total_node_weight()))
+        mcw = jnp.int32(max(1, total_w // SHRINK))
+        labels = lp_cluster(g, mcw, jnp.int32(seed + level))
+        coarse, c_n, _ = contract_clustering(g, labels)
+        g = coarse.graph
+    jax.block_until_ready(g.node_w)
+    return c_n
+
+
+def main() -> None:
+    import jax
+
+    from kaminpar_tpu.graphs.csr import device_graph_from_host
+
+    host = build_graph()
+    graph = device_graph_from_host(host)
+    jax.block_until_ready(graph.node_w)
+
+    run_pipeline(graph, seed=0)  # warmup: compile every shape bucket
+
+    best = float("inf")
+    for rep in range(3):
+        t0 = time.perf_counter()
+        run_pipeline(graph, seed=rep)
+        best = min(best, time.perf_counter() - t0)
+
+    vs = 0.0
+    baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_CPU.json")
+    if os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            cpu = json.load(f)
+        cpu_s = cpu.get("lp_coarsening_s")
+        if cpu_s:
+            vs = cpu_s / best
+
+    print(
+        json.dumps(
+            {
+                "metric": "lp_coarsening_wall_rmat10M",
+                "value": round(best, 4),
+                "unit": "s",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -180,6 +180,26 @@ def accept_prefix_by_capacity(
     return accept
 
 
+def move_weight_delta(
+    labels: jax.Array,
+    target: jax.Array,
+    accept: jax.Array,
+    node_w: jax.Array,
+    num_clusters: int,
+) -> jax.Array:
+    """Per-cluster weight delta of a bulk move (movers leave `labels`,
+    join `target`).  The distributed round psums this across devices
+    before applying (the control_cluster_weights analog)."""
+    moved_w = jnp.where(accept, node_w, 0).astype(ACC_DTYPE)
+    out_w = jax.ops.segment_sum(
+        moved_w, jnp.clip(labels, 0, num_clusters - 1), num_segments=num_clusters
+    )
+    in_w = jax.ops.segment_sum(
+        moved_w, jnp.clip(target, 0, num_clusters - 1), num_segments=num_clusters
+    )
+    return in_w - out_w
+
+
 def apply_move_weight_delta(
     cluster_weights: jax.Array,
     labels: jax.Array,
@@ -191,14 +211,8 @@ def apply_move_weight_delta(
     their old cluster, add them to the new one.  Shared by LP rounds,
     isolated-node clustering, and two-hop clustering."""
     C = cluster_weights.shape[0]
-    moved_w = jnp.where(accept, node_w, 0).astype(ACC_DTYPE)
-    out_w = jax.ops.segment_sum(
-        moved_w, jnp.clip(labels, 0, C - 1), num_segments=C
-    )
-    in_w = jax.ops.segment_sum(
-        moved_w, jnp.clip(target, 0, C - 1), num_segments=C
-    )
-    return (cluster_weights + in_w - out_w).astype(cluster_weights.dtype)
+    delta = move_weight_delta(labels, target, accept, node_w, C)
+    return (cluster_weights + delta).astype(cluster_weights.dtype)
 
 
 def connection_to_label(

@@ -1,0 +1,24 @@
+"""Multi-chip (distributed) layer.
+
+The TPU-native re-design of the reference's distributed stack
+(kaminpar-dist + kaminpar-mpi): instead of MPI ranks exchanging sparse
+all-to-alls over ghost-node halos (kaminpar-dist/graphutils/communication.h),
+the graph is sharded over a `jax.sharding.Mesh` axis and every exchange is
+an XLA collective inside `shard_map` — `psum` for cluster/block weight
+control and cut reduction, `all_gather` for label/ghost synchronization.
+"""
+
+from .mesh import make_mesh, NODE_AXIS
+from .dist_graph import DistGraph, dist_graph_from_host
+from .dist_lp import dist_lp_cluster, dist_lp_refine
+from .dist_metrics import dist_edge_cut
+
+__all__ = [
+    "make_mesh",
+    "NODE_AXIS",
+    "DistGraph",
+    "dist_graph_from_host",
+    "dist_lp_cluster",
+    "dist_lp_refine",
+    "dist_edge_cut",
+]

@@ -1,0 +1,122 @@
+"""Node-sharded device graph — the TPU twin of DistributedCSRGraph.
+
+The reference distributes nodes in contiguous global ranges per PE with
+local ghost copies of remote endpoints (kaminpar-dist/datastructures/
+distributed_csr_graph.h:25-92, ghost_node_mapper.h:311).  On a device mesh
+the same 1D distribution becomes array sharding: node arrays are sharded
+over the mesh axis, and each device holds the (padded) edge list of its own
+node range.  There is no explicit ghost table — remote label lookups are
+gathers into a replicated label vector that is rebuilt with `all_gather`
+after every bulk-synchronous round, which is the collective form of the
+reference's `synchronize_ghost_node_clusters` halo exchange
+(kaminpar-dist/coarsening/clustering/lp/global_lp_clusterer.cc:585-594).
+
+Layout invariants (device d of D, n_loc = n_pad / D, m_loc = m_tot / D):
+  * device d owns global nodes [d*n_loc, (d+1)*n_loc);
+  * `src`/`dst`/`edge_w` slots [d*m_loc, (d+1)*m_loc) hold exactly the
+    edges whose source is owned by d (both directions of an undirected
+    edge exist, each stored at its own endpoint, like the reference's
+    per-PE CSR rows);
+  * pad edge slots have src = first owned node, dst = global pad node
+    n_pad - 1, weight 0 — inert in ratings and cuts;
+  * the global pad node n_pad - 1 is never a real node (the builder
+    guarantees n_pad > n).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..graphs.host import HostGraph
+from ..utils.math import pad_size, round_up
+from .mesh import NODE_AXIS
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class DistGraph:
+    """Sharded COO graph over a 1D mesh.
+
+    Fields:
+      src, dst, edge_w : i32[m_tot]  edge arrays, sharded over the mesh axis
+                         (device d holds slots [d*m_loc, (d+1)*m_loc))
+      node_w           : i32[n_pad]  node weights, sharded over the mesh axis
+      n, m             : i32 scalars (replicated true counts)
+    """
+
+    src: jax.Array
+    dst: jax.Array
+    edge_w: jax.Array
+    node_w: jax.Array
+    n: jax.Array
+    m: jax.Array
+
+    @property
+    def n_pad(self) -> int:
+        return self.node_w.shape[0]
+
+    @property
+    def m_tot(self) -> int:
+        return self.src.shape[0]
+
+
+def dist_graph_from_host(
+    graph: HostGraph,
+    mesh: Mesh,
+    n_pad: Optional[int] = None,
+) -> DistGraph:
+    """Shard a host graph onto `mesh` in contiguous node ranges.
+
+    The analog of dKaMinPar's vtxdist/xadj/adjncy ingestion
+    (kaminpar-dist/dkaminpar.cc:400-448), minus the ghost mapping (see
+    module docstring).
+    """
+    D = mesh.devices.size
+    n, m = graph.n, graph.m
+    if n_pad is None:
+        n_pad = round_up(pad_size(n + 1), D)
+    else:
+        n_pad = round_up(n_pad, D)
+    if n_pad < n + 1:
+        raise ValueError("n_pad too small")
+    n_loc = n_pad // D
+    pad_node = n_pad - 1
+
+    src = graph.edge_sources().astype(np.int64)
+    dst = graph.adjncy.astype(np.int64)
+    ew = graph.edge_weight_array().astype(np.int64)
+
+    owner = src // n_loc
+    counts = np.bincount(owner, minlength=D) if m else np.zeros(D, np.int64)
+    m_loc = pad_size(int(counts.max()) if m else 1)
+
+    src_t = np.empty((D, m_loc), dtype=np.int32)
+    dst_t = np.full((D, m_loc), pad_node, dtype=np.int32)
+    ew_t = np.zeros((D, m_loc), dtype=np.int32)
+    for d in range(D):
+        src_t[d, :] = d * n_loc  # pad fill: first owned node, weight 0
+        sel = owner == d
+        c = int(counts[d])
+        src_t[d, :c] = src[sel]
+        dst_t[d, :c] = dst[sel]
+        ew_t[d, :c] = ew[sel]
+
+    node_w = np.zeros(n_pad, dtype=np.int32)
+    node_w[:n] = graph.node_weight_array().astype(np.int32)
+
+    shard = NamedSharding(mesh, P(NODE_AXIS))
+    repl = NamedSharding(mesh, P())
+    return DistGraph(
+        src=jax.device_put(src_t.reshape(-1), shard),
+        dst=jax.device_put(dst_t.reshape(-1), shard),
+        edge_w=jax.device_put(ew_t.reshape(-1), shard),
+        node_w=jax.device_put(node_w, shard),
+        n=jax.device_put(jnp.int32(n), repl),
+        m=jax.device_put(jnp.int32(m), repl),
+    )

@@ -1,0 +1,37 @@
+"""Device-mesh construction.
+
+The analog of the reference's MPI communicator setup (kaminpar-mpi/
+wrapper.h, definitions.h): one 1D mesh axis over which the node space is
+sharded.  The reference distributes nodes in contiguous ranges per PE
+(`node_distribution`, kaminpar-dist/datastructures/distributed_csr_graph.h:
+25-92); the mesh axis plays the role of the PE dimension, and XLA
+collectives over it ride ICI on real hardware (DCN across slices).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = NODE_AXIS,
+) -> Mesh:
+    """1D mesh over the first `n_devices` available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)}; on CPU set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
