@@ -23,8 +23,8 @@ import time
 RMAT_N = 1 << 20
 RMAT_M = 10_000_000
 SEED = 42
-LEVELS = 2
-SHRINK = 64  # max cluster weight = total weight / SHRINK, per level
+BENCH_K = 16
+BENCH_EPS = 0.03
 
 
 def build_graph():
@@ -33,24 +33,25 @@ def build_graph():
     return make_rmat(RMAT_N, RMAT_M, seed=SEED)
 
 
-def run_pipeline(graph, seed: int):
-    """LEVELS x (LP cluster + contract); returns final coarse n."""
+def run_pipeline(host, graph, seed: int) -> int:
+    """The product's full coarsening phase (Coarsener: LP clustering +
+    contraction until the contraction limit), matching the reference's
+    'coarsening' timer subtree.  Returns the coarsest n."""
     import jax
-    import jax.numpy as jnp
 
-    from kaminpar_tpu.ops.contraction import contract_clustering
-    from kaminpar_tpu.ops.lp import lp_cluster
+    from kaminpar_tpu.partitioning.coarsener import Coarsener
+    from kaminpar_tpu.presets import create_context_by_preset_name
 
-    g = graph
-    c_n = None
-    for level in range(LEVELS):
-        total_w = int(jax.device_get(g.total_node_weight()))
-        mcw = jnp.int32(max(1, total_w // SHRINK))
-        labels = lp_cluster(g, mcw, jnp.int32(seed + level))
-        coarse, c_n, _ = contract_clustering(g, labels)
-        g = coarse.graph
-    jax.block_until_ready(g.node_w)
-    return c_n
+    ctx = create_context_by_preset_name("default")
+    ctx.seed = seed
+    ctx.partition.setup(host, k=BENCH_K, epsilon=BENCH_EPS)
+    coarsener = Coarsener(ctx, graph, int(host.n))
+    threshold = max(2 * ctx.coarsening.contraction_limit, 2)  # deep.py stop
+    while coarsener.current_n > threshold:
+        if not coarsener.coarsen():
+            break
+    jax.block_until_ready(coarsener.current.node_w)
+    return coarsener.current_n
 
 
 def main() -> None:
@@ -62,12 +63,12 @@ def main() -> None:
     graph = device_graph_from_host(host)
     jax.block_until_ready(graph.node_w)
 
-    run_pipeline(graph, seed=0)  # warmup: compile every shape bucket
+    run_pipeline(host, graph, seed=0)  # warmup: compile every shape bucket
 
     best = float("inf")
     for rep in range(3):
         t0 = time.perf_counter()
-        run_pipeline(graph, seed=rep)
+        run_pipeline(host, graph, seed=rep)
         best = min(best, time.perf_counter() - t0)
 
     vs = 0.0

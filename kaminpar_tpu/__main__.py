@@ -1,0 +1,7 @@
+"""`python -m kaminpar_tpu` — the KaMinPar CLI (apps/KaMinPar.cc analog)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

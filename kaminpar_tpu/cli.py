@@ -1,0 +1,260 @@
+"""Command-line interface (analog of apps/KaMinPar.cc:405 main +
+kaminpar-cli/kaminpar_arguments.cc).
+
+The reference's CLI11 surface maps ~150 flags onto the Context tree, loads
+TOML config files (-C) and dumps the effective config (--dump-config,
+apps/KaMinPar.cc:90-112).  This argparse CLI covers the same capability
+groups: preset selection, partition parameters (k / epsilon / explicit
+block weights), algorithm overrides, IO formats, seed, output files,
+timers, and config round-tripping (TOML in via tomllib, TOML out via a
+small emitter).
+
+Usage:  python -m kaminpar_tpu <graph> -k 16 [-P preset] [options]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import enum
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import io as io_mod
+from .context import (
+    Context,
+    PartitioningMode,
+    RefinementAlgorithm,
+)
+from .kaminpar import KaMinPar
+from .presets import create_context_by_preset_name, get_preset_names
+from .utils import timer
+from .utils.logger import OutputLevel
+
+
+# ---------------------------------------------------------------------------
+# Context <-> plain dict (for -C config files and --dump-config)
+# ---------------------------------------------------------------------------
+
+def context_to_dict(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: context_to_dict(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (list, tuple)):
+        return [context_to_dict(x) for x in obj]
+    if isinstance(obj, float) and obj == float("inf"):
+        return "inf"
+    return obj
+
+
+def apply_dict_to_context(ctx: Any, data: Dict[str, Any]) -> None:
+    """Overlay a (possibly partial) nested dict onto the dataclass tree."""
+    for key, value in data.items():
+        if not hasattr(ctx, key):
+            raise ValueError(f"unknown config key: {key!r}")
+        current = getattr(ctx, key)
+        if dataclasses.is_dataclass(current) and isinstance(value, dict):
+            apply_dict_to_context(current, value)
+        elif isinstance(current, enum.Enum):
+            setattr(ctx, key, type(current)(value))
+        elif isinstance(current, list) and current and isinstance(
+            current[0], enum.Enum
+        ):
+            setattr(ctx, key, [type(current[0])(v) for v in value])
+        elif key == "algorithms":  # empty refiner list: elements are enums
+            setattr(ctx, key, [RefinementAlgorithm(v) for v in value])
+        elif value == "inf":
+            setattr(ctx, key, float("inf"))
+        else:
+            setattr(ctx, key, type(current)(value) if current is not None else value)
+
+
+def dump_toml(data: Dict[str, Any], prefix: str = "") -> List[str]:
+    """Minimal TOML emitter for the context dict (scalars, lists, tables)."""
+    lines: List[str] = []
+    scalars = {k: v for k, v in data.items() if not isinstance(v, dict)}
+    tables = {k: v for k, v in data.items() if isinstance(v, dict)}
+    for k, v in scalars.items():
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            lines.append(f"{k} = {'true' if v else 'false'}")
+        elif isinstance(v, (int, float)):
+            lines.append(f"{k} = {v}")
+        elif isinstance(v, str):
+            lines.append(f'{k} = "{v}"')
+        elif isinstance(v, list):
+            items = ", ".join(
+                f'"{x}"' if isinstance(x, str) else str(x) for x in v
+            )
+            lines.append(f"{k} = [{items}]")
+    for k, v in tables.items():
+        name = f"{prefix}.{k}" if prefix else k
+        lines.append("")
+        lines.append(f"[{name}]")
+        lines.extend(dump_toml(v, name))
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# Argument parser (kaminpar_arguments.cc flag groups)
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kaminpar_tpu",
+        description="TPU-native deep multilevel graph partitioner",
+    )
+    p.add_argument("graph", nargs="?", help="input graph file")
+    p.add_argument("-k", "--k", type=int, default=None, help="number of blocks")
+    p.add_argument(
+        "-e", "--epsilon", type=float, default=None,
+        help="max imbalance, e.g. 0.03 (default)",
+    )
+    p.add_argument(
+        "-B", "--max-block-weights", type=int, nargs="+", default=None,
+        help="explicit per-block max weights (overrides -k/-e)",
+    )
+    p.add_argument(
+        "--min-epsilon", type=float, default=None,
+        help="enforce min block weights (1-eps)*perfect",
+    )
+    p.add_argument(
+        "-P", "--preset", default="default",
+        choices=sorted(get_preset_names()), help="configuration preset",
+    )
+    p.add_argument("-C", "--config", default=None, help="TOML config file")
+    p.add_argument(
+        "--dump-config", action="store_true",
+        help="print the effective config as TOML and exit",
+    )
+    p.add_argument("-s", "--seed", type=int, default=None, help="RNG seed")
+    p.add_argument(
+        "-f", "--format", default="auto", choices=["auto", "metis", "parhip"],
+        help="input graph format",
+    )
+    p.add_argument("-o", "--output", default=None, help="partition output file")
+    p.add_argument(
+        "--output-block-sizes", default=None, help="block size output file"
+    )
+    p.add_argument("-q", "--quiet", action="store_true", help="no output")
+    p.add_argument(
+        "--validate", action="store_true",
+        help="validate the input graph (graph_validator analog)",
+    )
+    p.add_argument(
+        "-T", "--timers", action="store_true", help="print the timer tree"
+    )
+    p.add_argument(
+        "-m", "--mode", default=None,
+        choices=[m.value for m in PartitioningMode],
+        help="partitioning scheme override",
+    )
+    # common algorithm overrides (kaminpar_arguments.cc coarsening/refinement)
+    p.add_argument("--lp-iterations", type=int, default=None)
+    p.add_argument("--contraction-limit", type=int, default=None)
+    p.add_argument(
+        "--refinement", default=None,
+        help="semicolon-separated refiner list, e.g. "
+        "'overload-balancer;lp;underload-balancer'",
+    )
+    p.add_argument(
+        "--vcycles", type=int, nargs="+", default=None,
+        help="block counts per v-cycle (vcycle mode)",
+    )
+    return p
+
+
+def make_context(args: argparse.Namespace) -> Context:
+    ctx = create_context_by_preset_name(args.preset)
+    if args.config:
+        import tomllib
+
+        with open(args.config, "rb") as f:
+            apply_dict_to_context(ctx, tomllib.load(f))
+    if args.mode:
+        ctx.partitioning.mode = PartitioningMode(args.mode)
+    if args.lp_iterations is not None:
+        ctx.coarsening.clustering.lp.num_iterations = args.lp_iterations
+    if args.contraction_limit is not None:
+        ctx.coarsening.contraction_limit = args.contraction_limit
+    if args.refinement is not None:
+        ctx.refinement.algorithms = [
+            RefinementAlgorithm(a) for a in args.refinement.split(";") if a
+        ]
+    if args.vcycles is not None:
+        ctx.partitioning.vcycles = list(args.vcycles)
+    if args.seed is not None:  # -C config may set the seed; flag wins
+        ctx.seed = args.seed
+    return ctx
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    ctx = make_context(args)
+
+    if args.dump_config:
+        print("\n".join(dump_toml(context_to_dict(ctx))))
+        return 0
+
+    if args.graph is None:
+        print("error: no graph file given", file=sys.stderr)
+        return 1
+    if args.k is None and args.max_block_weights is None:
+        print("error: need -k or -B/--max-block-weights", file=sys.stderr)
+        return 1
+
+    t_io = time.perf_counter()
+    graph = io_mod.load_graph(args.graph, fmt=args.format)
+    io_s = time.perf_counter() - t_io
+
+    partitioner = KaMinPar(ctx)
+    if args.quiet:
+        partitioner.set_output_level(OutputLevel.QUIET)
+    partitioner.set_graph(graph, validate=args.validate)
+
+    if args.min_epsilon is not None:
+        # needs k/weights set up first; compute_partition redoes setup, so
+        # pre-setup here only to derive min weights
+        ctx.partition.setup(graph, k=args.k, epsilon=args.epsilon,
+                            max_block_weights=args.max_block_weights)
+        ctx.partition.setup_min_block_weights(args.min_epsilon)
+
+    t0 = time.perf_counter()
+    partition = partitioner.compute_partition(
+        k=args.k,
+        epsilon=args.epsilon,
+        max_block_weights=(
+            np.asarray(args.max_block_weights, dtype=np.int64)
+            if args.max_block_weights
+            else None
+        ),
+        seed=args.seed,
+    )
+    wall = time.perf_counter() - t0
+
+    if not args.quiet:
+        print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
+    if args.timers and not args.quiet:
+        print(timer.GLOBAL_TIMER.render())
+
+    if args.output:
+        io_mod.write_partition(args.output, partition)
+    if args.output_block_sizes:
+        io_mod.write_block_sizes(
+            args.output_block_sizes, partition, ctx.partition.k
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

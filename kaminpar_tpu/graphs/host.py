@@ -311,3 +311,44 @@ def extract_block_subgraphs(
         )
         subgraphs.append(sub)
     return SubgraphExtraction(subgraphs=subgraphs, node_mapping=pos_in_block)
+
+
+# ---------------------------------------------------------------------------
+# Host contraction (numpy twin of ops/contraction.contract_clustering; used
+# by the distributed driver where the coarse graph is rebuilt host-side
+# before redistribution, and by the sequential initial-partitioning path)
+# ---------------------------------------------------------------------------
+
+
+def contract_clustering_host(
+    graph: HostGraph, labels: np.ndarray
+) -> tuple[HostGraph, np.ndarray]:
+    """Contract a clustering on the host.
+
+    `labels[i]` is node i's cluster (any values); returns (coarse graph,
+    cmap) where cmap densely remaps fine node -> coarse node, coarse node
+    weights are cluster sums, and coarse edges aggregate inter-cluster
+    weights (self-loops dropped) — the same semantics as the reference's
+    contract_clustering (kaminpar-shm/coarsening/contraction/
+    cluster_contraction.h:50-59).
+    """
+    labels = np.asarray(labels)[: graph.n]
+    uniq, cmap = np.unique(labels, return_inverse=True)
+    c_n = len(uniq)
+    cmap = cmap.astype(np.int32)
+
+    c_node_w = np.zeros(c_n, dtype=np.int64)
+    np.add.at(c_node_w, cmap, graph.node_weight_array())
+
+    src = cmap[graph.edge_sources()]
+    dst = cmap[graph.adjncy]
+    w = graph.edge_weight_array()
+    keep = src != dst
+    coarse = from_edge_list(
+        c_n,
+        np.stack([src[keep], dst[keep]], axis=1),
+        edge_weights=w[keep],
+        node_weights=c_node_w,
+        symmetrize=False,
+    )
+    return coarse, cmap

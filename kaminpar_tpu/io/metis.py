@@ -101,12 +101,27 @@ def write_metis(graph: HostGraph, path: str) -> None:
         f.write(header + "\n")
         nw = graph.node_weights
         ew = graph.edge_weights
-        for u in range(n):
-            parts = []
-            if has_nw:
-                parts.append(str(int(nw[u])))
-            for e in range(int(graph.xadj[u]), int(graph.xadj[u + 1])):
-                parts.append(str(int(graph.adjncy[e]) + 1))
-                if has_ew:
-                    parts.append(str(int(ew[e])))
-            f.write(" ".join(parts) + "\n")
+        if not has_nw and not has_ew and m > 0:
+            # vectorized fast path: one token stream with '\n' as the
+            # separator after each row's last edge, then blank lines
+            # spliced back in for isolated nodes (which METIS encodes as
+            # empty lines — see tests/test_io.py)
+            deg = graph.degrees()
+            tokens = np.char.mod("%d", graph.adjncy.astype(np.int64) + 1)
+            sep = np.full(m, " ", dtype="U1")
+            row_ends = np.asarray(graph.xadj[1:], dtype=np.int64)[deg > 0] - 1
+            sep[row_ends] = "\n"
+            body = "".join(np.char.add(tokens, sep))
+            lines = body.split("\n")[:-1]  # one entry per nonempty row
+            it = iter(lines)
+            f.write("\n".join(next(it) if d else "" for d in deg > 0) + "\n")
+        else:
+            for u in range(n):
+                parts = []
+                if has_nw:
+                    parts.append(str(int(nw[u])))
+                for e in range(int(graph.xadj[u]), int(graph.xadj[u + 1])):
+                    parts.append(str(int(graph.adjncy[e]) + 1))
+                    if has_ew:
+                        parts.append(str(int(ew[e])))
+                f.write(" ".join(parts) + "\n")

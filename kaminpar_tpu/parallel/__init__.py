@@ -12,6 +12,7 @@ from .mesh import make_mesh, NODE_AXIS
 from .dist_graph import DistGraph, dist_graph_from_host
 from .dist_lp import dist_lp_cluster, dist_lp_refine
 from .dist_metrics import dist_edge_cut
+from .dist_partitioner import dKaMinPar
 
 __all__ = [
     "make_mesh",
@@ -21,4 +22,5 @@ __all__ = [
     "dist_lp_cluster",
     "dist_lp_refine",
     "dist_edge_cut",
+    "dKaMinPar",
 ]

@@ -22,12 +22,28 @@ class OutputLevel(enum.IntEnum):
     DEBUG = 4
 
 
+class _DynamicStderrHandler(logging.StreamHandler):
+    """Resolves sys.stderr at emit time (it may be redirected later)."""
+
+    def __init__(self):
+        super().__init__(stream=None)
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):  # base __init__ assigns; ignore
+        pass
+
+
 _LOGGER = logging.getLogger("kaminpar_tpu")
 if not _LOGGER.handlers:
-    handler = logging.StreamHandler(sys.stderr)
+    handler = _DynamicStderrHandler()
     handler.setFormatter(logging.Formatter("[kaminpar-tpu] %(message)s"))
     _LOGGER.addHandler(handler)
-    _LOGGER.setLevel(logging.WARNING)
+    # default OutputLevel is APPLICATION, so INFO must pass through
+    _LOGGER.setLevel(logging.INFO)
     _LOGGER.propagate = False
 
 _OUTPUT_LEVEL = OutputLevel.APPLICATION

@@ -43,6 +43,13 @@ class Timer:
         self.enabled = enabled
 
     def reset(self) -> None:
+        """Clear the tree.  A no-op while scopes are open: the library may
+        run nested inside another pipeline (e.g. shm KaMinPar as the
+        distributed driver's initial partitioner), and clearing mid-scope
+        would orphan the open stack — the same global-singleton caveat the
+        reference documents (README.MD:253-256)."""
+        if len(self._stack) > 1:
+            return
         self.root = TimerNode(self.root.name)
         self._stack = [self.root]
 

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Measure the reference KaMinPar's coarsening wall-clock on the bench graph.
+
+Run once per benchmark-host to produce BASELINE_CPU.json, which bench.py
+uses as the vs_baseline denominator.  Usage:
+
+    python scripts/measure_cpu_baseline.py [path-to-reference-KaMinPar-binary]
+
+The binary is built from /root/reference (cmake -DCMAKE_BUILD_TYPE=Release
+-DBUILD_TESTING=OFF -DKAMINPAR_BUILD_WITH_SPARSEHASH=OFF
+-DKAMINPAR_BUILD_WITH_KASSERT=OFF; target KaMinParApp).  The script writes
+the bench RMAT graph in METIS format, runs the binary with the bench's
+k/epsilon, parses the coarsening timer from its output, and records the
+result with provenance (host core count).
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+
+def main() -> None:
+    binary = sys.argv[1] if len(sys.argv) > 1 else "/tmp/kmp_build/apps/KaMinPar"
+    if not os.path.exists(binary):
+        raise SystemExit(f"reference binary not found: {binary}")
+
+    from kaminpar_tpu.io import write_metis
+
+    host = bench.build_graph()
+    with tempfile.TemporaryDirectory() as tmp:
+        graph_path = os.path.join(tmp, "bench_rmat.metis")
+        write_metis(host, graph_path)
+
+        best = float("inf")
+        best_cut = None
+        for seed in range(2):
+            out = subprocess.run(
+                [
+                    binary,
+                    graph_path,
+                    "-k",
+                    str(bench.BENCH_K),
+                    "-e",
+                    str(bench.BENCH_EPS),
+                    "-s",
+                    str(seed),
+                ],
+                capture_output=True,
+                text=True,
+                check=True,
+            ).stdout
+            m = re.search(r"Coarsening:\s*\.*\s*\(?([0-9.]+)\s*s", out)
+            if m is None:
+                sys.stderr.write(out)
+                raise SystemExit("could not parse coarsening time")
+            best = min(best, float(m.group(1)))
+            mc = re.search(r"Edge cut:\s*(\d+)", out)
+            if mc:
+                cut = int(mc.group(1))
+                best_cut = cut if best_cut is None else min(best_cut, cut)
+
+    result = {
+        "lp_coarsening_s": best,
+        "edge_cut": best_cut,
+        "graph": f"rmat n={bench.RMAT_N} m={bench.RMAT_M} seed={bench.SEED}",
+        "k": bench.BENCH_K,
+        "epsilon": bench.BENCH_EPS,
+        "binary": "reference KaMinPar (default preset), coarsening subtree",
+        "cpu_cores": multiprocessing.cpu_count(),
+    }
+    path = os.path.join(os.path.dirname(__file__), "..", "BASELINE_CPU.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""CLI tests (apps/KaMinPar.cc surface)."""
+
+import io as std_io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.cli import (
+    apply_dict_to_context,
+    build_parser,
+    context_to_dict,
+    dump_toml,
+    main,
+)
+from kaminpar_tpu.presets import create_context_by_preset_name
+
+RGG = "/root/reference/misc/rgg2d.metis"
+
+
+def test_dump_config_roundtrips_through_toml(tmp_path):
+    import tomllib
+
+    ctx = create_context_by_preset_name("strong")
+    text = "\n".join(dump_toml(context_to_dict(ctx)))
+    data = tomllib.loads(text)
+    ctx2 = create_context_by_preset_name("default")
+    apply_dict_to_context(ctx2, data)
+    assert context_to_dict(ctx2) == context_to_dict(ctx)
+
+
+def test_cli_partitions_and_writes_output(tmp_path, capfd):
+    out = tmp_path / "part.txt"
+    sizes = tmp_path / "sizes.txt"
+    rc = main(
+        [
+            RGG,
+            "-k",
+            "4",
+            "-e",
+            "0.03",
+            "-o",
+            str(out),
+            "--output-block-sizes",
+            str(sizes),
+            "-T",
+            "--validate",
+        ]
+    )
+    assert rc == 0
+    captured = capfd.readouterr()  # fd-level: the logger binds the real stderr
+    assert "RESULT cut=" in captured.err
+    assert "TIME io=" in captured.out
+
+    part = np.loadtxt(out, dtype=np.int32)
+    assert part.shape == (1024,)
+    assert part.min() >= 0 and part.max() < 4
+    bs = np.loadtxt(sizes, dtype=np.int64)
+    assert bs.sum() == 1024
+
+
+def test_cli_config_file_override(tmp_path):
+    cfg = tmp_path / "cfg.toml"
+    cfg.write_text("[coarsening]\ncontraction_limit = 123\n")
+    parser = build_parser()
+    args = parser.parse_args([RGG, "-k", "2", "-C", str(cfg)])
+    from kaminpar_tpu.cli import make_context
+
+    ctx = make_context(args)
+    assert ctx.coarsening.contraction_limit == 123
+
+
+def test_cli_refinement_override():
+    parser = build_parser()
+    args = parser.parse_args([RGG, "-k", "2", "--refinement", "lp;jet"])
+    from kaminpar_tpu.cli import make_context
+    from kaminpar_tpu.context import RefinementAlgorithm
+
+    ctx = make_context(args)
+    assert ctx.refinement.algorithms == [
+        RefinementAlgorithm.LABEL_PROPAGATION,
+        RefinementAlgorithm.JET,
+    ]
+
+
+def test_cli_errors_without_k(capfd):
+    assert main([RGG]) == 1
+    assert main([]) == 1

@@ -117,3 +117,39 @@ def test_dist_matches_single_chip_quality():
     d_labels = np.asarray(dist_lp_cluster(dg, 40, seed=1))
     d_n = cluster_stats(graph, d_labels)[0]
     assert 0.25 * sc_n <= d_n <= 4.0 * sc_n
+
+
+def test_dkaminpar_end_to_end():
+    """Distributed deep multilevel on 8 devices: feasible partition with a
+    cut comparable to the single-chip pipeline (dist_endtoend_test analog)."""
+    from kaminpar_tpu import KaMinPar
+    from kaminpar_tpu.parallel import dKaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    graph = make_grid_graph(64, 64)
+    k, eps = 4, 0.03
+
+    dpart = (
+        dKaMinPar("default", n_devices=8)
+        .set_graph(graph)
+        .compute_partition(k=k, epsilon=eps, seed=1)
+    )
+    assert dpart.shape == (graph.n,)
+    assert dpart.min() >= 0 and dpart.max() < k
+
+    nw = graph.node_weight_array()
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, dpart, nw)
+    cap = int((1 + eps) * np.ceil(nw.sum() / k)) + int(nw.max())
+    assert (bw <= cap).all()
+
+    src = graph.edge_sources()
+    dcut = int(graph.edge_weight_array()[dpart[src] != dpart[graph.adjncy]].sum() // 2)
+
+    sc = KaMinPar("default")
+    sc.set_output_level(OutputLevel.QUIET)
+    spart = sc.set_graph(graph).compute_partition(k=k, epsilon=eps, seed=1)
+    scut = int(graph.edge_weight_array()[spart[src] != spart[graph.adjncy]].sum() // 2)
+
+    # same algorithm family; allow slack for the different commit protocol
+    assert dcut <= 3 * scut + 16
