@@ -139,7 +139,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("-s", "--seed", type=int, default=None, help="RNG seed")
     p.add_argument(
-        "-f", "--format", default="auto", choices=["auto", "metis", "parhip"],
+        "-f", "--format", default="auto",
+        choices=["auto", "metis", "parhip", "compressed"],
         help="input graph format",
     )
     p.add_argument("-o", "--output", default=None, help="partition output file")

@@ -39,6 +39,7 @@ class CoarseningAlgorithm(str, enum.Enum):
     NOOP = "noop"
     BASIC_CLUSTERING = "basic"
     OVERLAY_CLUSTERING = "overlay"
+    SPARSIFICATION_CLUSTERING = "sparsification"
 
 
 class ClusterWeightLimit(str, enum.Enum):
@@ -115,6 +116,9 @@ class ClusteringContext:
     # desired-cluster-count floor (n / shrink_factor); accepted for preset
     # parity, not yet enforced by the bulk-sync clusterer
     shrink_factor: float = float("inf")
+    # terapart-largek: force an extra coarsening level at the k-contraction
+    # boundary (presets.cc create_terapart_largek_context)
+    forced_kc_level: bool = False
 
 
 @dataclass
@@ -125,6 +129,9 @@ class CoarseningContext:
     clustering: ClusteringContext = field(default_factory=ClusteringContext)
     contraction_limit: int = 2000
     convergence_threshold: float = 0.05
+    # linear-time MGP (arXiv 2504.17615; SparsificationClusterCoarsener
+    # analog): fraction of edges kept per level before clustering
+    sparsification_keep_ratio: float = 0.5
 
     def max_cluster_weight(
         self, n: int, total_node_weight: int, p_ctx: "PartitionContext"
@@ -366,6 +373,14 @@ class PartitionContext:
 
 
 @dataclass
+class GraphCompressionContext:
+    """Compressed-graph (TeraPart) mode: store the host graph varint-gap
+    compressed (graphs/compressed.py); the device path is unchanged."""
+
+    enabled: bool = False
+
+
+@dataclass
 class DebugContext:
     """kaminpar.h:484-496."""
 
@@ -394,6 +409,9 @@ class Context:
     )
     refinement: RefinementContext = field(default_factory=RefinementContext)
     parallel: ParallelContext = field(default_factory=ParallelContext)
+    compression: GraphCompressionContext = field(
+        default_factory=GraphCompressionContext
+    )
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
 

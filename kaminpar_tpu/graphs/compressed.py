@@ -1,0 +1,118 @@
+"""Compressed host graph (TeraPart analog).
+
+The reference's memory-frugal mode stores neighborhoods gap+varint encoded
+(kaminpar-common/graph_compression/compressed_neighborhoods.h:52-60,
+varint.h; datastructures/compressed_graph.h:30) so tera-scale graphs fit in
+RAM.  In the TPU framework the *device* graph must stay flat int32 CSR (XLA
+kernels want dense arrays), so compression lives on the host side of the
+DLPack boundary: a `CompressedHostGraph` holds the varint-gap streams
+(encoded/decoded by the native C++ codec, kaminpar_tpu/native/codec.cpp)
+and materializes plain CSR lazily — whole-graph for device upload, per-node
+for host algorithms.
+
+Edge weights, when present, are stored as raw arrays (the reference
+interleaves varint-coded weights; a follow-up can pack them the same way —
+unweighted graphs, the common tera-scale case, already get the full
+benefit).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import native
+from .host import HostGraph
+
+
+@dataclass
+class CompressedHostGraph:
+    """Varint-gap compressed CSR (CompressedGraph analog)."""
+
+    xadj: np.ndarray  # i64[n+1] degrees prefix (uncompressed, like reference)
+    offsets: np.ndarray  # i64[n+1] byte offset per node's stream
+    data: np.ndarray  # u8[total] varint gap streams
+    node_weights: Optional[np.ndarray] = None
+    edge_weights: Optional[np.ndarray] = None
+
+    @property
+    def n(self) -> int:
+        return len(self.xadj) - 1
+
+    @property
+    def m(self) -> int:
+        return int(self.xadj[-1])
+
+    def degrees(self) -> np.ndarray:
+        return self.xadj[1:] - self.xadj[:-1]
+
+    def neighbors(self, u: int) -> np.ndarray:
+        """Decode one node (compressed_graph.h adjacent_nodes analog)."""
+        return native.decode_node(u, self.xadj, self.offsets, self.data)
+
+    def decode(self) -> HostGraph:
+        """Materialize the full CSR graph."""
+        adjncy = native.decode_gaps(self.xadj, self.offsets, self.data)
+        return HostGraph(
+            xadj=self.xadj.copy(),
+            adjncy=adjncy,
+            node_weights=self.node_weights,
+            edge_weights=self.edge_weights,
+        )
+
+    def node_weight_array(self) -> np.ndarray:
+        if self.node_weights is not None:
+            return np.asarray(self.node_weights, dtype=np.int64)
+        return np.ones(self.n, dtype=np.int64)
+
+    @property
+    def total_node_weight(self) -> int:
+        return int(self.node_weight_array().sum())
+
+    def memory_bytes(self) -> int:
+        total = self.xadj.nbytes + self.offsets.nbytes + self.data.nbytes
+        if self.node_weights is not None:
+            total += self.node_weights.nbytes
+        if self.edge_weights is not None:
+            total += self.edge_weights.nbytes
+        return total
+
+    def compression_ratio(self) -> float:
+        """Uncompressed adjacency bytes / compressed stream bytes
+        (the reference reports the same ratio in its compression stats)."""
+        raw = self.m * 4
+        return raw / max(1, self.data.nbytes)
+
+
+def compress_host_graph(graph: HostGraph) -> CompressedHostGraph:
+    """Build the compressed form (compressed_graph_builder.h analog).
+
+    Neighborhoods must be sorted ascending for gap coding; the builder
+    sorts per node when needed (the reference's builder requires the same
+    and offers reorder_edges_by_compression, permutator.h:241)."""
+    adjncy = graph.adjncy
+    xadj = np.asarray(graph.xadj, dtype=np.int64)
+    # ensure sorted neighborhoods (cheap check first)
+    needs_sort = False
+    if graph.m:
+        d = np.diff(adjncy.astype(np.int64))
+        row_start = np.zeros(graph.m, dtype=bool)
+        row_start[xadj[:-1][graph.degrees() > 0]] = True
+        needs_sort = bool((d < 0)[~row_start[1:]].any())
+    ew = graph.edge_weights
+    if needs_sort:
+        src = graph.edge_sources()
+        order = np.lexsort((adjncy, src))
+        adjncy = adjncy[order]
+        if ew is not None:
+            ew = np.asarray(ew)[order]
+    data, offsets = native.encode_gaps(xadj, adjncy)
+    return CompressedHostGraph(
+        xadj=xadj,
+        offsets=offsets,
+        data=data,
+        node_weights=graph.node_weights,
+        edge_weights=ew,
+    )

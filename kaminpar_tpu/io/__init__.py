@@ -6,6 +6,11 @@ import os
 
 from .metis import load_metis, parse_metis, write_metis  # noqa: F401
 from .parhip import load_parhip, parse_parhip, write_parhip  # noqa: F401
+from .compressed_binary import (  # noqa: F401
+    is_compressed_file,
+    load_compressed,
+    write_compressed,
+)
 from .partition import (  # noqa: F401
     read_partition,
     write_partition,
@@ -15,15 +20,18 @@ from .partition import (  # noqa: F401
 from ..graphs.host import HostGraph
 
 
-def load_graph(path: str, fmt: str = "auto") -> HostGraph:
+def load_graph(path: str, fmt: str = "auto"):
     """Load a graph by file format (kaminpar_io.h read_graph analog).
-    fmt: 'metis', 'parhip', or 'auto' (sniff by extension then content)."""
+    fmt: 'metis', 'parhip', 'compressed', or 'auto' (sniff by extension
+    then content).  'compressed' returns a CompressedHostGraph."""
     if fmt == "auto":
         ext = os.path.splitext(path)[1].lower()
         if ext in (".metis", ".graph", ".txt"):
             fmt = "metis"
         elif ext in (".parhip", ".bgf", ".bin"):
             fmt = "parhip"
+        elif ext == ".npz" or is_compressed_file(path):
+            fmt = "compressed"
         else:
             with open(path, "rb") as f:
                 head = f.read(64)
@@ -32,6 +40,8 @@ def load_graph(path: str, fmt: str = "auto") -> HostGraph:
         return load_metis(path)
     if fmt == "parhip":
         return load_parhip(path)
+    if fmt == "compressed":
+        return load_compressed(path)
     raise ValueError(f"unknown graph format: {fmt}")
 
 

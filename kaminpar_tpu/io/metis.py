@@ -85,8 +85,64 @@ def parse_metis(text: str) -> HostGraph:
 
 
 def load_metis(path: str) -> HostGraph:
-    with open(path, "r") as f:
-        return parse_metis(f.read())
+    with open(path, "rb") as f:
+        raw = f.read()
+    graph = _parse_metis_native(raw)
+    if graph is not None:
+        return graph
+    return parse_metis(raw.decode("latin-1"))
+
+
+def _parse_metis_native(raw: bytes) -> HostGraph | None:
+    """One-pass native tokenizer (the file_toker.h analog,
+    kaminpar_tpu/native/codec.cpp kmp_parse_metis_body); None -> fall back
+    to the Python parser."""
+    from .. import native
+
+    lib = native.get_lib()
+    if lib is None:
+        return None
+    # split off the header line (skipping leading comments)
+    pos = 0
+    header = None
+    while pos < len(raw):
+        eol = raw.find(b"\n", pos)
+        if eol < 0:
+            eol = len(raw)
+        line = raw[pos:eol].strip()
+        pos = eol + 1
+        if line and not line.startswith(b"%"):
+            header = line.split()
+            break
+    if header is None or len(header) < 2:
+        return None
+    n = int(header[0])
+    m2 = int(header[1]) * 2
+    fmt = header[2].decode() if len(header) > 2 else "0"
+    has_vw = len(fmt) >= 2 and fmt[-2] == "1"
+    has_ew = fmt[-1] == "1"
+
+    body = raw[pos:]
+    xadj = np.zeros(n + 1, dtype=np.int64)
+    adjncy = np.zeros(max(m2, 1), dtype=np.int32)
+    vw = np.zeros(n if has_vw else 1, dtype=np.int64)
+    ew = np.zeros(max(m2, 1) if has_ew else 1, dtype=np.int64)
+    m = lib.kmp_parse_metis_body(
+        body, len(body), n, int(has_vw), int(has_ew), m2,
+        xadj, adjncy, vw, ew,
+    )
+    if m < 0:
+        raise ValueError(f"malformed adjacency on node line {-m}")
+    if m != m2:
+        raise ValueError(f"header claims {m2} directed edges, file has {m}")
+    if m and (adjncy[:m].min() < 0 or adjncy[:m].max() >= n):
+        raise ValueError("neighbor id out of range")
+    return HostGraph(
+        xadj=xadj,
+        adjncy=adjncy[:m],
+        node_weights=vw if has_vw else None,
+        edge_weights=ew[:m] if has_ew else None,
+    )
 
 
 def write_metis(graph: HostGraph, path: str) -> None:

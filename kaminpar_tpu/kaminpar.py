@@ -47,10 +47,21 @@ class KaMinPar:
         self.output_level = OutputLevel.APPLICATION
 
     # -- graph ingestion (KaMinPar::borrow_and_mutate_graph / copy_graph) --
-    def set_graph(self, graph: HostGraph, validate: bool = False) -> "KaMinPar":
-        if validate:
-            validate_graph(graph)
-        self._graph = graph
+    def set_graph(self, graph, validate: bool = False) -> "KaMinPar":
+        """Accepts a HostGraph or a CompressedHostGraph (terapart mode).
+        With ctx.compression.enabled, plain graphs are stored compressed
+        (the Graph facade's CSR/compressed dispatch analog,
+        kaminpar-shm/datastructures/graph.h:24-62)."""
+        from .graphs.compressed import CompressedHostGraph, compress_host_graph
+
+        if isinstance(graph, CompressedHostGraph):
+            self._graph = graph
+        else:
+            if validate:
+                validate_graph(graph)
+            if self.ctx.compression.enabled:
+                graph = compress_host_graph(graph)
+            self._graph = graph
         return self
 
     def copy_graph(
@@ -88,7 +99,16 @@ class KaMinPar:
     ) -> np.ndarray:
         if self._graph is None:
             raise RuntimeError("no graph set; call set_graph() first")
+        from .graphs.compressed import CompressedHostGraph
+
         graph = self._graph
+        if isinstance(graph, CompressedHostGraph):
+            # memoize the decode: repeated compute_partition calls (seed/k
+            # sweeps) shouldn't re-pay the O(m) decompression
+            cached = getattr(self, "_decoded", None)
+            if cached is None or cached[0] is not graph:
+                self._decoded = (graph, graph.decode())
+            graph = self._decoded[1]
         ctx = self.ctx
         if seed is not None:
             ctx.seed = int(seed)

@@ -1,0 +1,61 @@
+"""Edge sparsification on device (linear-time MGP).
+
+Analog of the reference's SparsificationClusterCoarsener
+(kaminpar-shm/coarsening/sparsification_cluster_coarsener.cc, arXiv
+2504.17615): bounds per-level work by dropping a fraction of edges before
+clustering.  The TPU version flips one hashed coin per *undirected* edge
+(both directions share the hash of the unordered endpoint pair, so symmetry
+is preserved), turns dropped edges into inert pad edges, and rescales kept
+edge weights by 1/keep_ratio so expected cut weights are preserved — the
+unbiased-sparsifier trick the paper uses.
+
+Dropped edges keep their slots (static shapes); `row_ptr` degrees become
+upper bounds, which only affects the isolated-node heuristic, not
+correctness.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..graphs.csr import DeviceGraph
+from .segments import hash_u32
+
+
+@jax.jit
+def sparsify_edges(
+    graph: DeviceGraph, keep_ratio: jax.Array, salt: jax.Array
+) -> DeviceGraph:
+    """Drop each undirected edge with probability 1 - keep_ratio."""
+    n_pad = graph.n_pad
+    pad_node = n_pad - 1
+    lo = jnp.minimum(graph.src, graph.dst)
+    hi = jnp.maximum(graph.src, graph.dst)
+    h = hash_u32(lo * jnp.int32(1_000_003) + hi, salt)
+    ratio = keep_ratio.astype(jnp.float32)
+    # clamp below int32 max to a float32-representable bound so the cast
+    # cannot wrap; ratio >= 1 keeps everything exactly
+    thresh = jnp.minimum(ratio * 2147483647.0, 2147483392.0).astype(jnp.int32)
+    keep = (h < thresh) | (ratio >= 1.0)
+    is_real = graph.src < graph.n
+    drop = is_real & ~keep
+
+    scale = 1.0 / jnp.maximum(ratio, 1e-6)
+    new_w = jnp.clip(
+        jnp.round(graph.edge_w.astype(jnp.float32) * scale),
+        0,
+        2147483392.0,  # largest float32 below 2**31
+    ).astype(graph.edge_w.dtype)
+
+    return DeviceGraph(
+        row_ptr=graph.row_ptr,
+        src=jnp.where(drop, pad_node, graph.src),
+        dst=jnp.where(drop, pad_node, graph.dst),
+        edge_w=jnp.where(drop, 0, jnp.where(is_real, new_w, 0)),
+        node_w=graph.node_w,
+        n=graph.n,
+        m=graph.m,
+    )

@@ -74,9 +74,28 @@ class Coarsener:
         seed = jnp.int32(
             (self.ctx.seed * 7919 + self.level * 31337) & 0x7FFFFFFF
         )
+        from ..context import CoarseningAlgorithm
+
+        cluster_input = self.current
+        if (
+            c_ctx.algorithm == CoarseningAlgorithm.SPARSIFICATION_CLUSTERING
+            and int(self.current.m) > (1 << 16)
+        ):
+            # linear-time MGP: cluster on a sparsified copy to bound LP
+            # work, but contract the TRUE graph — the hierarchy must hold
+            # unmutated graphs (the reference likewise never sparsifies the
+            # input level, sparsification_cluster_coarsener.cc)
+            from ..ops.sparsify import sparsify_edges
+
+            with timer.scoped_timer("sparsification"):
+                cluster_input = sparsify_edges(
+                    self.current,
+                    jnp.float32(c_ctx.sparsification_keep_ratio),
+                    seed ^ jnp.int32(0x51A5),
+                )
         with timer.scoped_timer("lp-clustering"):
             labels = lp_cluster(
-                self.current,
+                cluster_input,
                 jnp.int32(min(max_cluster_weight, 2**31 - 1)),
                 seed,
                 self._lp_cfg,

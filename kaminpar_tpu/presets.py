@@ -128,6 +128,84 @@ def create_vcycle_context(restrict_refinement: bool = False) -> Context:
     return ctx
 
 
+def _terapartify(ctx: Context) -> Context:
+    """presets.cc terapartify_context: enable compressed-graph mode."""
+    ctx.compression.enabled = True
+    ctx.preset_name = "terapart"
+    return ctx
+
+
+def create_terapart_context() -> Context:
+    return _terapartify(create_default_context())
+
+
+def create_terapart_strong_context() -> Context:
+    ctx = _terapartify(create_strong_context())
+    ctx.preset_name = "terapart-strong"
+    return ctx
+
+
+def create_terapart_largek_context() -> Context:
+    ctx = _terapartify(create_largek_context())
+    ctx.preset_name = "terapart-largek"
+    ctx.coarsening.clustering.forced_kc_level = True
+    return ctx
+
+
+def create_esa21_smallk_context() -> Context:
+    """presets.cc create_esa21_smallk_context: the ESA'21 configuration.
+    The reference switches to BUFFERED contraction + single-phase LP; the
+    TPU kernels have one contraction and one LP implementation, so this is
+    the default pipeline under the historical name."""
+    ctx = create_default_context()
+    ctx.preset_name = "esa21-smallk"
+    return ctx
+
+
+def create_esa21_largek_context() -> Context:
+    ctx = create_esa21_smallk_context()
+    ctx.preset_name = "esa21-largek"
+    ctx.initial_partitioning.pool.min_num_repetitions = 4
+    ctx.initial_partitioning.pool.min_num_non_adaptive_repetitions = 2
+    ctx.initial_partitioning.pool.max_num_repetitions = 4
+    return ctx
+
+
+def create_esa21_largek_fast_context() -> Context:
+    ctx = create_esa21_largek_context()
+    ctx.preset_name = "esa21-largek-fast"
+    pool = ctx.initial_partitioning.pool
+    pool.min_num_repetitions = 2
+    pool.min_num_non_adaptive_repetitions = 1
+    pool.max_num_repetitions = 2
+    return ctx
+
+
+def create_esa21_strong_context() -> Context:
+    ctx = create_esa21_smallk_context()
+    ctx.preset_name = "esa21-strong"
+    ctx.refinement.algorithms = [
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+        RefinementAlgorithm.LABEL_PROPAGATION,
+        RefinementAlgorithm.GREEDY_FM,
+        RefinementAlgorithm.OVERLOAD_BALANCER,
+        RefinementAlgorithm.UNDERLOAD_BALANCER,
+    ]
+    return ctx
+
+
+def create_linear_time_kway_context() -> Context:
+    """presets.cc create_linear_time_kway_context: mtkahypar-kway with
+    sparsification clustering (linear-time MGP, arXiv 2504.17615)."""
+    from .context import CoarseningAlgorithm
+
+    ctx = create_mtkahypar_kway_context()
+    ctx.preset_name = "linear-time-kway"
+    ctx.coarsening.algorithm = CoarseningAlgorithm.SPARSIFICATION_CLUSTERING
+    return ctx
+
+
 def create_mtkahypar_kway_context() -> Context:
     """presets.cc:488-499: Mt-KaHyPar-style coarsening + direct k-way."""
     ctx = create_default_context()
@@ -151,12 +229,26 @@ _PRESETS = {
     "largek": create_largek_context,
     "largek-fast": create_largek_fast_context,
     "largek-strong": create_largek_strong_context,
+    "terapart": create_terapart_context,
+    "terapart-strong": create_terapart_strong_context,
+    "terapart-largek": create_terapart_largek_context,
     "jet": create_jet_context,
     "4xjet": lambda: create_jet_context(4),
     "noref": create_noref_context,
     "vcycle": lambda: create_vcycle_context(False),
     "restricted-vcycle": lambda: create_vcycle_context(True),
+    "esa21": create_esa21_smallk_context,
+    "esa21-smallk": create_esa21_smallk_context,
+    "esa21-largek": create_esa21_largek_context,
+    "esa21-largek-fast": create_esa21_largek_fast_context,
+    "esa21-strong": create_esa21_strong_context,
+    "diss": create_esa21_smallk_context,
+    "diss-smallk": create_esa21_smallk_context,
+    "diss-largek": create_esa21_largek_context,
+    "diss-largek-fast": create_esa21_largek_fast_context,
+    "diss-strong": create_esa21_strong_context,
     "mtkahypar-kway": create_mtkahypar_kway_context,
+    "linear-time-kway": create_linear_time_kway_context,
 }
 
 

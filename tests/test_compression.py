@@ -1,0 +1,106 @@
+"""Compressed-graph subsystem tests (the reference's
+tests/shm/datastructures/compressed_graph_test.cc checks compressed vs CSR
+equivalence; tests/common/ covers the varint codecs)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import native
+from kaminpar_tpu.graphs.compressed import compress_host_graph
+from kaminpar_tpu.graphs.factories import (
+    make_grid_graph,
+    make_isolated_graph,
+    make_rmat,
+    make_star,
+)
+
+
+@pytest.mark.parametrize(
+    "graph",
+    [
+        make_grid_graph(8, 8),
+        make_star(31),
+        make_rmat(256, 1024, seed=5),
+        make_isolated_graph(10),
+    ],
+    ids=["grid", "star", "rmat", "isolated"],
+)
+def test_compressed_equals_csr(graph):
+    cg = compress_host_graph(graph)
+    assert cg.n == graph.n and cg.m == graph.m
+    back = cg.decode()
+    assert (back.xadj == graph.xadj).all()
+    assert (back.adjncy == graph.adjncy).all()
+    for u in [0, graph.n // 2, graph.n - 1] if graph.n else []:
+        assert (cg.neighbors(u) == graph.neighbors(u)).all()
+
+
+def test_varint_codec_roundtrip_fuzz():
+    rng = np.random.default_rng(0)
+    for _ in range(5):
+        n = int(rng.integers(1, 50))
+        deg = rng.integers(0, 20, size=n)
+        xadj = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(deg, out=xadj[1:])
+        adjncy = np.sort(
+            rng.integers(0, max(1, 10 * n), size=int(xadj[-1])).astype(np.int32)
+        )
+        # per-node sorted neighborhoods
+        for u in range(n):
+            adjncy[xadj[u] : xadj[u + 1]] = np.sort(adjncy[xadj[u] : xadj[u + 1]])
+        data, off = native.encode_gaps(xadj, adjncy)
+        assert (native.decode_gaps(xadj, off, data) == adjncy).all()
+        # numpy fallback produces the identical stream
+        d2, o2 = native._encode_gaps_np(n, xadj, adjncy.astype(np.int32))
+        assert (d2 == data).all() and (o2 == off).all()
+
+
+def test_compression_saves_memory():
+    g = make_rmat(1 << 12, 1 << 15, seed=2)
+    cg = compress_host_graph(g)
+    assert cg.compression_ratio() > 1.5
+
+
+def test_compressed_binary_roundtrip(tmp_path):
+    from kaminpar_tpu.io import load_graph, write_compressed
+
+    g = make_rmat(512, 2048, seed=9)
+    cg = compress_host_graph(g)
+    path = str(tmp_path / "g.npz")
+    write_compressed(path, cg)
+    back = load_graph(path)  # auto-detects the compressed container
+    assert back.n == g.n and back.m == g.m
+    dec = back.decode()
+    assert (dec.adjncy == g.adjncy).all()
+
+
+def test_terapart_preset_partitions_compressed(rgg2d):
+    from kaminpar_tpu import KaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    part = (
+        KaMinPar("terapart")
+        .set_output_level(OutputLevel.QUIET)
+        .set_graph(rgg2d)
+        .compute_partition(k=8, epsilon=0.03, seed=0)
+    )
+    assert part.shape == (rgg2d.n,)
+    nw = rgg2d.node_weight_array()
+    bw = np.zeros(8, dtype=np.int64)
+    np.add.at(bw, part, nw)
+    cap = int(1.03 * np.ceil(nw.sum() / 8)) + int(nw.max())
+    assert (bw <= cap).all()
+
+
+def test_linear_time_kway_preset(rgg2d):
+    from kaminpar_tpu import KaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    part = (
+        KaMinPar("linear-time-kway")
+        .set_output_level(OutputLevel.QUIET)
+        .set_graph(rgg2d)
+        .compute_partition(k=4, epsilon=0.03, seed=0)
+    )
+    assert part.shape == (rgg2d.n,)
+    assert part.min() >= 0 and part.max() < 4
