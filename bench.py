@@ -54,10 +54,31 @@ def run_pipeline(host, graph, seed: int) -> int:
     return coarsener.current_n
 
 
+def _init_platform() -> str:
+    """Use the default (TPU/axon) backend; fall back to CPU when the chip
+    is unreachable so the bench always reports a number."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError as e:
+        import sys
+
+        print(f"bench: default backend unavailable ({e}); CPU fallback",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+        return jax.devices()[0].platform
+
+
 def main() -> None:
     import jax
 
     from kaminpar_tpu.graphs.csr import device_graph_from_host
+
+    _init_platform()
 
     host = build_graph()
     graph = device_graph_from_host(host)

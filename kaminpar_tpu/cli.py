@@ -156,6 +156,14 @@ def build_parser() -> argparse.ArgumentParser:
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
+        "-H", "--heap-profile", action="store_true",
+        help="profile host/device memory per phase (heap_profiler analog)",
+    )
+    p.add_argument(
+        "--statistics", action="store_true",
+        help="collect and print detailed statistics (IFSTATS analog)",
+    )
+    p.add_argument(
         "-m", "--mode", default=None,
         choices=[m.value for m in PartitioningMode],
         help="partitioning scheme override",
@@ -214,6 +222,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: need -k or -B/--max-block-weights", file=sys.stderr)
         return 1
 
+    from .utils import heap_profiler, statistics
+
+    if args.heap_profile:
+        heap_profiler.enable()
+    if args.statistics:
+        statistics.enable()
+
     t_io = time.perf_counter()
     graph = io_mod.load_graph(args.graph, fmt=args.format)
     io_s = time.perf_counter() - t_io
@@ -247,6 +262,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
     if args.timers and not args.quiet:
         print(timer.GLOBAL_TIMER.render())
+    if args.heap_profile and not args.quiet:
+        print(heap_profiler.render())
+    if args.statistics and not args.quiet:
+        print(statistics.render())
 
     if args.output:
         io_mod.write_partition(args.output, partition)

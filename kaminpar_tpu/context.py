@@ -119,6 +119,9 @@ class ClusteringContext:
     # terapart-largek: force an extra coarsening level at the k-contraction
     # boundary (presets.cc create_terapart_largek_context)
     forced_kc_level: bool = False
+    # overlay coarsening (OverlayClusterCoarsener): number of independent
+    # clusterings intersected per level
+    num_overlays: int = 2
 
 
 @dataclass

@@ -320,6 +320,24 @@ def extract_block_subgraphs(
 # ---------------------------------------------------------------------------
 
 
+def host_partition_metrics(graph: HostGraph, partition, k: int) -> dict:
+    """Cut / block weights / imbalance / feasibility on the host (the
+    numpy twin of ops/metrics; shared by the RESULT printer and the
+    partition-properties tool so the definitions cannot drift)."""
+    partition = np.asarray(partition)
+    src = graph.edge_sources()
+    ew = graph.edge_weight_array()
+    cut = int(ew[partition[src] != partition[graph.adjncy]].sum() // 2)
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, partition, graph.node_weight_array())
+    perfect = max(1, -(-graph.total_node_weight // max(k, 1)))
+    return {
+        "cut": cut,
+        "block_weights": bw,
+        "imbalance": bw.max() / perfect - 1.0 if k else 0.0,
+    }
+
+
 def contract_clustering_host(
     graph: HostGraph, labels: np.ndarray
 ) -> tuple[HostGraph, np.ndarray]:

@@ -127,8 +127,15 @@ class KaMinPar:
         self._validate_parameters()
         k = ctx.partition.k
 
+        from .utils import heap_profiler, statistics
+        from .utils.heap_profiler import scoped_heap_profiler
+
         timer.GLOBAL_TIMER.reset()
-        with timer.scoped_timer("partitioning"):
+        heap_profiler.reset()
+        statistics.reset()
+        with timer.scoped_timer("partitioning"), scoped_heap_profiler(
+            "partitioning"
+        ):
             # isolated-node preprocessing (kaminpar.cc:392-404)
             num_isolated = count_isolated_nodes(graph)
             if num_isolated and graph.n > num_isolated:
@@ -209,18 +216,14 @@ class KaMinPar:
 
     def _print_result(self, graph, partition) -> None:
         """Parseable RESULT line (kaminpar-shm/kaminpar.cc:48)."""
+        from .graphs.host import host_partition_metrics
+
         p = self.ctx.partition
-        src = graph.edge_sources()
-        ew = graph.edge_weight_array()
-        cut = int(ew[partition[src] != partition[graph.adjncy]].sum()) // 2
-        bw = np.zeros(p.k, dtype=np.int64)
-        np.add.at(bw, partition, graph.node_weight_array())
-        perfect = max(1, -(-p.total_node_weight // p.k))
-        imbalance = bw.max() / perfect - 1.0
-        feasible = bool((bw <= p.max_block_weights).all())
+        m = host_partition_metrics(graph, partition, p.k)
+        feasible = bool((m["block_weights"] <= p.max_block_weights).all())
         log(
-            f"RESULT cut={cut} imbalance={imbalance:.6f} feasible={int(feasible)} "
-            f"k={p.k}"
+            f"RESULT cut={m['cut']} imbalance={m['imbalance']:.6f} "
+            f"feasible={int(feasible)} k={p.k}"
         )
 
 

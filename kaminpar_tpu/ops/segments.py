@@ -234,6 +234,24 @@ def connection_to_label(
     return jnp.maximum(w_cur, 0)
 
 
+def combine_labels(l1: jax.Array, l2: jax.Array) -> jax.Array:
+    """Intersect two clusterings: nodes end up together iff they share a
+    cluster in BOTH inputs (the overlay/PASCO combination used by
+    OverlayClusterCoarsener, kaminpar-shm/coarsening/overlay_cluster_
+    coarsener.cc).  Returns labels whose values are node ids (the minimum
+    node id of each (l1, l2) group), same convention as lp_cluster."""
+    n = l1.shape[0]
+    node = jnp.arange(n, dtype=jnp.int32)
+    a, b, idx = lax.sort((l1, l2, node), num_keys=2)
+    prev_a = jnp.concatenate([jnp.array([-1], a.dtype), a[:-1]])
+    prev_b = jnp.concatenate([jnp.array([-1], b.dtype), b[:-1]])
+    is_new = (a != prev_a) | (b != prev_b)
+    gid = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+    leader = jax.ops.segment_min(idx, gid, num_segments=n)
+    out = jnp.zeros(n, dtype=jnp.int32).at[idx].set(leader[gid])
+    return out
+
+
 def compact_unique(labels: jax.Array, n_pad: int) -> Tuple[jax.Array, jax.Array]:
     """Remap arbitrary label values in [0, n_pad) to dense ids [0, c).
 

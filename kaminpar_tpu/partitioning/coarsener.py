@@ -93,13 +93,24 @@ class Coarsener:
                     jnp.float32(c_ctx.sparsification_keep_ratio),
                     seed ^ jnp.int32(0x51A5),
                 )
-        with timer.scoped_timer("lp-clustering"):
-            labels = lp_cluster(
-                cluster_input,
-                jnp.int32(min(max_cluster_weight, 2**31 - 1)),
-                seed,
-                self._lp_cfg,
-            )
+        mcw = jnp.int32(min(max_cluster_weight, 2**31 - 1))
+        if c_ctx.algorithm == CoarseningAlgorithm.OVERLAY_CLUSTERING:
+            # OverlayClusterCoarsener (PASCO): intersect several
+            # independent clusterings — nodes merge only when every
+            # clustering agrees, which guards quality on hard instances
+            from ..ops.segments import combine_labels
+
+            with timer.scoped_timer("lp-clustering"):
+                labels = None
+                for r in range(max(1, c_ctx.clustering.num_overlays)):
+                    li = lp_cluster(
+                        cluster_input, mcw, seed + jnp.int32(7 * r + 1),
+                        self._lp_cfg,
+                    )
+                    labels = li if labels is None else combine_labels(labels, li)
+        else:
+            with timer.scoped_timer("lp-clustering"):
+                labels = lp_cluster(cluster_input, mcw, seed, self._lp_cfg)
         with timer.scoped_timer("contraction"):
             coarse, c_n, c_m = contract_clustering(self.current, labels)
 

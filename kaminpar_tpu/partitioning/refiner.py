@@ -47,6 +47,8 @@ class RefinerPipeline:
         level: int = 0,
         num_levels: int = 1,
     ) -> jax.Array:
+        from ..utils import statistics
+
         k = self.k
         for i, algorithm in enumerate(self.ctx.refinement.algorithms):
             salt = jnp.int32((seed * 2654435761 + i * 40503 + level) & 0x7FFFFFFF)
@@ -108,6 +110,12 @@ class RefinerPipeline:
                     )
             else:
                 log_warning(f"unknown refinement algorithm: {algorithm}")
+            if statistics.enabled():
+                statistics.track(
+                    f"cut_after_{algorithm.value}",
+                    int(metrics.edge_cut(graph, partition)),
+                )
+                statistics.count(f"runs_{algorithm.value}")
         return partition
 
     def enforce_balance_host(

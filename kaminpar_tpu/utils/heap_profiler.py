@@ -1,0 +1,149 @@
+"""Scoped memory profiler (analog of kaminpar-common/heap_profiler.{h,cc}).
+
+The reference interposes malloc (libc_memory_override.cc) and prints a
+peak-memory tree per SCOPED_HEAP_PROFILER scope.  A Python/JAX process has
+two memory domains to track:
+
+  * host allocations — via tracemalloc (stdlib), scoped snapshots;
+  * device (HBM) allocations — via jax.local_devices()[0].memory_stats()
+    where the backend exposes them (TPU does; CPU returns None).
+
+Profiling is off unless enabled (the reference compiles it out unless
+KAMINPAR_ENABLE_HEAP_PROFILING); `enable()`/`disable()` toggle at runtime.
+"""
+
+from __future__ import annotations
+
+import tracemalloc
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+_enabled = False
+
+
+@dataclass
+class HeapNode:
+    name: str
+    peak_host_bytes: int = 0
+    peak_device_bytes: int = 0
+    count: int = 0
+    children: Dict[str, "HeapNode"] = field(default_factory=dict)
+
+    def child(self, name: str) -> "HeapNode":
+        node = self.children.get(name)
+        if node is None:
+            node = HeapNode(name)
+            self.children[name] = node
+        return node
+
+
+_root = HeapNode("root")
+_stack = [_root]
+
+
+def enable() -> None:
+    global _enabled
+    if not _enabled:
+        tracemalloc.start()
+        _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    if _enabled:
+        tracemalloc.stop()
+        _enabled = False
+
+
+def reset() -> None:
+    global _root, _stack
+    if len(_stack) > 1:
+        return  # same open-scope guard as the timer
+    _root = HeapNode("root")
+    _stack = [_root]
+
+
+def _device_peak_bytes() -> int:
+    """Process-lifetime device high-water mark where the backend exposes
+    it (TPU does via memory_stats; CPU returns 0)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            return int(
+                stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+            )
+    except Exception:
+        pass
+    return 0
+
+
+@contextmanager
+def scoped_heap_profiler(name: str):
+    """SCOPED_HEAP_PROFILER analog.
+
+    Host: records how far above the scope-entry allocation level the
+    traced peak rises while the scope is open (no reset_peak, so nested
+    scopes don't clobber their parents' tracking).  Device: records the
+    increase of the backend's lifetime high-water mark during the scope —
+    if the scope stays below an earlier process-wide peak this reads 0,
+    an inherent limit of peak-only counters."""
+    if not _enabled:
+        yield
+        return
+    node = _stack[-1].child(name)
+    _stack.append(node)
+    cur0, peak0 = tracemalloc.get_traced_memory()
+    dev_peak0 = _device_peak_bytes()
+    try:
+        yield
+    finally:
+        _, peak1 = tracemalloc.get_traced_memory()
+        if peak1 > peak0:  # a new high-water mark was set inside the scope
+            node.peak_host_bytes = max(node.peak_host_bytes, peak1 - cur0)
+        node.peak_device_bytes = max(
+            node.peak_device_bytes, _device_peak_bytes() - dev_peak0
+        )
+        node.count += 1
+        _stack.pop()
+
+
+def record(name: str, nbytes: int) -> None:
+    """RECORD("name") analog: annotate a data structure's footprint."""
+    if not _enabled:
+        return
+    node = _stack[-1].child(name)
+    node.peak_host_bytes = max(node.peak_host_bytes, int(nbytes))
+    node.count += 1
+
+
+def _fmt(nbytes: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(nbytes) < 1024:
+            return f"{nbytes:.0f} {unit}"
+        nbytes /= 1024
+    return f"{nbytes:.1f} TiB"
+
+
+def render() -> str:
+    """PRINT_HEAP_PROFILE analog."""
+    lines = []
+
+    def rec(node: HeapNode, depth: int) -> None:
+        if depth > 0:
+            extra = (
+                f", device {_fmt(node.peak_device_bytes)}"
+                if node.peak_device_bytes
+                else ""
+            )
+            lines.append(
+                f"{'  ' * depth}{node.name}: peak {_fmt(node.peak_host_bytes)}"
+                f"{extra}"
+            )
+        for child in node.children.values():
+            rec(child, depth + 1)
+
+    rec(_root, 0)
+    return "\n".join(lines) if lines else "(heap profiler: no scopes recorded)"

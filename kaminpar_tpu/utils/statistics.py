@@ -1,0 +1,68 @@
+"""Statistics counters (analog of KAMINPAR_ENABLE_STATISTICS / IFSTATS).
+
+The reference gates detailed per-phase statistics behind a compile flag
+(e.g. label_propagation.h:87,538, refinement/fm/batch_stats.cc).  Here a
+process-global registry of named counters/series is toggled at runtime;
+disabled stats are near-free.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List
+
+_enabled = False
+_counters: Dict[str, int] = defaultdict(int)
+_series: Dict[str, List[float]] = defaultdict(list)
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    _counters.clear()
+    _series.clear()
+
+
+def count(name: str, delta: int = 1) -> None:
+    """IFSTATS(counter++) analog."""
+    if _enabled:
+        _counters[name] += delta
+
+
+def track(name: str, value: float) -> None:
+    """Append to a named series (per-round cuts, move counts, ...)."""
+    if _enabled:
+        _series[name].append(float(value))
+
+
+def get(name: str) -> int:
+    return _counters.get(name, 0)
+
+
+def series(name: str) -> List[float]:
+    return list(_series.get(name, []))
+
+
+def render() -> str:
+    lines = ["STATS"]
+    for name in sorted(_counters):
+        lines.append(f"  {name}={_counters[name]}")
+    for name in sorted(_series):
+        vals = _series[name]
+        lines.append(
+            f"  {name}: n={len(vals)} last={vals[-1]:g} "
+            f"min={min(vals):g} max={max(vals):g}"
+        )
+    return "\n".join(lines)

@@ -91,3 +91,39 @@ def test_edge_weight_conservation():
     h = host_graph_from_device(cg.graph)
     assert h.total_edge_weight == inter
     assert int(h.node_weight_array().sum()) == g.n
+
+
+def test_combine_labels_intersection():
+    """overlay combination: together iff together in BOTH clusterings."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaminpar_tpu.ops.segments import combine_labels
+
+    l1 = jnp.asarray(np.array([0, 0, 0, 3, 3, 3, 6, 6], dtype=np.int32))
+    l2 = jnp.asarray(np.array([0, 0, 2, 2, 4, 4, 6, 7], dtype=np.int32))
+    out = np.asarray(combine_labels(l1, l2))
+    # groups: {0,1},{2},{3},{4,5},{6},{7}
+    assert out[0] == out[1]
+    assert len({out[2], out[3], out[4], out[6], out[7], out[0]}) == 6
+    assert out[4] == out[5]
+    # leaders are min node ids
+    assert out[0] == 0 and out[4] == 4
+
+
+def test_overlay_preset_partitions(rgg2d):
+    from kaminpar_tpu import KaMinPar
+    from kaminpar_tpu.context import CoarseningAlgorithm
+    from kaminpar_tpu.presets import create_context_by_preset_name
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.algorithm = CoarseningAlgorithm.OVERLAY_CLUSTERING
+    part = (
+        KaMinPar(ctx)
+        .set_output_level(OutputLevel.QUIET)
+        .set_graph(rgg2d)
+        .compute_partition(k=4, epsilon=0.03, seed=0)
+    )
+    assert part.shape == (rgg2d.n,)
+    assert part.min() >= 0 and part.max() < 4

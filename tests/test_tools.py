@@ -1,0 +1,86 @@
+"""Tool subcommand + aux subsystem tests (apps/tools analogs)."""
+
+import numpy as np
+
+from kaminpar_tpu.tools import main as tools_main
+
+RGG = "/root/reference/misc/rgg2d.metis"
+
+
+def test_properties(capfd):
+    assert tools_main(["properties", RGG]) == 0
+    out = capfd.readouterr().out
+    assert "n=1024 m=4113" in out
+    assert "isolated_nodes=2" in out  # rgg2d ships 2 isolated nodes
+
+
+def test_partition_properties(tmp_path, capfd):
+    part = tmp_path / "p.txt"
+    np.savetxt(part, np.arange(1024) % 4, fmt="%d")
+    assert tools_main(["partition-properties", RGG, str(part)]) == 0
+    out = capfd.readouterr().out
+    assert "k=4 cut=" in out
+
+
+def test_compress_decompress_roundtrip(tmp_path, capfd):
+    comp = tmp_path / "g.npz"
+    back = tmp_path / "g.metis"
+    assert tools_main(["compress", RGG, "-o", str(comp)]) == 0
+    assert tools_main(["decompress", str(comp), "-o", str(back)]) == 0
+    from kaminpar_tpu.io import load_graph
+
+    a = load_graph(RGG)
+    b = load_graph(str(back))
+    # compression sorts neighborhoods; compare canonical forms
+    assert (a.xadj == b.xadj).all()
+    for u in range(a.n):
+        assert (np.sort(a.neighbors(u)) == np.sort(b.neighbors(u))).all()
+
+
+def test_rearrange_preserves_structure(tmp_path):
+    out = tmp_path / "r.metis"
+    assert tools_main(["rearrange", RGG, "-o", str(out)]) == 0
+    from kaminpar_tpu.io import load_graph
+
+    a = load_graph(RGG)
+    b = load_graph(str(out))
+    assert a.n == b.n and a.m == b.m
+    # degree multiset preserved
+    assert sorted(a.degrees()) == sorted(b.degrees())
+
+
+def test_components_tool(capfd):
+    assert tools_main(["components", RGG]) == 0
+    out = capfd.readouterr().out
+    assert "components=" in out
+
+
+def test_components_kernel_matches_host():
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.graphs.csr import device_graph_from_host
+    from kaminpar_tpu.graphs.factories import make_grid_graph, make_matching_graph
+    from kaminpar_tpu.ops.components import count_components
+
+    g = make_grid_graph(8, 8)
+    assert count_components(device_graph_from_host(g)) == 1
+    g2 = make_matching_graph(10)  # 10 disjoint edges
+    assert count_components(device_graph_from_host(g2)) == 10
+
+
+def test_heap_profiler_and_statistics(capfd):
+    from kaminpar_tpu.cli import main as cli_main
+    from kaminpar_tpu.utils import heap_profiler, statistics
+
+    try:
+        rc = cli_main([RGG, "-k", "2", "-H", "--statistics"])
+        assert rc == 0
+        out = capfd.readouterr().out
+        assert "partitioning: peak" in out
+        assert "STATS" in out
+        assert "cut_after_lp" in out
+    finally:
+        heap_profiler.disable()
+        heap_profiler.reset()
+        statistics.disable()
+        statistics.reset()
