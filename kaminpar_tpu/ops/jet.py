@@ -142,8 +142,19 @@ def _jet_refine_impl(
     balancer_rounds: int,
 ) -> jax.Array:
     part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
+
+    def is_feasible(part):
+        bw = jax.ops.segment_sum(
+            graph.node_w.astype(ACC_DTYPE), part, num_segments=k
+        )
+        return jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
+
+    # snapshots track the best FEASIBLE cut; an infeasible input (e.g.
+    # everything in one block, cut 0) must not pin the snapshot
     best0 = part0
-    best_cut0 = edge_cut(graph, part0)
+    best_cut0 = jnp.where(
+        is_feasible(part0), edge_cut(graph, part0), jnp.iinfo(jnp.int32).max
+    )
 
     def round_body(rnd, carry):
         part, best, best_cut = carry
@@ -178,11 +189,11 @@ def _jet_refine_impl(
             cut = edge_cut(graph, part)
             improved_enough = (best_cut - cut).astype(jnp.float32) > (
                 1.0 - fruitless_threshold
-            ) * best_cut.astype(jnp.float32)
+            ) * jnp.abs(best_cut).astype(jnp.float32)
             fruitless = jnp.where(improved_enough, 0, fruitless + 1)
-            is_best = cut <= best_cut
+            is_best = (cut <= best_cut) & is_feasible(part)
             best = jnp.where(is_best, part, best)
-            best_cut = jnp.minimum(best_cut, cut)
+            best_cut = jnp.where(is_best, cut, best_cut)
             return (i + 1, fruitless, part, lock, best, best_cut, is_best)
 
         lock0 = jnp.zeros(graph.n_pad, dtype=jnp.int32)

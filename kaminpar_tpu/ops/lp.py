@@ -76,6 +76,10 @@ class LPConfig:
     cluster_isolated: bool = True
     # refinement mode: labels are blocks, moves need positive gain
     refinement: bool = False
+    # distributed-only: restrict joins to clusters owned by the same device
+    # (LocalLPClusterer analog, kaminpar-dist/.../local_lp_clusterer.cc —
+    # no cross-PE clusters, so contraction needs no label migration)
+    dist_local_only: bool = False
 
 
 def lp_round(

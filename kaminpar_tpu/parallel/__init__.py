@@ -10,8 +10,22 @@ control and cut reduction, `all_gather` for label/ghost synchronization.
 
 from .mesh import make_mesh, NODE_AXIS
 from .dist_graph import DistGraph, dist_graph_from_host
-from .dist_lp import dist_lp_cluster, dist_lp_refine
+from .dist_lp import dist_lp_cluster, dist_lp_cluster_from, dist_lp_refine
 from .dist_metrics import dist_edge_cut
+from .dist_coloring import dist_greedy_coloring
+from .dist_clp import dist_colored_lp_refine
+from .dist_balancer import dist_node_balance
+from .dist_jet import dist_jet_refine
+from .dist_hem import dist_hem_cluster, dist_hem_lp_cluster
+from .dist_context import (
+    DistContext,
+    DistClusteringAlgorithm,
+    DistRefinementAlgorithm,
+    create_dist_context_by_preset_name,
+    create_dist_clusterer,
+    create_dist_refiner,
+    get_dist_preset_names,
+)
 from .dist_partitioner import dKaMinPar
 
 __all__ = [
@@ -20,7 +34,21 @@ __all__ = [
     "DistGraph",
     "dist_graph_from_host",
     "dist_lp_cluster",
+    "dist_lp_cluster_from",
     "dist_lp_refine",
     "dist_edge_cut",
+    "dist_greedy_coloring",
+    "dist_colored_lp_refine",
+    "dist_node_balance",
+    "dist_jet_refine",
+    "dist_hem_cluster",
+    "dist_hem_lp_cluster",
+    "DistContext",
+    "DistClusteringAlgorithm",
+    "DistRefinementAlgorithm",
+    "create_dist_context_by_preset_name",
+    "create_dist_clusterer",
+    "create_dist_refiner",
+    "get_dist_preset_names",
     "dKaMinPar",
 ]

@@ -1,0 +1,173 @@
+"""Distributed greedy node balancer over the device mesh.
+
+Analog of the reference's NodeBalancer
+(kaminpar-dist/refinement/balancer/node_balancer.cc): overloaded blocks
+shed their lowest-loss border nodes into blocks with headroom until the
+partition is feasible.  The reference merges per-PE candidate priority
+queues through a binary reduction tree (balancer/reductions.h) and picks
+moves on rank 0; the TPU version exploits that every device can afford the
+whole O(n) candidate vector: local shards rate their own nodes, one
+`all_gather` replicates the candidate set, and the capacity-respecting
+prefix pass (ops/segments.accept_prefix_by_capacity) — computed identically
+on every device — replaces the reduction tree.  One round is therefore two
+collectives (candidate all_gather + block-weight psum) instead of the
+reference's log-P reduction + broadcast.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..ops.segments import (
+    ACC_DTYPE,
+    accept_prefix_by_capacity,
+    aggregate_by_key,
+    argmax_per_segment,
+    connection_to_label,
+)
+from .dist_graph import DistGraph
+from .mesh import NODE_AXIS
+
+
+def _relative_gain_key(gain: jax.Array, weight: jax.Array) -> jax.Array:
+    """compute_relative_gain surrogate (relative_gain.h); see
+    ops/balancer.py."""
+    w = jnp.maximum(weight.astype(jnp.float32), 1.0)
+    g = gain.astype(jnp.float32)
+    return jnp.where(g > 0, g * w, g / w)
+
+
+def dist_balance_round(
+    src_l, dst_l, ew_l, nw_l, n, part, k, cap, salt
+) -> Tuple[jax.Array, jax.Array]:
+    """One balancing round, executed per device inside shard_map.
+
+    `part` is the replicated i32[n_pad] partition; returns the new
+    replicated partition and the global number of moved nodes."""
+    n_loc = nw_l.shape[0]
+    n_pad = part.shape[0]
+    d = lax.axis_index(NODE_AXIS)
+    offset = (d * n_loc).astype(jnp.int32)
+    node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
+    seg = src_l - offset
+    part_l = lax.dynamic_slice(part, (offset,), (n_loc,))
+
+    bw = lax.psum(
+        jax.ops.segment_sum(
+            nw_l.astype(ACC_DTYPE), jnp.clip(part_l, 0, k - 1), num_segments=k
+        ),
+        NODE_AXIS,
+    )
+    overload = jnp.maximum(bw - cap, 0)
+    headroom = jnp.maximum(cap - bw, 0)
+
+    in_overloaded = (overload[jnp.clip(part_l, 0, k - 1)] > 0) & (
+        node_ids_l < n
+    )
+
+    # local candidate rating (node_balancer.cc: highest relative gain into a
+    # non-overloaded block with room)
+    neigh_block = part[dst_l]
+    seg_g, key_g, w_g = aggregate_by_key(seg, neigh_block, ew_l)
+    key_c = jnp.clip(key_g, 0, k - 1)
+    seg_c = jnp.clip(seg_g, 0, n_loc - 1)
+    tgt_ok = (
+        (seg_g >= 0)
+        & (key_g != part_l[seg_c])
+        & (overload[key_c] == 0)
+        & (nw_l[seg_c].astype(ACC_DTYPE) <= headroom[key_c])
+    )
+    best, best_w = argmax_per_segment(
+        seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=tgt_ok
+    )
+    w_own = connection_to_label(seg_g, key_g, w_g, part_l, n_loc)
+
+    fallback = jnp.argmax(headroom).astype(jnp.int32)
+    fallback_ok = nw_l.astype(ACC_DTYPE) <= headroom[fallback]
+    use_fallback = (best < 0) & fallback_ok
+    target_l = jnp.where(use_fallback, fallback, best)
+    gain_l = jnp.where(use_fallback, -w_own, best_w - w_own)
+    mover_l = in_overloaded & (target_l >= 0)
+    target_l = jnp.where(mover_l, target_l, -1)
+
+    # replicate the candidate set; every device runs the identical
+    # deterministic commit (the reduction-tree replacement)
+    target = lax.all_gather(target_l, NODE_AXIS, tiled=True)
+    gain = lax.all_gather(gain_l, NODE_AXIS, tiled=True)
+    nw = lax.all_gather(nw_l, NODE_AXIS, tiled=True)
+
+    order_key = -_relative_gain_key(gain, nw)
+    src_block = jnp.where(target >= 0, jnp.clip(part, 0, k - 1), -1)
+    accept_out = accept_prefix_by_capacity(
+        src_block, order_key, nw, overload, reach=True
+    )
+    target2 = jnp.where(accept_out, target, -1)
+    accept_in = accept_prefix_by_capacity(target2, order_key, nw, headroom)
+    accept = accept_out & accept_in
+
+    new_part = jnp.where(accept, jnp.clip(target, 0, k - 1), part)
+    return new_part, jnp.sum(accept.astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "max_rounds"))
+def _dist_node_balance_impl(mesh, graph, partition, k, cap, seed, max_rounds):
+    def per_device(src_l, dst_l, ew_l, nw_l, n, part0, cap, seed):
+        def cond(state):
+            i, part, moved = state
+            return (i < max_rounds) & (moved != 0)
+
+        def body(state):
+            i, part, _ = state
+            salt = (seed.astype(jnp.int32) * 62089911 + i * 7919) & 0x7FFFFFFF
+            part, moved = dist_balance_round(
+                src_l, dst_l, ew_l, nw_l, n, part, k, cap, salt
+            )
+            return (i + 1, part, moved)
+
+        _, part, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), part0, jnp.int32(1))
+        )
+        return part
+
+    return _shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(NODE_AXIS),) * 4 + (P(),) * 4,
+        out_specs=P(),
+        check_vma=False,
+    )(
+        graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
+        partition, cap, seed,
+    )
+
+
+def dist_node_balance(
+    graph: DistGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights,
+    seed,
+    max_rounds: int = 16,
+) -> jax.Array:
+    """Balance an infeasible partition on the mesh (NodeBalancer analog).
+    Returns the replicated balanced partition."""
+    return _dist_node_balance_impl(
+        graph.src.sharding.mesh,
+        graph,
+        jnp.asarray(partition, jnp.int32),
+        k,
+        jnp.asarray(max_block_weights, ACC_DTYPE),
+        jnp.asarray(seed),
+        max_rounds,
+    )

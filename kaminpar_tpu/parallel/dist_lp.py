@@ -66,6 +66,7 @@ def _dist_lp_round(
     weights: jax.Array,
     cap: jax.Array,
     active_l: jax.Array,
+    movable_l: jax.Array,
     salt: jax.Array,
     cfg: LPConfig,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
@@ -96,6 +97,11 @@ def _dist_lp_round(
     )
     is_current = key_g == labels_l[seg_c]
     feasible = (seg_g >= 0) & (is_current | fits)
+    if cfg.dist_local_only:
+        # LocalLPClusterer semantics: only join clusters led by an owned
+        # node, so clusters never span device boundaries
+        owned = (key_g >= offset) & (key_g < offset + n_loc)
+        feasible = feasible & (is_current | owned)
     best, best_w = argmax_per_segment(
         seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=feasible
     )
@@ -118,6 +124,7 @@ def _dist_lp_round(
         & (best != labels_l)
         & improves
         & active_l
+        & movable_l
         & (node_ids_l < n)
     )
     target_l = jnp.where(wants & participate, best, -1)
@@ -177,10 +184,22 @@ def _dist_lp_loop(
     seed: jax.Array,
     cfg: LPConfig,
     iters: int,
+    movable: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """shard_map'd multi-round loop; returns replicated labels [n_pad]."""
+    """shard_map'd multi-round loop; returns replicated labels [n_pad].
 
-    def per_device(src_l, dst_l, ew_l, nw_l, n, labels0, weights0, cap, seed):
+    `movable` (replicated bool[n_pad], optional) freezes nodes where False
+    — used by the HEM+LP hybrid to pin matched pairs."""
+    if movable is None:
+        movable = jnp.ones(graph.n_pad, dtype=bool)
+
+    def per_device(src_l, dst_l, ew_l, nw_l, n, labels0, weights0, cap,
+                   seed, movable):
+        n_loc = nw_l.shape[0]
+        d = lax.axis_index(NODE_AXIS)
+        offset = (d * n_loc).astype(jnp.int32)
+        movable_l = lax.dynamic_slice(movable, (offset,), (n_loc,))
+
         def cond(state):
             i, _, _, _, moved = state
             return (i < iters) & (moved != 0)
@@ -190,11 +209,11 @@ def _dist_lp_loop(
             salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
             labels, weights, active_l, moved = _dist_lp_round(
                 src_l, dst_l, ew_l, nw_l, n, labels, weights, cap,
-                active_l, salt, cfg,
+                active_l, movable_l, salt, cfg,
             )
             return (i + 1, labels, weights, active_l, moved)
 
-        active0 = jnp.ones(nw_l.shape[0], dtype=bool)
+        active0 = jnp.ones(n_loc, dtype=bool)
         init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1))
         _, labels, _, _, _ = lax.while_loop(cond, body, init)
         return labels
@@ -204,14 +223,14 @@ def _dist_lp_loop(
         mesh=mesh,
         in_specs=(
             P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
-            P(), P(), P(), P(), P(),
+            P(), P(), P(), P(), P(), P(),
         ),
         out_specs=P(),
         check_vma=False,
     )
     return mapped(
         graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
-        labels0, weights0, cap, seed,
+        labels0, weights0, cap, seed, movable,
     )
 
 
@@ -242,6 +261,45 @@ def dist_lp_cluster(
     return _dist_lp_cluster_impl(
         graph.src.sharding.mesh, graph, jnp.asarray(max_cluster_weight),
         jnp.asarray(seed), cfg, num_iterations,
+    )
+
+
+@partial(jax.jit, static_argnames=("mesh", "cfg", "num_iterations"))
+def _dist_lp_cluster_from_impl(mesh, graph, labels0, movable,
+                               max_cluster_weight, seed, cfg,
+                               num_iterations):
+    n_pad = graph.n_pad
+    labels0 = jnp.asarray(labels0, jnp.int32)
+    weights0 = jax.ops.segment_sum(
+        graph.node_w.astype(ACC_DTYPE),
+        jnp.clip(labels0, 0, n_pad - 1),
+        num_segments=n_pad,
+    ).astype(jnp.int32)
+    cap = jnp.broadcast_to(
+        jnp.asarray(max_cluster_weight, ACC_DTYPE), (n_pad,)
+    )
+    iters = num_iterations if num_iterations is not None else cfg.num_iterations
+    return _dist_lp_loop(
+        mesh, graph, labels0, weights0, cap, seed, cfg, iters,
+        movable=movable,
+    )
+
+
+def dist_lp_cluster_from(
+    graph: DistGraph,
+    labels0: jax.Array,
+    movable: jax.Array,
+    max_cluster_weight,
+    seed,
+    cfg: LPConfig = LPConfig(),
+    num_iterations: Optional[int] = None,
+) -> jax.Array:
+    """LP clustering from a given initial clustering with frozen nodes
+    (`movable == False`).  Used by the HEM+LP hybrid clusterer."""
+    return _dist_lp_cluster_from_impl(
+        graph.src.sharding.mesh, graph, labels0, movable,
+        jnp.asarray(max_cluster_weight), jnp.asarray(seed), cfg,
+        num_iterations,
     )
 
 

@@ -35,11 +35,15 @@ from jax.sharding import Mesh
 
 from ..context import Context
 from ..graphs.host import HostGraph, contract_clustering_host
-from ..presets import create_context_by_preset_name
 from ..utils import timer
 from ..utils.logger import log
+from .dist_context import (
+    DistContext,
+    create_dist_clusterer,
+    create_dist_context_by_preset_name,
+    create_dist_refiner,
+)
 from .dist_graph import DistGraph, dist_graph_from_host
-from .dist_lp import dist_lp_cluster, dist_lp_refine
 from .dist_metrics import dist_edge_cut
 from .mesh import make_mesh
 
@@ -50,14 +54,16 @@ class dKaMinPar:
 
     def __init__(
         self,
-        ctx: Union[Context, str, None] = None,
+        ctx: Union[DistContext, Context, str, None] = None,
         mesh: Optional[Mesh] = None,
         n_devices: Optional[int] = None,
     ):
         if ctx is None:
-            ctx = create_context_by_preset_name("default")
+            ctx = create_dist_context_by_preset_name("default")
         elif isinstance(ctx, str):
-            ctx = create_context_by_preset_name(ctx)
+            ctx = create_dist_context_by_preset_name(ctx)
+        elif isinstance(ctx, Context):  # shm context: wrap (legacy surface)
+            ctx = DistContext(shm=ctx)
         self.ctx = ctx
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self._graph: Optional[HostGraph] = None
@@ -105,6 +111,8 @@ class dKaMinPar:
         ctx = self.ctx
         c_ctx = ctx.coarsening
         total_node_weight = ctx.partition.total_node_weight
+        clusterer = create_dist_clusterer(ctx)
+        refiner = create_dist_refiner(ctx)
 
         # coarsening (deep_multilevel.cc:75-118 analog)
         levels: List[Tuple[DistGraph, np.ndarray, HostGraph]] = []
@@ -121,9 +129,7 @@ class dKaMinPar:
                 )
                 lvl_seed = (ctx.seed * 7919 + len(levels) * 31337) & 0x7FFFFFFF
                 labels = np.asarray(
-                    dist_lp_cluster(
-                        dg, min(mcw, 2**31 - 1), jnp.int32(lvl_seed)
-                    )
+                    clusterer(dg, min(mcw, 2**31 - 1), jnp.int32(lvl_seed))
                 )
                 coarse, cmap = contract_clustering_host(current, labels)
                 if coarse.n >= (1.0 - c_ctx.convergence_threshold) * current.n:
@@ -137,7 +143,7 @@ class dKaMinPar:
             from ..kaminpar import KaMinPar
             from ..utils.logger import OutputLevel, output_level, set_output_level
 
-            shm_ctx = self.ctx.copy()
+            shm_ctx = self.ctx.shm.copy()
             shm = KaMinPar(shm_ctx)
             # quiet the nested shm run without leaking the process-global
             # logger level past this scope
@@ -157,6 +163,7 @@ class dKaMinPar:
         max_bw = jnp.asarray(
             self.ctx.partition.max_block_weights, dtype=jnp.int32
         )
+        num_levels = len(levels)
         with timer.scoped_timer("dist-uncoarsening"):
             for level_idx, (dg, cmap, fine_host) in enumerate(
                 reversed(levels)
@@ -164,12 +171,13 @@ class dKaMinPar:
                 partition = partition[cmap]  # project up
                 full = np.zeros(dg.n_pad, dtype=np.int32)
                 full[: fine_host.n] = partition
-                refined = dist_lp_refine(
+                refined = refiner(
                     dg,
                     jnp.asarray(full),
                     k,
                     max_bw,
-                    jnp.int32((self.ctx.seed * 92821 + level_idx) & 0x7FFFFFFF),
+                    (self.ctx.seed * 92821 + level_idx) & 0x7FFFFFFF,
+                    level=num_levels - 1 - level_idx,
                 )
                 partition = np.asarray(refined)[: fine_host.n]
         return partition

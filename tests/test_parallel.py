@@ -153,3 +153,166 @@ def test_dkaminpar_end_to_end():
 
     # same algorithm family; allow slack for the different commit protocol
     assert dcut <= 3 * scut + 16
+
+
+# -- dist parity components (coloring, colored LP, Jet, balancer, HEM) ----
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_dist_coloring_is_valid(n_devices):
+    from kaminpar_tpu.parallel import dist_greedy_coloring
+
+    graph = make_grid_graph(20, 20)
+    mesh = make_mesh(n_devices)
+    dg = dist_graph_from_host(graph, mesh)
+    colors, nc = dist_greedy_coloring(dg, seed=5)
+    colors, nc = np.asarray(colors), int(nc)
+    src, dst = graph.edge_sources(), graph.adjncy
+    assert (colors[src] != colors[dst]).all()
+    assert (colors[: graph.n] >= 0).all()
+    # greedy coloring of a grid (max degree 4) should use few colors
+    assert nc <= 16
+
+
+def test_dist_colored_lp_improves_cut_under_caps():
+    from kaminpar_tpu.parallel import dist_colored_lp_refine
+
+    graph = make_grid_graph(24, 24)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    k = 4
+    rng = np.random.default_rng(0)
+    part = np.zeros(dg.n_pad, np.int32)
+    part[: graph.n] = rng.integers(0, k, graph.n)
+    nw = graph.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * 1.1))
+    caps = jnp.full((k,), cap, jnp.int32)
+    cut0 = int(dist_edge_cut(dg, jnp.asarray(part)))
+    ref = np.asarray(
+        dist_colored_lp_refine(dg, jnp.asarray(part), k, caps, 11)
+    )
+    cut1 = int(dist_edge_cut(dg, jnp.asarray(ref)))
+    bw = np.bincount(ref[: graph.n], weights=nw, minlength=k)
+    assert cut1 <= cut0
+    assert bw.max() <= cap
+
+
+def test_dist_node_balancer_restores_feasibility():
+    from kaminpar_tpu.parallel import dist_node_balance
+
+    graph = make_grid_graph(24, 24)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    k = 4
+    nw = graph.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * 1.05))
+    caps = jnp.full((k,), cap, jnp.int32)
+    part = np.zeros(dg.n_pad, np.int32)  # everything in block 0
+    bal = np.asarray(dist_node_balance(dg, jnp.asarray(part), k, caps, 5))
+    bw = np.bincount(bal[: graph.n], weights=nw, minlength=k)
+    assert bw.max() <= cap
+
+
+def test_dist_jet_beats_batched_lp_start():
+    from kaminpar_tpu.parallel import dist_jet_refine
+
+    graph = make_grid_graph(24, 24)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    k = 4
+    rng = np.random.default_rng(1)
+    part = np.zeros(dg.n_pad, np.int32)
+    part[: graph.n] = rng.integers(0, k, graph.n)
+    nw = graph.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * 1.1))
+    caps = jnp.full((k,), cap, jnp.int32)
+    cut0 = int(dist_edge_cut(dg, jnp.asarray(part)))
+    ref = np.asarray(dist_jet_refine(dg, jnp.asarray(part), k, caps, 13))
+    cut1 = int(dist_edge_cut(dg, jnp.asarray(ref)))
+    bw = np.bincount(ref[: graph.n], weights=nw, minlength=k)
+    assert cut1 < cut0
+    assert bw.max() <= cap
+
+
+def test_dist_hem_is_a_matching_on_edges():
+    from kaminpar_tpu.parallel import dist_hem_cluster
+
+    graph = make_grid_graph(16, 16)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    nw = graph.node_weight_array()
+    cap = int(nw.sum())
+    lab = np.asarray(dist_hem_cluster(dg, cap, seed=5))[: graph.n]
+    sizes = np.bincount(lab, minlength=graph.n)
+    assert sizes.max() <= 2  # matching: clusters of at most two nodes
+    eset = set(zip(graph.edge_sources().tolist(), graph.adjncy.tolist()))
+    for u in range(graph.n):
+        if lab[u] != u:
+            assert (u, lab[u]) in eset  # pairs are real edges
+    # a grid has a near-perfect matching; handshaking should find most
+    assert (sizes == 2).sum() >= graph.n // 4
+
+
+def test_dist_hem_lp_coarsens_further_than_hem():
+    from kaminpar_tpu.parallel import dist_hem_cluster, dist_hem_lp_cluster
+
+    graph = make_grid_graph(16, 16)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    cap = 32
+    hem = np.asarray(dist_hem_cluster(dg, cap, seed=5))[: graph.n]
+    hemlp = np.asarray(dist_hem_lp_cluster(dg, cap, seed=5))[: graph.n]
+    assert len(np.unique(hemlp)) <= len(np.unique(hem))
+    nw = graph.node_weight_array()
+    cw = np.bincount(hemlp, weights=nw, minlength=graph.n)
+    assert cw.max() <= cap
+
+
+def test_dist_local_lp_keeps_clusters_on_device():
+    from kaminpar_tpu.ops.lp import LPConfig
+
+    graph = make_grid_graph(16, 16)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    labels = np.asarray(
+        dist_lp_cluster(dg, 32, seed=7, cfg=LPConfig(dist_local_only=True))
+    )[: graph.n]
+    n_loc = dg.n_pad // 4
+    owner_of_label = labels // n_loc
+    owner_of_node = np.arange(graph.n) // n_loc
+    assert (owner_of_label == owner_of_node).all()
+
+
+def test_dist_presets_and_factories():
+    from kaminpar_tpu.parallel import (
+        create_dist_context_by_preset_name,
+        get_dist_preset_names,
+    )
+
+    names = get_dist_preset_names()
+    for expected in (
+        "default", "strong", "largek", "xterapart",
+        "europar23-fast", "europar23-strong",
+    ):
+        assert expected in names
+    for name in names:
+        ctx = create_dist_context_by_preset_name(name)
+        assert ctx.shm is not None
+
+
+def test_dkaminpar_strong_preset_end_to_end():
+    from kaminpar_tpu.parallel import dKaMinPar
+
+    graph = make_grid_graph(48, 48)
+    k, eps = 4, 0.03
+    part = (
+        dKaMinPar("strong", n_devices=4)
+        .set_graph(graph)
+        .compute_partition(k=k, epsilon=eps, seed=1)
+    )
+    assert part.shape == (graph.n,)
+    nw = graph.node_weight_array()
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, part, nw)
+    cap = int((1 + eps) * np.ceil(nw.sum() / k)) + int(nw.max())
+    assert (bw <= cap).all()
