@@ -41,7 +41,7 @@ from .segments import (
 )
 
 
-def _relative_gain_key(gain: jax.Array, weight: jax.Array) -> jax.Array:
+def relative_gain_key(gain: jax.Array, weight: jax.Array) -> jax.Array:
     """Sortable surrogate for compute_relative_gain (relative_gain.h):
     gain>0 -> gain*weight, else gain/weight.  Returned as a float32 to be
     used as a *descending* priority."""
@@ -108,7 +108,7 @@ def overload_balance_round(
 
     # per-source-block: accept movers by descending relative gain until the
     # overload is covered.  Encode descending order as ascending int key.
-    rel = _relative_gain_key(gain, graph.node_w)
+    rel = relative_gain_key(gain, graph.node_w)
     order_key = -rel  # float32; ascending sort = best relative gain first
     src_block = jnp.where(mover, part, -1)
     accept_out = accept_prefix_by_capacity(
@@ -208,7 +208,7 @@ def underload_balance(
             & (surplus[part] >= graph.node_w.astype(ACC_DTYPE))
         )
         target = jnp.where(mover, best, -1)
-        rel = _relative_gain_key(best_w, graph.node_w)
+        rel = relative_gain_key(best_w, graph.node_w)
         order_key = -rel
         # take out no more than the surplus, put in no more than the deficit
         accept_out = accept_prefix_by_capacity(

@@ -187,9 +187,18 @@ def _jet_refine_impl(
                 balancer_rounds,
             )
             cut = edge_cut(graph, part)
-            improved_enough = (best_cut - cut).astype(jnp.float32) > (
-                1.0 - fruitless_threshold
-            ) * jnp.abs(best_cut).astype(jnp.float32)
+            # while best_cut is still the no-feasible-partition sentinel,
+            # "improvement" means finding the first feasible partition —
+            # comparing against the sentinel would defeat the fruitless
+            # early-exit entirely
+            has_best = best_cut < jnp.iinfo(jnp.int32).max
+            improved_enough = jnp.where(
+                has_best,
+                (best_cut - cut).astype(jnp.float32)
+                > (1.0 - fruitless_threshold)
+                * jnp.abs(best_cut).astype(jnp.float32),
+                is_feasible(part),
+            )
             fruitless = jnp.where(improved_enough, 0, fruitless + 1)
             is_best = (cut <= best_cut) & is_feasible(part)
             best = jnp.where(is_best, part, best)

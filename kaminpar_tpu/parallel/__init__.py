@@ -15,6 +15,7 @@ from .dist_metrics import dist_edge_cut
 from .dist_coloring import dist_greedy_coloring
 from .dist_clp import dist_colored_lp_refine
 from .dist_balancer import dist_node_balance
+from .dist_cluster_balancer import dist_cluster_balance
 from .dist_jet import dist_jet_refine
 from .dist_hem import dist_hem_cluster, dist_hem_lp_cluster
 from .dist_context import (
@@ -40,6 +41,7 @@ __all__ = [
     "dist_greedy_coloring",
     "dist_colored_lp_refine",
     "dist_node_balance",
+    "dist_cluster_balance",
     "dist_jet_refine",
     "dist_hem_cluster",
     "dist_hem_lp_cluster",

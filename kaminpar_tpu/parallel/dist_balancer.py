@@ -29,6 +29,7 @@ try:  # jax >= 0.6
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
+from ..ops.balancer import relative_gain_key
 from ..ops.segments import (
     ACC_DTYPE,
     accept_prefix_by_capacity,
@@ -38,14 +39,6 @@ from ..ops.segments import (
 )
 from .dist_graph import DistGraph
 from .mesh import NODE_AXIS
-
-
-def _relative_gain_key(gain: jax.Array, weight: jax.Array) -> jax.Array:
-    """compute_relative_gain surrogate (relative_gain.h); see
-    ops/balancer.py."""
-    w = jnp.maximum(weight.astype(jnp.float32), 1.0)
-    g = gain.astype(jnp.float32)
-    return jnp.where(g > 0, g * w, g / w)
 
 
 def dist_balance_round(
@@ -107,7 +100,7 @@ def dist_balance_round(
     gain = lax.all_gather(gain_l, NODE_AXIS, tiled=True)
     nw = lax.all_gather(nw_l, NODE_AXIS, tiled=True)
 
-    order_key = -_relative_gain_key(gain, nw)
+    order_key = -relative_gain_key(gain, nw)
     src_block = jnp.where(target >= 0, jnp.clip(part, 0, k - 1), -1)
     accept_out = accept_prefix_by_capacity(
         src_block, order_key, nw, overload, reach=True

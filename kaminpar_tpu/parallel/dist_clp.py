@@ -29,7 +29,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 try:  # jax >= 0.6
     from jax import shard_map as _shard_map
@@ -47,7 +47,7 @@ from ..ops.segments import (
 )
 from .dist_coloring import dist_greedy_coloring
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, throttled_local_capacity
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "num_iterations"))
@@ -62,8 +62,6 @@ def _dist_clp_impl(
     seed: jax.Array,
     num_iterations: int,
 ):
-    n_pad = graph.n_pad
-
     def per_device(src_l, dst_l, ew_l, nw_l, n, part0, colors, num_colors,
                    cap, seed):
         n_loc = nw_l.shape[0]
@@ -103,24 +101,7 @@ def _dist_clp_impl(
             wants = eligible & (best >= 0) & (best != part_l) & (gain > 0)
             target_l = jnp.where(wants, best, -1)
 
-            # cross-device capacity throttle (see dist_lp.py)
-            demand_l = jax.ops.segment_sum(
-                jnp.where(target_l >= 0, nw_l, 0).astype(ACC_DTYPE),
-                jnp.clip(target_l, 0, k - 1),
-                num_segments=k,
-            )
-            demand = lax.psum(demand_l, NODE_AXIS)
-            headroom = jnp.maximum(cap - bw, 0)
-            frac = headroom.astype(jnp.float32) / jnp.maximum(
-                demand, 1
-            ).astype(jnp.float32)
-            scaled = jnp.floor(
-                demand_l.astype(jnp.float32)
-                * jnp.minimum(frac, 1.0)
-                * (1.0 - 1e-6)
-            ).astype(ACC_DTYPE)
-            local_cap = jnp.where(demand <= headroom, demand_l, scaled)
-            local_cap = jnp.minimum(local_cap, headroom)
+            local_cap = throttled_local_capacity(target_l, nw_l, bw, cap)
             prio_l = hash_u32(node_ids_l, salt ^ 0x165667B1)
             accept_l = accept_prefix_by_capacity(
                 target_l, prio_l, nw_l, local_cap

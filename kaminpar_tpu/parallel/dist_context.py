@@ -46,6 +46,7 @@ class DistRefinementAlgorithm(str, enum.Enum):
     COLORED_LP = "colored-lp"
     JET = "jet"
     NODE_BALANCER = "node-balancer"
+    CLUSTER_BALANCER = "cluster-balancer"
 
 
 @dataclass
@@ -146,6 +147,19 @@ def create_dist_colored_lp_context() -> DistContext:
     return ctx
 
 
+def create_dist_cluster_balancer_context() -> DistContext:
+    """Hybrid balancing pipeline (factories.cc HYBRID_CLUSTER_BALANCER
+    lineage): node balancer first, cluster balancer for the overloads
+    single-node moves cannot fix, then batched LP."""
+    ctx = _base("default")
+    ctx.refinement = [
+        DistRefinementAlgorithm.NODE_BALANCER,
+        DistRefinementAlgorithm.CLUSTER_BALANCER,
+        DistRefinementAlgorithm.BATCHED_LP,
+    ]
+    return ctx
+
+
 def create_dist_noref_context() -> DistContext:
     ctx = _base("noref")
     ctx.refinement = []
@@ -162,6 +176,7 @@ _DIST_PRESETS = {
     "europar23-strong": create_dist_strong_context,
     "jet": create_dist_jet_context,
     "colored-lp": create_dist_colored_lp_context,
+    "cluster-balancer": create_dist_cluster_balancer_context,
     "noref": create_dist_noref_context,
 }
 
@@ -221,6 +236,7 @@ def create_dist_refiner(ctx: DistContext) -> Callable:
     (factories.cc create_refiner + MultiRefiner analog)."""
     from .dist_balancer import dist_node_balance
     from .dist_clp import dist_colored_lp_refine
+    from .dist_cluster_balancer import dist_cluster_balance
     from .dist_jet import dist_jet_refine
     from .dist_lp import dist_lp_refine
 
@@ -234,6 +250,10 @@ def create_dist_refiner(ctx: DistContext) -> Callable:
                 continue
             elif algo == DistRefinementAlgorithm.NODE_BALANCER:
                 part = dist_node_balance(
+                    graph, part, k, max_block_weights, s
+                )
+            elif algo == DistRefinementAlgorithm.CLUSTER_BALANCER:
+                part = dist_cluster_balance(
                     graph, part, k, max_block_weights, s
                 )
             elif algo == DistRefinementAlgorithm.BATCHED_LP:

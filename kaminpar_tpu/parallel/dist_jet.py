@@ -190,9 +190,16 @@ def _dist_jet_impl(
 
                 part = lax.fori_loop(0, balancer_rounds, bal_body, part)
                 cut = _local_cut(part, src_l, dst_l, ew_l)
-                improved_enough = (best_cut - cut).astype(jnp.float32) > (
-                    1.0 - fruitless_threshold
-                ) * jnp.abs(best_cut).astype(jnp.float32)
+                # sentinel-aware, as in ops/jet.py: until a feasible
+                # partition exists, improvement = reaching feasibility
+                has_best = best_cut < jnp.iinfo(jnp.int32).max
+                improved_enough = jnp.where(
+                    has_best,
+                    (best_cut - cut).astype(jnp.float32)
+                    > (1.0 - fruitless_threshold)
+                    * jnp.abs(best_cut).astype(jnp.float32),
+                    is_feasible(part),
+                )
                 fruitless = jnp.where(improved_enough, 0, fruitless + 1)
                 is_best = (cut <= best_cut) & is_feasible(part)
                 best = jnp.where(is_best, part, best)

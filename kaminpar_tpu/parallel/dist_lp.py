@@ -53,7 +53,7 @@ from ..ops.segments import (
     move_weight_delta,
 )
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, throttled_local_capacity
 
 
 def _dist_lp_round(
@@ -130,21 +130,7 @@ def _dist_lp_round(
     target_l = jnp.where(wants & participate, best, -1)
 
     # -- weight control: psum'd demand, throttled local capacity ---------
-    demand_l = jax.ops.segment_sum(
-        jnp.where(target_l >= 0, nw_l, 0).astype(ACC_DTYPE),
-        jnp.clip(target_l, 0, C - 1),
-        num_segments=C,
-    )
-    demand = lax.psum(demand_l, NODE_AXIS)
-    headroom = jnp.maximum(cap - weights.astype(ACC_DTYPE), 0)
-    frac = headroom.astype(jnp.float32) / jnp.maximum(demand, 1).astype(
-        jnp.float32
-    )
-    scaled = jnp.floor(
-        demand_l.astype(jnp.float32) * jnp.minimum(frac, 1.0) * (1.0 - 1e-6)
-    ).astype(ACC_DTYPE)
-    local_cap = jnp.where(demand <= headroom, demand_l, scaled)
-    local_cap = jnp.minimum(local_cap, headroom)
+    local_cap = throttled_local_capacity(target_l, nw_l, weights, cap)
 
     prio_l = hash_u32(node_ids_l, salt ^ 0x165667B1)
     accept_l = accept_prefix_by_capacity(target_l, prio_l, nw_l, local_cap)

@@ -1,4 +1,4 @@
-"""Device-mesh construction.
+"""Device-mesh construction and shared collective commit helpers.
 
 The analog of the reference's MPI communicator setup (kaminpar-mpi/
 wrapper.h, definitions.h): one 1D mesh axis over which the node space is
@@ -13,10 +13,50 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh
 
+from ..ops.segments import ACC_DTYPE
+
 NODE_AXIS = "nodes"
+
+
+def throttled_local_capacity(
+    target_l: jax.Array,
+    node_w_l: jax.Array,
+    weights: jax.Array,
+    cap: jax.Array,
+    axis_name: str = NODE_AXIS,
+) -> jax.Array:
+    """Cross-device capacity throttle (the control_cluster_weights analog,
+    kaminpar-dist/.../global_lp_clusterer.cc:429): each device sums the
+    weight its movers demand per target bucket, the demands are `psum`'d,
+    and the device's local capacity share is scaled by headroom/demand —
+    so the *total* weight accepted across devices provably stays within
+    headroom.  The 1-1e-6 factor guards float rounding in the scale; the
+    demand<=headroom fast path keeps the common case exact.
+
+    Returns the per-bucket local capacity to feed accept_prefix_by_capacity.
+    Shared by the batched and colored distributed LP refiners.
+    """
+    C = cap.shape[0]
+    demand_l = jax.ops.segment_sum(
+        jnp.where(target_l >= 0, node_w_l, 0).astype(ACC_DTYPE),
+        jnp.clip(target_l, 0, C - 1),
+        num_segments=C,
+    )
+    demand = lax.psum(demand_l, axis_name)
+    headroom = jnp.maximum(cap - weights.astype(ACC_DTYPE), 0)
+    frac = headroom.astype(jnp.float32) / jnp.maximum(demand, 1).astype(
+        jnp.float32
+    )
+    scaled = jnp.floor(
+        demand_l.astype(jnp.float32) * jnp.minimum(frac, 1.0) * (1.0 - 1e-6)
+    ).astype(ACC_DTYPE)
+    local_cap = jnp.where(demand <= headroom, demand_l, scaled)
+    return jnp.minimum(local_cap, headroom)
 
 
 def make_mesh(

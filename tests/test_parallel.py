@@ -316,3 +316,71 @@ def test_dkaminpar_strong_preset_end_to_end():
     np.add.at(bw, part, nw)
     cap = int((1 + eps) * np.ceil(nw.sum() / k)) + int(nw.max())
     assert (bw <= cap).all()
+
+
+@pytest.mark.parametrize("n_devices", [1, 4])
+def test_dist_cluster_balancer_restores_feasibility(n_devices):
+    from kaminpar_tpu.parallel import dist_cluster_balance
+
+    graph = make_grid_graph(24, 24)
+    mesh = make_mesh(n_devices)
+    dg = dist_graph_from_host(graph, mesh)
+    k = 4
+    nw = graph.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * 1.05))
+    caps = jnp.full((k,), cap, jnp.int32)
+    part = np.zeros(dg.n_pad, np.int32)  # everything in block 0
+    bal = np.asarray(dist_cluster_balance(dg, jnp.asarray(part), k, caps, 5))
+    bw = np.bincount(bal[: graph.n], weights=nw, minlength=k)
+    assert bw.max() <= cap
+
+
+def test_dist_cluster_balancer_noop_on_feasible_partition():
+    from kaminpar_tpu.parallel import dist_cluster_balance
+
+    graph = make_grid_graph(16, 16)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    k = 4
+    # balanced column partition is already feasible: balancer must not touch
+    part = np.zeros(dg.n_pad, np.int32)
+    cols = np.arange(graph.n) % 16
+    part[: graph.n] = cols * k // 16
+    nw = graph.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * 1.05))
+    caps = jnp.full((k,), cap, jnp.int32)
+    bal = np.asarray(dist_cluster_balance(dg, jnp.asarray(part), k, caps, 5))
+    np.testing.assert_array_equal(bal[: graph.n], part[: graph.n])
+
+
+def test_dist_cluster_balancer_moves_whole_clusters_when_needed():
+    """A block whose border nodes all have high loss still gets rebalanced:
+    whole connected clusters move at once (the reason ClusterBalancer
+    exists, cluster_balancer.cc)."""
+    from kaminpar_tpu.parallel import dist_cluster_balance
+    from kaminpar_tpu.graphs.host import from_edge_list
+
+    # two dense-ish communities joined weakly; both start in block 0
+    rng = np.random.default_rng(7)
+    n_half = 32
+    edges, weights = [], []
+    for c in range(2):
+        base = c * n_half
+        for i in range(n_half):
+            for j in rng.choice(n_half, size=4, replace=False):
+                if i != j:
+                    edges.append((base + i, base + j))
+                    weights.append(10)
+    edges.append((0, n_half))  # weak bridge
+    weights.append(1)
+    graph = from_edge_list(2 * n_half, np.array(edges), np.array(weights))
+    mesh = make_mesh(2)
+    dg = dist_graph_from_host(graph, mesh)
+    k = 2
+    nw = graph.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * 1.1))
+    caps = jnp.full((k,), cap, jnp.int32)
+    part = np.zeros(dg.n_pad, np.int32)
+    bal = np.asarray(dist_cluster_balance(dg, jnp.asarray(part), k, caps, 3))
+    bw = np.bincount(bal[: graph.n], weights=nw, minlength=k)
+    assert bw.max() <= cap
