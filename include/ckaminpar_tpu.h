@@ -1,0 +1,65 @@
+/* C API for the TPU-native KaMinPar framework.
+ *
+ * Parity surface for the reference's C wrapper (kaminpar-shm/ckaminpar.h):
+ * a C program hands in a CSR graph and receives a k-way partition.  The
+ * implementation (kaminpar_tpu/native/ckaminpar.cpp) embeds a Python
+ * interpreter and drives the same pipeline as the Python API, so C callers
+ * get the identical partitioner (device-accelerated when a TPU backend is
+ * available in the embedded runtime).
+ *
+ * Usage:
+ *   kmp_partitioner *p = kmp_create("default", 0);
+ *   int32_t *part = malloc(n * sizeof(int32_t));
+ *   int64_t cut = kmp_compute_partition(p, n, xadj, adjncy, NULL, NULL,
+ *                                       k, 0.03, part);
+ *   if (cut < 0) fprintf(stderr, "%s\n", kmp_last_error(p));
+ *   kmp_free(p);
+ *
+ * Thread-safety: one embedded interpreter per process; calls are
+ * serialized on the GIL.  Link against libckaminpar_tpu.so (built by
+ * python -m kaminpar_tpu.native.build_capi) and libpython.
+ */
+
+#ifndef CKAMINPAR_TPU_H
+#define CKAMINPAR_TPU_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct kmp_partitioner kmp_partitioner;
+
+/* Create a partitioner configured by preset name (see
+ * kaminpar_tpu.presets; e.g. "default", "fast", "strong", "terapart").
+ * `seed` seeds every randomized phase.  Returns NULL on failure. */
+kmp_partitioner *kmp_create(const char *preset, int seed);
+
+void kmp_free(kmp_partitioner *p);
+
+/* Partition an undirected CSR graph (METIS convention: both directions of
+ * every edge stored) into k blocks with imbalance factor `epsilon`.
+ *
+ *   n        number of nodes
+ *   xadj     int64[n + 1] CSR offsets
+ *   adjncy   int32[xadj[n]] neighbor lists
+ *   vwgt     int32[n] node weights, or NULL for unit weights
+ *   adjwgt   int32[xadj[n]] edge weights, or NULL for unit weights
+ *   out      int32[n] receives the block of every node
+ *
+ * Returns the edge cut (>= 0) or -1 on error (see kmp_last_error). */
+int64_t kmp_compute_partition(kmp_partitioner *p, int64_t n,
+                              const int64_t *xadj, const int32_t *adjncy,
+                              const int32_t *vwgt, const int32_t *adjwgt,
+                              int32_t k, double epsilon, int32_t *out);
+
+/* Message for the most recent failure on this partitioner ("" if none).
+ * The pointer stays valid until the next call on `p`. */
+const char *kmp_last_error(kmp_partitioner *p);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* CKAMINPAR_TPU_H */

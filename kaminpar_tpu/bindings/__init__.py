@@ -1,0 +1,14 @@
+"""External-framework bindings.
+
+The reference ships pybind11 Python bindings and a NetworKit Cython module
+(bindings/python, bindings/networkit).  This framework *is* Python, so the
+"Python binding" is the package itself (kaminpar_tpu.KaMinPar); this
+subpackage holds adapters to other graph frameworks:
+
+  * networkit — NetworKit graph -> HostGraph adapter with the reference
+    binding's call surface (kaminpar_networkit.cc analog)
+  * the C ABI lives in kaminpar_tpu/native/ckaminpar.cpp +
+    include/ckaminpar_tpu.h (ckaminpar.h analog)
+"""
+
+from .networkit import NetworKitKaMinPar  # noqa: F401

@@ -1,0 +1,43 @@
+"""Build libckaminpar_tpu.so — the C ABI shared library.
+
+Usage: python -m kaminpar_tpu.native.build_capi [output_dir]
+
+Compiles kaminpar_tpu/native/ckaminpar.cpp against the running
+interpreter's embedding flags (python3-config --embed) so C/C++ programs
+can link the partitioner via include/ckaminpar_tpu.h — the parity path
+for the reference's ckaminpar C API target.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+
+def build(out_dir: str | None = None) -> str:
+    src_dir = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(src_dir, "ckaminpar.cpp")
+    out_dir = out_dir or src_dir
+    out = os.path.join(out_dir, "libckaminpar_tpu.so")
+
+    include = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    version = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION"
+    )
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+        f"-I{include}",
+        f"-L{libdir}",
+        f"-lpython{version}",
+        "-o", out,
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+if __name__ == "__main__":
+    path = build(sys.argv[1] if len(sys.argv) > 1 else None)
+    print(path)

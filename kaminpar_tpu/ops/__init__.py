@@ -1,1 +1,1 @@
-from . import metrics, segments  # noqa: F401
+from . import bfs, metrics, segments  # noqa: F401

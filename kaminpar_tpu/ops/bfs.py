@@ -1,0 +1,67 @@
+"""Device BFS distances — frontier expansion as segmented reductions.
+
+The kernel half of the BFS extractor (kaminpar-dist/graphutils/
+bfs_extractor.{h,cc}): the reference grows a per-PE BFS around seed nodes
+with explicit frontier queues and ghost-node exchanges
+(bfs_extractor.cc:613).  On TPU the frontier is a whole-graph predicate and
+one expansion step is a single `segment_min` over the COO edge list — no
+queues, no per-node control flow; `max_hops` steps run inside one jitted
+`lax.while_loop`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..graphs.csr import DeviceGraph
+
+UNREACHED = jnp.iinfo(jnp.int32).max
+
+
+@partial(jax.jit, static_argnames=())
+def bfs_hops(
+    graph: DeviceGraph, seeds: jax.Array, max_hops: jax.Array
+) -> jax.Array:
+    """Hop distance from the seed set, capped at `max_hops`.
+
+    Args:
+      seeds:    i32[s] node ids (pad entries -1 are ignored)
+      max_hops: i32 scalar — expansion stops after this many hops
+
+    Returns i32[n_pad]: 0 for seeds, hop count for reached nodes within
+    the cap, UNREACHED (INT32_MAX) otherwise (pad nodes included).
+    """
+    n_pad = graph.n_pad
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    is_real = node_ids < graph.n
+
+    dist0 = jnp.full(n_pad, UNREACHED, dtype=jnp.int32)
+    valid_seed = (seeds >= 0) & (seeds < graph.n)
+    dist0 = dist0.at[jnp.clip(seeds, 0, n_pad - 1)].min(
+        jnp.where(valid_seed, 0, UNREACHED)
+    )
+    dist0 = jnp.where(is_real, dist0, UNREACHED)
+
+    def cond(state):
+        h, dist, changed = state
+        return (h < max_hops) & changed
+
+    def body(state):
+        h, dist, _ = state
+        in_frontier = dist[graph.src] == h
+        cand = jnp.where(in_frontier, h + 1, UNREACHED)
+        # pad edges point at the pad node; is_real masks it back out
+        reached = jax.ops.segment_min(
+            cand, graph.dst, num_segments=n_pad
+        )
+        new_dist = jnp.where(is_real, jnp.minimum(dist, reached), UNREACHED)
+        return h + 1, new_dist, jnp.any(new_dist != dist)
+
+    _, dist, _ = lax.while_loop(
+        cond, body, (jnp.int32(0), dist0, jnp.array(True))
+    )
+    return dist

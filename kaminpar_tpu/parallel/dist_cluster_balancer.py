@@ -258,7 +258,17 @@ def dist_cluster_balance_round(
     )
     new_part = lax.all_gather(new_part_l, NODE_AXIS, tiled=True)
     moved = jnp.sum(accept.astype(jnp.int32))
-    return new_part, moved
+    # post-move block weights from the (replicated) accepted candidates —
+    # saves the cond() a second cross-device weight reduction
+    moved_w = jnp.where(accept, cw, 0)
+    delta_in = jax.ops.segment_sum(
+        moved_w, jnp.clip(target, 0, k - 1), num_segments=k
+    )
+    delta_out = jax.ops.segment_sum(
+        moved_w, jnp.clip(src_block, 0, k - 1), num_segments=k
+    )
+    still_overloaded = jnp.any(bw - delta_out + delta_in > cap)
+    return new_part, moved, still_overloaded
 
 
 @partial(
@@ -268,36 +278,20 @@ def _dist_cluster_balance_impl(
     mesh, graph, partition, k, cap, seed, max_rounds, merge_rounds
 ):
     def per_device(src_l, dst_l, ew_l, nw_l, n, part0, cap, seed):
-        def still_overloaded(part):
-            part_slice = lax.dynamic_slice(
-                part,
-                (lax.axis_index(NODE_AXIS).astype(jnp.int32) * nw_l.shape[0],),
-                (nw_l.shape[0],),
-            )
-            bw = lax.psum(
-                jax.ops.segment_sum(
-                    nw_l.astype(ACC_DTYPE),
-                    jnp.clip(part_slice, 0, k - 1),
-                    num_segments=k,
-                ),
-                NODE_AXIS,
-            )
-            return jnp.any(bw > cap)
-
         def cond(state):
-            i, part, moved = state
-            return (i < max_rounds) & (moved != 0) & still_overloaded(part)
+            i, part, moved, still_overloaded = state
+            return (i < max_rounds) & (moved != 0) & still_overloaded
 
         def body(state):
-            i, part, _ = state
+            i, part, _, _ = state
             salt = (seed.astype(jnp.int32) * 48611 + i * 104729) & 0x7FFFFFFF
-            part, moved = dist_cluster_balance_round(
+            part, moved, still = dist_cluster_balance_round(
                 src_l, dst_l, ew_l, nw_l, n, part, k, cap, salt, merge_rounds
             )
-            return (i + 1, part, moved)
+            return (i + 1, part, moved, still)
 
-        _, part, _ = lax.while_loop(
-            cond, body, (jnp.int32(0), part0, jnp.int32(1))
+        _, part, _, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), part0, jnp.int32(1), jnp.array(True))
         )
         return part
 

@@ -10,9 +10,10 @@ therefore stays host-side, mirroring the reference's *sequential* FM
 structure with a global gain PQ over border nodes, best-prefix rollback and
 the simple stopping rule (num_fruitless_moves).
 
-The per-node gain bookkeeping is the OnTheFlyGainCache strategy
-(gains/on_the_fly_gain_cache.h:25): gains recomputed from the adjacency
-rather than cached per (node, block) — the right trade at host speeds.
+The per-node gain bookkeeping uses the dense gain cache
+(refinement/gains.HostDenseGainCache, the DenseGainCache strategy): an
+(n, k) connection matrix built once per pass and updated incrementally on
+each move, so best-move queries are O(k) instead of O(deg).
 """
 
 from __future__ import annotations
@@ -25,29 +26,7 @@ import numpy as np
 from ..context import FMRefinementContext
 from ..graphs.csr import DeviceGraph, host_graph_from_device
 from ..graphs.host import HostGraph
-
-
-def _best_move(graph, part, node_w, edge_w, bw, max_bw, u, k):
-    """Best feasible (gain, target) for node u (on-the-fly gain)."""
-    lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
-    if lo == hi:
-        return None
-    neigh = graph.adjncy[lo:hi]
-    w = edge_w[lo:hi]
-    blocks = part[neigh]
-    conn = np.zeros(k, dtype=np.int64)
-    np.add.at(conn, blocks, w)
-    b = part[u]
-    own = conn[b]
-    conn[b] = -(1 << 62)
-    # feasibility: target must have room
-    room_ok = bw + node_w[u] <= max_bw
-    conn[~room_ok] = -(1 << 62)
-    conn[b] = -(1 << 62)
-    t = int(np.argmax(conn))
-    if conn[t] <= -(1 << 62):
-        return None
-    return int(conn[t] - own), t
+from .gains import create_host_gain_cache
 
 
 def fm_refine_host(
@@ -97,11 +76,12 @@ def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
     if len(border) == 0:
         return 0
 
+    cache = create_host_gain_cache(graph, part, k)
     pq = []
     tie = rng.random(n)
     in_pq = np.zeros(n, dtype=bool)
     for u in border:
-        mv = _best_move(graph, part, node_w, edge_w, bw, max_bw, int(u), k)
+        mv = cache.best_move(int(u), part, node_w, bw, max_bw)
         if mv is not None:
             heapq.heappush(pq, (-mv[0], tie[u], int(u), mv[1]))
             in_pq[u] = True
@@ -117,8 +97,8 @@ def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
         negg, _, u, t = heapq.heappop(pq)
         if locked[u]:
             continue
-        # gains are stale: recompute and re-push if changed
-        mv = _best_move(graph, part, node_w, edge_w, bw, max_bw, u, k)
+        # gains may be stale: re-query the cache and re-push if changed
+        mv = cache.best_move(u, part, node_w, bw, max_bw)
         if mv is None:
             continue
         gain, t = mv
@@ -132,6 +112,7 @@ def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
         part[u] = t
         bw[b] -= node_w[u]
         bw[t] += node_w[u]
+        cache.apply_move(u, b, t)
         locked[u] = True
         cur_delta += gain
         moves.append((u, b))
@@ -144,12 +125,12 @@ def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
             if fruitless >= ctx.num_fruitless_moves:
                 break
 
-        # re-queue unlocked neighbors (their gains changed)
+        # re-queue unlocked neighbors (their cached rows just changed)
         lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
         for v in graph.adjncy[lo:hi]:
             v = int(v)
             if not locked[v]:
-                mv = _best_move(graph, part, node_w, edge_w, bw, max_bw, v, k)
+                mv = cache.best_move(v, part, node_w, bw, max_bw)
                 if mv is not None:
                     heapq.heappush(pq, (-mv[0], tie[v], v, mv[1]))
 

@@ -1,0 +1,271 @@
+"""Gain caches — pluggable strategies mirroring kaminpar-shm/refinement/gains/.
+
+The reference keeps per-(node, block) connection weights so FM/Jet can
+query move gains in O(1) and update them incrementally as nodes move:
+`gain(u, from, to) = conn(u, to) - conn(u, from)`, with strategies trading
+memory for speed (sparse_gain_cache.h:54 dense per-node×block,
+compact_hashing_gain_cache.h:34 default, on_the_fly_gain_cache.h:25
+recompute-on-demand, delta_gain_caches.h:202 speculative overlays).
+
+TPU translation:
+  * DeviceDenseGainCache — the SparseGainCache analog: a dense
+    i32[n_pad, k] connection matrix on device, built with one
+    segment_sum, updated after each bulk-synchronous move round with two
+    more (the `move()` protocol, executed for a whole round's movers at
+    once).  The per-round update touches only edges incident to movers,
+    like the reference's per-move delta updates — O(moved edges), not
+    O(m).  Feeds Jet-style refiners at small/medium k.
+  * on-the-fly — the default for whole-graph device refiners: LP/Jet
+    recompute ratings per round via ops/segments.aggregate_by_key (no
+    materialized n×k table); this module adds `on_the_fly_gains` as the
+    explicit strategy entry point.
+  * HostDenseGainCache — numpy (n, k) cache with incremental updates for
+    the host FM refiner, replacing full per-node recomputation.
+  * HostDeltaGainCache — speculative overlay over a HostDenseGainCache
+    (delta_gain_caches.h analog): moves applied to the delta are visible
+    through `gain()` but do not touch the base cache until `commit()`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graphs.csr import DeviceGraph
+from ..ops.segments import ACC_DTYPE, INT32_MIN
+
+
+# ---------------------------------------------------------------------------
+# Device dense gain cache (SparseGainCache analog)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("k",))
+def build_dense_gain_cache(
+    graph: DeviceGraph, partition: jax.Array, k: int
+) -> jax.Array:
+    """conn[u, b] = total weight of u's edges into block b.
+
+    One flat segment_sum over the COO edge list (the bulk analog of
+    SparseGainCache::initialize's per-node aggregation)."""
+    n_pad = graph.n_pad
+    part_c = jnp.clip(partition, 0, k - 1)
+    flat = graph.src.astype(jnp.int32) * k + part_c[graph.dst]
+    conn = jax.ops.segment_sum(
+        graph.edge_w.astype(ACC_DTYPE), flat, num_segments=n_pad * k
+    )
+    return conn.reshape(n_pad, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def update_dense_gain_cache(
+    conn: jax.Array,
+    graph: DeviceGraph,
+    old_partition: jax.Array,
+    new_partition: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Incremental update after a bulk move round (the move() protocol,
+    sparse_gain_cache.h): for every edge (u, v) whose target v moved
+    a -> b, conn[u, a] -= w(uv) and conn[u, b] += w(uv).  Cost is
+    O(edges incident to movers); unmoved rounds are a no-op."""
+    n_pad = graph.n_pad
+    old_c = jnp.clip(old_partition, 0, k - 1)
+    new_c = jnp.clip(new_partition, 0, k - 1)
+    moved = old_c[graph.dst] != new_c[graph.dst]
+    w = jnp.where(moved, graph.edge_w, 0).astype(ACC_DTYPE)
+    sub = graph.src.astype(jnp.int32) * k + old_c[graph.dst]
+    add = graph.src.astype(jnp.int32) * k + new_c[graph.dst]
+    flat = conn.reshape(-1)
+    flat = flat.at[sub].add(-w, mode="drop")
+    flat = flat.at[add].add(w, mode="drop")
+    return flat.reshape(n_pad, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def best_moves_from_cache(
+    conn: jax.Array,
+    partition: jax.Array,
+    node_w: jax.Array,
+    block_weights: jax.Array,
+    max_block_weights: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-node (best_target, gain) from a dense cache under the block
+    weight caps (gain(u, from, to) = conn[u,to] - conn[u,from]).
+    Infeasible rows return target -1 / gain INT32_MIN."""
+    n_pad = conn.shape[0]
+    part_c = jnp.clip(partition, 0, k - 1)
+    own = jnp.take_along_axis(conn, part_c[:, None], axis=1)[:, 0]
+    cap = jnp.broadcast_to(max_block_weights, (k,)).astype(ACC_DTYPE)
+    fits = (
+        block_weights[None, :].astype(ACC_DTYPE)
+        + node_w[:, None].astype(ACC_DTYPE)
+        <= cap[None, :]
+    )
+    is_own = jnp.arange(k, dtype=jnp.int32)[None, :] == part_c[:, None]
+    score = jnp.where(fits & ~is_own, conn, INT32_MIN)
+    best = jnp.argmax(score, axis=1).astype(jnp.int32)
+    best_w = jnp.max(score, axis=1)
+    has = best_w > INT32_MIN
+    gain = jnp.where(has, best_w - own, INT32_MIN)
+    return jnp.where(has, best, -1), gain
+
+
+def on_the_fly_gains(
+    graph: DeviceGraph, partition: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """OnTheFlyGainCache strategy (on_the_fly_gain_cache.h:25): no
+    materialized table — returns the aggregate_by_key triple
+    (seg_g, key_g, w_g) enumerating each node's adjacent blocks with
+    connection weights, exactly what LP/Jet rounds consume."""
+    from ..ops.segments import aggregate_by_key
+
+    part_c = jnp.clip(partition, 0, k - 1)
+    return aggregate_by_key(graph.src, part_c[graph.dst], graph.edge_w)
+
+
+# ---------------------------------------------------------------------------
+# Host caches (FM support)
+# ---------------------------------------------------------------------------
+
+
+class HostDenseGainCache:
+    """Dense (n, k) connection matrix on host with incremental move
+    updates — the host FM's gain authority (DenseGainCache analog).
+
+    Invariant (gain_cache_test.cc's validation property): after any move
+    sequence applied through `apply_move`, `self.conn` equals a fresh
+    rebuild from the current partition."""
+
+    def __init__(self, host_graph, partition: np.ndarray, k: int):
+        self.g = host_graph
+        self.k = k
+        n = host_graph.n
+        self.src = host_graph.edge_sources()
+        self.dst = host_graph.adjncy
+        self.ew = host_graph.edge_weight_array()
+        # int32 matches the device ACC_DTYPE; entries are bounded by a
+        # node's weighted degree
+        self.conn = np.zeros((n, k), dtype=np.int32)
+        np.add.at(
+            self.conn,
+            (self.src, np.asarray(partition, np.int64)[self.dst]),
+            self.ew,
+        )
+
+    def gain(self, u: int, b_from: int, b_to: int) -> int:
+        return int(self.conn[u, b_to] - self.conn[u, b_from])
+
+    def best_move(
+        self,
+        u: int,
+        part: np.ndarray,
+        node_w: np.ndarray,
+        bw: np.ndarray,
+        max_bw: np.ndarray,
+    ) -> Optional[Tuple[int, int]]:
+        """Best feasible (gain, target) for u, O(k)."""
+        b = int(part[u])
+        row = self.conn[u]
+        feas = bw + node_w[u] <= max_bw
+        feas[b] = False
+        if not feas.any():
+            return None
+        masked = np.where(feas, row, -(1 << 62))
+        t = int(np.argmax(masked))
+        if masked[t] <= -(1 << 62):
+            return None
+        return int(row[t] - row[b]), t
+
+    def apply_move(self, u: int, b_from: int, b_to: int) -> None:
+        """Move u and update the neighbors' rows (move(), O(deg(u)))."""
+        lo, hi = int(self.g.xadj[u]), int(self.g.xadj[u + 1])
+        neigh = self.dst[lo:hi]
+        w = self.ew[lo:hi]
+        np.subtract.at(self.conn, (neigh, b_from), w)
+        np.add.at(self.conn, (neigh, b_to), w)
+
+
+class HostOnTheFlyGainCache:
+    """On-the-fly strategy for host FM (on_the_fly_gain_cache.h:25): no
+    table — best_move recomputes from the adjacency in O(deg + k).  Used
+    when the dense (n, k) table would not fit comfortably in memory."""
+
+    def __init__(self, host_graph, partition: np.ndarray, k: int):
+        self.g = host_graph
+        self.k = k
+        self.dst = host_graph.adjncy
+        self.ew = host_graph.edge_weight_array()
+        self.part = partition  # shared, caller mutates it before apply_move
+
+    def best_move(self, u, part, node_w, bw, max_bw):
+        lo, hi = int(self.g.xadj[u]), int(self.g.xadj[u + 1])
+        if lo == hi:
+            return None
+        conn = np.zeros(self.k, dtype=np.int64)
+        np.add.at(conn, part[self.dst[lo:hi]], self.ew[lo:hi])
+        b = int(part[u])
+        own = conn[b]
+        feas = bw + node_w[u] <= max_bw
+        feas[b] = False
+        masked = np.where(feas, conn, -(1 << 62))
+        t = int(np.argmax(masked))
+        if masked[t] <= -(1 << 62):
+            return None
+        return int(conn[t] - own), t
+
+    def apply_move(self, u: int, b_from: int, b_to: int) -> None:
+        pass  # nothing cached
+
+
+# dense table above this many entries falls back to on-the-fly
+DENSE_CACHE_MAX_ENTRIES = 1 << 26
+
+
+def create_host_gain_cache(host_graph, partition: np.ndarray, k: int):
+    """Strategy picker (the factories.cc gain-cache dispatch analog):
+    dense when the (n, k) table is affordable, on-the-fly otherwise."""
+    if host_graph.n * k <= DENSE_CACHE_MAX_ENTRIES:
+        return HostDenseGainCache(host_graph, partition, k)
+    return HostOnTheFlyGainCache(host_graph, partition, k)
+
+
+class HostDeltaGainCache:
+    """Speculative overlay (delta_gain_caches.h:202 analog): FM batches
+    try moves against the delta; `commit()` folds them into the base,
+    `clear()` discards them."""
+
+    def __init__(self, base: HostDenseGainCache):
+        self.base = base
+        self._delta: Dict[Tuple[int, int], int] = {}
+        self._moves: list[Tuple[int, int, int]] = []
+
+    def _conn(self, u: int, b: int) -> int:
+        return int(self.base.conn[u, b]) + self._delta.get((u, b), 0)
+
+    def gain(self, u: int, b_from: int, b_to: int) -> int:
+        return self._conn(u, b_to) - self._conn(u, b_from)
+
+    def apply_move(self, u: int, b_from: int, b_to: int) -> None:
+        g = self.base.g
+        lo, hi = int(g.xadj[u]), int(g.xadj[u + 1])
+        for v, w in zip(self.base.dst[lo:hi], self.base.ew[lo:hi]):
+            v = int(v)
+            self._delta[(v, b_from)] = self._delta.get((v, b_from), 0) - int(w)
+            self._delta[(v, b_to)] = self._delta.get((v, b_to), 0) + int(w)
+        self._moves.append((u, b_from, b_to))
+
+    def commit(self) -> None:
+        for u, b_from, b_to in self._moves:
+            self.base.apply_move(u, b_from, b_to)
+        self.clear()
+
+    def clear(self) -> None:
+        self._delta.clear()
+        self._moves.clear()

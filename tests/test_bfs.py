@@ -1,0 +1,121 @@
+"""BFS kernel + BFS extractor tests (bfs_extractor.cc analog coverage:
+the reference's dist tests extract BFS regions around seeds and validate
+the resulting shm graph)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs.bfs_extractor import extract_bfs_subgraph, _host_bfs
+from kaminpar_tpu.graphs.csr import device_graph_from_host
+from kaminpar_tpu.graphs.factories import (
+    make_grid_graph,
+    make_path,
+    make_star,
+)
+from kaminpar_tpu.graphs.host import validate
+from kaminpar_tpu.ops.bfs import UNREACHED, bfs_hops
+
+
+def test_bfs_hops_on_path():
+    g = make_path(10)
+    dg = device_graph_from_host(g)
+    hops = np.asarray(bfs_hops(dg, jnp.array([0], jnp.int32), jnp.int32(3)))
+    expect = [0, 1, 2, 3] + [UNREACHED] * 6
+    assert hops[: g.n].tolist() == expect
+
+
+def test_bfs_hops_multi_seed_matches_host_bfs():
+    g = make_grid_graph(12, 12)
+    dg = device_graph_from_host(g)
+    seeds = np.array([0, 77, 143], dtype=np.int64)
+    hops_dev = np.asarray(
+        bfs_hops(dg, jnp.asarray(seeds, jnp.int32), jnp.int32(4))
+    )[: g.n]
+    hops_host = _host_bfs(g, seeds, 4)
+    reached = hops_host <= 4
+    assert (hops_dev[reached] == hops_host[reached]).all()
+    assert (hops_dev[~reached] == UNREACHED).all()
+
+
+def test_bfs_hops_ignores_pad_seeds():
+    g = make_star(5)
+    dg = device_graph_from_host(g)
+    hops = np.asarray(
+        bfs_hops(dg, jnp.array([-1, 0], jnp.int32), jnp.int32(2))
+    )
+    assert hops[0] == 0
+    assert (hops[1 : g.n] == 1).all()
+
+
+@pytest.mark.parametrize("use_device_hops", [False, True])
+def test_extract_bfs_subgraph_grid(use_device_hops):
+    g = make_grid_graph(10, 10)
+    k = 2
+    part = (np.arange(g.n) % 10 >= 5).astype(np.int32)  # left/right halves
+    seeds = np.array([0])
+    hops = None
+    if use_device_hops:
+        dg = device_graph_from_host(g)
+        hops = np.asarray(
+            bfs_hops(dg, jnp.asarray(seeds, jnp.int32), jnp.int32(2))
+        )
+    ext = extract_bfs_subgraph(g, part, seeds, max_hops=2, k=k, hops=hops)
+    validate(ext.graph)
+    # region of corner node at radius 2 on a grid: 6 nodes
+    assert ext.num_region == 6
+    assert ext.graph.n == ext.num_region + k
+    # total node weight is conserved (region + pseudo exterior)
+    assert ext.graph.node_weight_array().sum() == g.node_weight_array().sum()
+    # pseudo-node weights = exterior block weights
+    in_region = np.zeros(g.n, dtype=bool)
+    in_region[ext.node_mapping] = True
+    for b in range(k):
+        expect = g.node_weight_array()[(~in_region) & (part == b)].sum()
+        assert ext.graph.node_weight_array()[ext.num_region + b] == expect
+    # every interior edge of the region is preserved with its weight
+    sub = ext.graph
+    # region-internal degree check on original corner node (id 0 -> new 0)
+    assert ext.node_mapping[0] == 0
+    assert ext.partition[: ext.num_region].tolist() == part[ext.node_mapping].tolist()
+
+
+def test_extract_project_back():
+    g = make_grid_graph(6, 6)
+    k = 2
+    part = (np.arange(g.n) % 6 >= 3).astype(np.int32)
+    ext = extract_bfs_subgraph(g, part, np.array([14]), max_hops=1, k=k)
+    rp = ext.partition.copy()
+    rp[: ext.num_region] = 1 - rp[: ext.num_region]  # flip the region
+    out = ext.project_back(rp, part)
+    flipped = np.zeros(g.n, dtype=bool)
+    flipped[ext.node_mapping] = True
+    assert (out[flipped] == 1 - part[flipped]).all()
+    assert (out[~flipped] == part[~flipped]).all()
+
+
+def test_extract_conserves_cut_between_region_and_exterior():
+    """Weight of edges from region to exterior block b must equal the
+    region->pseudo-b edge weights (the contracted exterior keeps the
+    region's attachment, bfs_extractor.h:28-46)."""
+    g = make_grid_graph(8, 8)
+    k = 2
+    part = (np.arange(g.n) % 8 >= 4).astype(np.int32)
+    ext = extract_bfs_subgraph(g, part, np.array([27]), max_hops=2, k=k)
+    in_region = np.zeros(g.n, dtype=bool)
+    in_region[ext.node_mapping] = True
+    src, dst, ew = g.edge_sources(), g.adjncy, g.edge_weight_array()
+    for b in range(k):
+        expect = ew[
+            in_region[src] & ~in_region[dst] & (part[dst] == b)
+        ].sum()
+        sub = ext.graph
+        ssrc, sdst, sew = (
+            sub.edge_sources(),
+            sub.adjncy,
+            sub.edge_weight_array(),
+        )
+        got = sew[
+            (ssrc < ext.num_region) & (sdst == ext.num_region + b)
+        ].sum()
+        assert got == expect

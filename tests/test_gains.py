@@ -1,0 +1,166 @@
+"""Gain cache tests — mirrors the reference's gain_cache_test.cc: cached
+gains must equal recomputation after arbitrary move sequences."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs.csr import device_graph_from_host
+from kaminpar_tpu.graphs.factories import make_grid_graph, make_rmat
+from kaminpar_tpu.refinement.gains import (
+    HostDeltaGainCache,
+    HostDenseGainCache,
+    best_moves_from_cache,
+    build_dense_gain_cache,
+    on_the_fly_gains,
+    update_dense_gain_cache,
+)
+
+
+def _reference_conn(host, part, k):
+    conn = np.zeros((host.n, k), dtype=np.int64)
+    np.add.at(
+        conn,
+        (host.edge_sources(), part[host.adjncy]),
+        host.edge_weight_array(),
+    )
+    return conn
+
+
+def test_device_dense_cache_matches_reference_build():
+    host = make_grid_graph(8, 8)
+    k = 4
+    rng = np.random.default_rng(0)
+    part = rng.integers(0, k, host.n).astype(np.int32)
+    dg = device_graph_from_host(host)
+    padded = np.zeros(dg.n_pad, np.int32)
+    padded[: host.n] = part
+    conn = np.asarray(build_dense_gain_cache(dg, jnp.asarray(padded), k))
+    np.testing.assert_array_equal(conn[: host.n], _reference_conn(host, part, k))
+    # pad rows are all-zero (pad edges have weight 0)
+    assert (conn[host.n :] == 0).all()
+
+
+def test_device_dense_cache_incremental_update_matches_rebuild():
+    """The move() protocol: after a bulk move round, the incrementally
+    updated cache equals a fresh build from the new partition."""
+    host = make_rmat(256, 2048, seed=3)
+    k = 5
+    rng = np.random.default_rng(1)
+    part = rng.integers(0, k, host.n).astype(np.int32)
+    dg = device_graph_from_host(host)
+    p0 = np.zeros(dg.n_pad, np.int32)
+    p0[: host.n] = part
+    conn = build_dense_gain_cache(dg, jnp.asarray(p0), k)
+    for round_ in range(3):
+        new = p0.copy()
+        movers = rng.random(host.n) < 0.3
+        new[: host.n][movers] = rng.integers(0, k, movers.sum())
+        conn = update_dense_gain_cache(
+            conn, dg, jnp.asarray(p0), jnp.asarray(new), k
+        )
+        fresh = build_dense_gain_cache(dg, jnp.asarray(new), k)
+        np.testing.assert_array_equal(np.asarray(conn), np.asarray(fresh))
+        p0 = new
+
+
+def test_best_moves_from_cache_respects_caps_and_gains():
+    host = make_grid_graph(6, 6)
+    k = 2
+    part = np.zeros(host.n, np.int32)
+    part[host.n // 2 :] = 1
+    dg = device_graph_from_host(host)
+    p = np.zeros(dg.n_pad, np.int32)
+    p[: host.n] = part
+    conn = build_dense_gain_cache(dg, jnp.asarray(p), k)
+    nw = np.zeros(dg.n_pad, np.int64)
+    nw[: host.n] = host.node_weight_array()
+    bw = np.bincount(part, weights=host.node_weight_array(), minlength=k)
+    # generous caps: every move feasible
+    caps = jnp.full((k,), int(bw.max() * 2), jnp.int32)
+    best, gain = best_moves_from_cache(
+        conn,
+        jnp.asarray(p),
+        jnp.asarray(nw, jnp.int32),
+        jnp.asarray(bw, jnp.int32),
+        caps,
+        k,
+    )
+    best, gain = np.asarray(best), np.asarray(gain)
+    ref = _reference_conn(host, part, k)
+    for u in range(host.n):
+        own = ref[u, part[u]]
+        other = 1 - part[u]
+        assert best[u] == other
+        assert gain[u] == ref[u, other] - own
+    # zero caps: nothing feasible
+    best2, _ = best_moves_from_cache(
+        conn,
+        jnp.asarray(p),
+        jnp.asarray(nw, jnp.int32),
+        jnp.asarray(bw, jnp.int32),
+        jnp.zeros((k,), jnp.int32),
+        k,
+    )
+    assert (np.asarray(best2)[: host.n] == -1).all()
+
+
+def test_on_the_fly_gains_enumerates_adjacent_blocks():
+    host = make_grid_graph(4, 4)
+    k = 2
+    part = (np.arange(host.n) % 4 >= 2).astype(np.int32)
+    dg = device_graph_from_host(host)
+    p = np.zeros(dg.n_pad, np.int32)
+    p[: host.n] = part
+    seg, key, w = (
+        np.asarray(x) for x in on_the_fly_gains(dg, jnp.asarray(p), k)
+    )
+    ref = _reference_conn(host, part, k)
+    got = np.zeros_like(ref)
+    for s, b, ww in zip(seg, key, w):
+        if s >= 0 and s < host.n:
+            got[s, b] += ww
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_host_cache_incremental_equals_rebuild_after_moves():
+    host = make_rmat(128, 1024, seed=5)
+    k = 4
+    rng = np.random.default_rng(2)
+    part = rng.integers(0, k, host.n).astype(np.int32)
+    cache = HostDenseGainCache(host, part, k)
+    for _ in range(50):
+        u = int(rng.integers(0, host.n))
+        b_from = int(part[u])
+        b_to = int(rng.integers(0, k))
+        if b_to == b_from:
+            continue
+        part[u] = b_to
+        cache.apply_move(u, b_from, b_to)
+    np.testing.assert_array_equal(cache.conn, _reference_conn(host, part, k))
+
+
+def test_host_delta_cache_is_speculative():
+    host = make_grid_graph(5, 5)
+    k = 2
+    part = (np.arange(host.n) % 5 >= 2).astype(np.int32)
+    base = HostDenseGainCache(host, part, k)
+    snapshot = base.conn.copy()
+    delta = HostDeltaGainCache(base)
+    delta.apply_move(12, int(part[12]), 1 - int(part[12]))
+    # base untouched until commit
+    np.testing.assert_array_equal(base.conn, snapshot)
+    # delta view consistent with a real apply
+    part2 = part.copy()
+    part2[12] = 1 - part[12]
+    ref2 = _reference_conn(host, part2, k)
+    for u in host.neighbors(12):
+        for b in range(k):
+            assert delta._conn(int(u), b) == ref2[int(u), b]
+    delta.commit()
+    np.testing.assert_array_equal(base.conn, ref2)
+    # clear() path: discarded moves leave the base alone
+    delta2 = HostDeltaGainCache(base)
+    delta2.apply_move(0, int(part2[0]), 1 - int(part2[0]))
+    delta2.clear()
+    np.testing.assert_array_equal(base.conn, ref2)
