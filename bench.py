@@ -78,6 +78,17 @@ def main() -> None:
 
     from kaminpar_tpu.graphs.csr import device_graph_from_host
 
+    # persistent compile cache: the multilevel pipeline compiles one
+    # executable per shape bucket (~10 buckets x several kernels); caching
+    # them on disk turns the ~10-minute first-run warmup into seconds on
+    # every later run
+    cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     _init_platform()
 
     host = build_graph()

@@ -230,7 +230,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         statistics.enable()
 
     t_io = time.perf_counter()
-    graph = io_mod.load_graph(args.graph, fmt=args.format)
+    if args.graph.startswith("gen:"):
+        # synthetic input, KaGen option-string style (the dKaMinPar CLI's
+        # -G generator surface, kaminpar-io/dist_skagen.h):
+        #   gen:rmat;n=65536;m=1000000;seed=1
+        from .graphs.factories import generate
+
+        graph = generate(args.graph)
+    else:
+        graph = io_mod.load_graph(args.graph, fmt=args.format)
     io_s = time.perf_counter() - t_io
 
     partitioner = KaMinPar(ctx)

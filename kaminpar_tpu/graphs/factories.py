@@ -139,3 +139,129 @@ def make_rmat(
         src = (src << 1) | (quad >> 1)
         dst = (dst << 1) | (quad & 1)
     return from_edge_list(n, np.stack([src, dst], axis=1))
+
+
+def make_rgg3d(
+    n: int, avg_degree: float = 8.0, seed: Optional[int] = None
+) -> HostGraph:
+    """Random geometric graph on the unit cube (KaGen RGG3D stand-in,
+    kaminpar-io/dist_skagen.cc generator lineage)."""
+    rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
+    pts = rng.random((n, 3))
+    radius = (avg_degree * 3.0 / (4.0 * np.pi * n)) ** (1.0 / 3.0)
+    ncell = max(1, int(1.0 / radius))
+    cell = (pts * ncell).astype(np.int64).clip(0, ncell - 1)
+    cell_id = (cell[:, 0] * ncell + cell[:, 1]) * ncell + cell[:, 2]
+    order = np.argsort(cell_id, kind="stable")
+    starts = np.searchsorted(cell_id[order], np.arange(ncell**3 + 1))
+    edges = []
+    r2 = radius * radius
+    for cid in range(ncell**3):
+        a = order[starts[cid] : starts[cid + 1]]
+        if len(a) == 0:
+            continue
+        cx, rem = divmod(cid, ncell * ncell)
+        cy, cz = divmod(rem, ncell)
+        for dx in (-1, 0, 1):
+            nx = cx + dx
+            if not (0 <= nx < ncell):
+                continue
+            for dy in (-1, 0, 1):
+                ny = cy + dy
+                if not (0 <= ny < ncell):
+                    continue
+                for dz in (-1, 0, 1):
+                    nz = cz + dz
+                    if not (0 <= nz < ncell):
+                        continue
+                    nid = (nx * ncell + ny) * ncell + nz
+                    b = order[starts[nid] : starts[nid + 1]]
+                    if len(b) == 0:
+                        continue
+                    d2 = ((pts[a, None, :] - pts[None, b, :]) ** 2).sum(-1)
+                    ii, jj = np.nonzero(d2 <= r2)
+                    mask = a[ii] < b[jj]
+                    if mask.any():
+                        edges.append(
+                            np.stack([a[ii][mask], b[jj][mask]], axis=1)
+                        )
+    all_edges = (
+        np.concatenate(edges) if edges else np.zeros((0, 2), dtype=np.int64)
+    )
+    return from_edge_list(n, all_edges)
+
+
+def make_gnm(n: int, m: int, seed: Optional[int] = None) -> HostGraph:
+    """Uniform random graph with ~m undirected edges (KaGen GNM_UNDIRECTED
+    stand-in)."""
+    rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
+    src = rng.integers(0, n, m, dtype=np.int64)
+    dst = rng.integers(0, n, m, dtype=np.int64)
+    keep = src != dst
+    return from_edge_list(n, np.stack([src[keep], dst[keep]], axis=1))
+
+
+def make_ba(n: int, d: int = 4, seed: Optional[int] = None) -> HostGraph:
+    """Barabási–Albert preferential attachment (KaGen BA stand-in): each
+    new node attaches to d targets sampled from the current edge list
+    (the classic repeated-endpoint trick)."""
+    rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
+    targets = np.zeros(2 * n * d, dtype=np.int64)
+    edges = np.empty((n * d, 2), dtype=np.int64)
+    cnt = 0
+    for u in range(n):
+        for j in range(d):
+            if cnt == 0 or rng.random() < 0.5 or u == 0:
+                t = int(rng.integers(0, max(u, 1)))
+            else:
+                t = int(targets[int(rng.integers(0, 2 * cnt))])
+            edges[cnt] = (u, t)
+            targets[2 * cnt] = u
+            targets[2 * cnt + 1] = t
+            cnt += 1
+    e = edges[:cnt]
+    e = e[e[:, 0] != e[:, 1]]
+    return from_edge_list(n, e)
+
+
+def make_grid3d(x: int, y: int, z: int) -> HostGraph:
+    """3D grid graph (KaGen GRID_3D stand-in)."""
+    idx = np.arange(x * y * z).reshape(x, y, z)
+    edges = []
+    edges.append(np.stack([idx[:-1].ravel(), idx[1:].ravel()], axis=1))
+    edges.append(
+        np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
+    )
+    edges.append(
+        np.stack([idx[:, :, :-1].ravel(), idx[:, :, 1:].ravel()], axis=1)
+    )
+    return from_edge_list(x * y * z, np.concatenate(edges))
+
+
+_GENERATORS = {
+    "rgg2d": make_rgg2d,
+    "rgg3d": make_rgg3d,
+    "rmat": make_rmat,
+    "gnm": make_gnm,
+    "ba": make_ba,
+    "grid2d": lambda rows, cols: make_grid_graph(rows, cols),
+    "grid3d": make_grid3d,
+}
+
+
+def generate(spec: str) -> HostGraph:
+    """Build a synthetic graph from a KaGen-style option string
+    (dKaMinPar's `-G "<type>;<key>=<value>;..."` surface,
+    kaminpar-io/dist_skagen.h): e.g. "rgg2d;n=1024;avg_degree=8",
+    "rmat;n=65536;m=1000000;seed=1", "grid3d;x=8;y=8;z=8"."""
+    parts = [p for p in spec.replace("gen:", "", 1).split(";") if p]
+    name = parts[0]
+    if name not in _GENERATORS:
+        raise ValueError(
+            f"unknown generator '{name}' (available: {sorted(_GENERATORS)})"
+        )
+    kwargs = {}
+    for p in parts[1:]:
+        key, _, value = p.partition("=")
+        kwargs[key.strip()] = float(value) if "." in value else int(value)
+    return _GENERATORS[name](**kwargs)

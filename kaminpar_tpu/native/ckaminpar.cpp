@@ -27,7 +27,12 @@ struct kmp_partitioner {
 static bool ensure_python() {
   if (Py_IsInitialized()) return true;
   Py_InitializeEx(0);
-  return Py_IsInitialized();
+  if (!Py_IsInitialized()) return false;
+  // release the GIL acquired by initialization so OTHER threads'
+  // PyGILState_Ensure in kmp_compute_partition can take it (the header
+  // documents GIL-serialized multi-threaded use)
+  PyEval_SaveThread();
+  return true;
 }
 
 kmp_partitioner *kmp_create(const char *preset, int seed) {

@@ -34,10 +34,8 @@ from .segments import (
     ACC_DTYPE,
     INT32_MIN,
     accept_prefix_by_capacity,
-    aggregate_by_key,
-    argmax_per_segment,
-    connection_to_label,
-    hash_u32,
+    best_from_dense,
+    dense_block_ratings,
 )
 
 
@@ -78,30 +76,20 @@ def overload_balance_round(
     in_overloaded = (overload[part] > 0) & is_real
 
     # best feasible target per node: highest-connection non-overloaded block
-    # with room for the node
-    neigh_block = part[graph.dst]
-    seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
-    key_c = jnp.clip(key_g, 0, k - 1)
-    seg_c = jnp.clip(seg_g, 0, n_pad - 1)
-    tgt_ok = (
-        (seg_g >= 0)
-        & (key_g != part[seg_c])
-        & (overload[key_c] == 0)
-        & (graph.node_w[seg_c].astype(ACC_DTYPE) <= headroom[key_c])
+    # with room for the node (dense (n, k) rating — one segment_sum, no
+    # sort; bw + node_w <= cap excludes overloaded targets by itself)
+    conn = dense_block_ratings(
+        graph.src, graph.dst, graph.edge_w, part, n_pad, k
     )
-    best, best_w = argmax_per_segment(
-        seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=tgt_ok
+    best, best_w, w_own = best_from_dense(
+        conn, part, bw, graph.node_w, cap, salt
     )
-    # connection to own block (for the gain of leaving)
-    w_own = connection_to_label(seg_g, key_g, w_g, part, n_pad)
 
-    # fallback target for nodes with no feasible adjacent block: the block
-    # with maximum headroom (reference moves into any non-overloaded block)
-    fallback = jnp.argmax(headroom).astype(jnp.int32)
-    fallback_ok = graph.node_w.astype(ACC_DTYPE) <= headroom[fallback]
-    use_fallback = (best < 0) & fallback_ok
-    target = jnp.where(use_fallback, fallback, best)
-    gain = jnp.where(use_fallback, -w_own, best_w - w_own)
+    # (no separate fallback needed: the dense table rates every fitting
+    # block, including zero-connection ones, so best < 0 already means no
+    # block can take the node)
+    target = best
+    gain = best_w - w_own
 
     mover = in_overloaded & (target >= 0)
     target = jnp.where(mover, target, -1)
@@ -184,17 +172,13 @@ def underload_balance(
         surplus = jnp.maximum(bw - min_block_weights.astype(ACC_DTYPE), 0)
 
         # candidates: nodes in surplus blocks adjacent to a deficit block
-        neigh_block = part[graph.dst]
-        seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
-        key_c = jnp.clip(key_g, 0, k - 1)
-        seg_c = jnp.clip(seg_g, 0, n_pad - 1)
-        tgt_ok = (
-            (seg_g >= 0)
-            & (key_g != part[seg_c])
-            & (deficit[key_c] > 0)
+        # (dense rating restricted to deficit columns)
+        conn = dense_block_ratings(
+            graph.src, graph.dst, graph.edge_w, part, n_pad, k
         )
-        best, best_w = argmax_per_segment(
-            seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=tgt_ok
+        best, best_w, _ = best_from_dense(
+            conn, part, bw, graph.node_w, bw, salt,
+            require_fit=False, allowed=deficit > 0,
         )
         # fallback for deficit blocks with no adjacent candidates (e.g. an
         # empty block): pull arbitrary nodes into the most-deficient block

@@ -39,9 +39,8 @@ from .metrics import edge_cut
 from .segments import (
     ACC_DTYPE,
     INT32_MIN,
-    aggregate_by_key,
-    argmax_per_segment,
-    connection_to_label,
+    best_from_dense,
+    dense_block_ratings,
 )
 
 
@@ -60,14 +59,16 @@ def _jet_iteration(
     is_real = node_ids < graph.n
 
     # ---- find moves (jet_refiner.cc:104-131) ----
-    neigh_block = part[graph.dst]
-    seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
-    seg_c = jnp.clip(seg_g, 0, n_pad - 1)
-    is_ext = (seg_g >= 0) & (key_g != part[seg_c])
-    best, best_conn = argmax_per_segment(
-        seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=is_ext
+    # dense (n, k) rating table: one segment_sum, no edge-list sort (the
+    # gain-cache strategy Jet's paper assumes; caps checked by the
+    # balancer, so require_fit=False like the reference's candidate step)
+    conn = dense_block_ratings(
+        graph.src, graph.dst, graph.edge_w, part, n_pad, k
     )
-    conn_own = connection_to_label(seg_g, key_g, w_g, part, n_pad)
+    best, best_conn, conn_own = best_from_dense(
+        conn, part, jnp.zeros((k,), ACC_DTYPE), graph.node_w,
+        jnp.zeros((k,), ACC_DTYPE), salt, require_fit=False,
+    )
     gain = best_conn - conn_own  # gain of moving to best external block
     is_border = best >= 0
     threshold = -jnp.floor(gain_temp * conn_own.astype(jnp.float32)).astype(

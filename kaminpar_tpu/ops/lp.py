@@ -57,8 +57,13 @@ from .segments import (
     aggregate_by_key,
     apply_move_weight_delta,
     argmax_per_segment,
+    best_from_dense,
+    best_from_rating_table,
     connection_to_label,
+    connection_to_own_label,
+    dense_block_ratings,
     hash_u32,
+    hashed_rating_table,
 )
 
 
@@ -80,6 +85,24 @@ class LPConfig:
     # (LocalLPClusterer analog, kaminpar-dist/.../local_lp_clusterer.cc —
     # no cross-PE clusters, so contraction needs no label migration)
     dist_local_only: bool = False
+    # rating engine: "auto" picks dense (labels = k blocks) > hash (big
+    # edge lists, hashed slots, no sort) > sort (exact aggregate_by_key);
+    # see ops/segments.py "Sort-free rating engines"
+    rating: str = "auto"
+    num_slots: int = 32  # hashed engine slots per node
+    # m_pad at which "auto" switches sort -> hash
+    hash_threshold: int = 1 << 21
+
+
+def _select_engine(cfg: LPConfig, num_clusters: int, m_pad: int) -> str:
+    """Static (trace-time) rating engine choice."""
+    if cfg.rating != "auto":
+        return cfg.rating
+    if num_clusters <= 256:
+        return "dense"
+    if m_pad >= cfg.hash_threshold:
+        return "hash"
+    return "sort"
 
 
 def lp_round(
@@ -106,32 +129,53 @@ def lp_round(
     """
     n_pad = graph.n_pad
     C = cluster_weights.shape[0]
-
-    # -- rate ------------------------------------------------------------
-    neighbor_cluster = labels[graph.dst]
-    seg_g, key_g, w_g = aggregate_by_key(graph.src, neighbor_cluster, graph.edge_w)
-
-    # -- feasibility: stay always allowed; join only under the weight cap
-    key_c = jnp.clip(key_g, 0, C - 1)
-    seg_c = jnp.clip(seg_g, 0, n_pad - 1)
     cap = jnp.broadcast_to(max_cluster_weight, (C,))
-    fits = (
-        cluster_weights[key_c].astype(ACC_DTYPE)
-        + graph.node_w[seg_c].astype(ACC_DTYPE)
-        <= cap[key_c]
-    )
-    is_current = key_g == labels[seg_c]
-    feasible = (seg_g >= 0) & (is_current | fits)
-    if communities is not None:
-        # v-cycle community restriction: a cluster label is a node id, so
-        # the cluster's community is the label node's community
-        same_comm = communities[key_c] == communities[seg_c]
-        feasible = feasible & (is_current | same_comm)
+    engine = _select_engine(cfg, C, graph.m_pad)
 
-    best, best_w = argmax_per_segment(
-        seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=feasible
-    )
-    w_cur = connection_to_label(seg_g, key_g, w_g, labels, n_pad)
+    # -- rate: per-node best non-own cluster under the weight cap, plus
+    # the exact connection to the own cluster.  Three engines with one
+    # contract (see ops/segments.py "Sort-free rating engines").
+    neighbor_cluster = labels[graph.dst]
+    if engine == "dense":
+        conn = dense_block_ratings(
+            graph.src, graph.dst, graph.edge_w, labels, n_pad, C
+        )
+        best, best_w, w_cur = best_from_dense(
+            conn, labels, cluster_weights, graph.node_w, cap, salt,
+            communities=communities,
+        )
+    elif engine == "hash":
+        slot_label, slot_w = hashed_rating_table(
+            graph.src, neighbor_cluster, graph.edge_w, n_pad,
+            cfg.num_slots, salt,
+        )
+        best, best_w = best_from_rating_table(
+            slot_label, slot_w, labels, cluster_weights, graph.node_w,
+            cap, salt ^ 0x51AB, communities=communities,
+        )
+        w_cur = connection_to_own_label(
+            graph.src, neighbor_cluster, graph.edge_w, labels, n_pad
+        )
+    else:  # sort (exact enumeration of every adjacent cluster)
+        seg_g, key_g, w_g = aggregate_by_key(
+            graph.src, neighbor_cluster, graph.edge_w
+        )
+        key_c = jnp.clip(key_g, 0, C - 1)
+        seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+        fits = (
+            cluster_weights[key_c].astype(ACC_DTYPE)
+            + graph.node_w[seg_c].astype(ACC_DTYPE)
+            <= cap[key_c]
+        )
+        feasible = (seg_g >= 0) & (key_g != labels[seg_c]) & fits
+        if communities is not None:
+            # v-cycle community restriction: a cluster label is a node id,
+            # so the cluster's community is the label node's community
+            feasible = feasible & (communities[key_c] == communities[seg_c])
+        best, best_w = argmax_per_segment(
+            seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=feasible
+        )
+        w_cur = connection_to_label(seg_g, key_g, w_g, labels, n_pad)
 
     # -- select ----------------------------------------------------------
     gain = best_w - w_cur
@@ -231,7 +275,7 @@ def _lp_cluster_impl(
             )
         if cfg.two_hop:
             labels, weights = two_hop_cluster(
-                graph, labels, weights, max_cluster_weight, seed
+                graph, labels, weights, max_cluster_weight, seed, cfg
             )
     return labels
 
@@ -371,6 +415,7 @@ def two_hop_cluster(
     cluster_weights: jax.Array,
     max_cluster_weight: jax.Array,
     seed: jax.Array,
+    cfg: LPConfig = LPConfig(),
 ) -> Tuple[jax.Array, jax.Array]:
     """Two-hop clustering of leftover singletons (label_propagation.h:919-
     1191): singleton nodes that share the same *favored cluster* (their
@@ -388,10 +433,28 @@ def two_hop_cluster(
         & (graph.degrees > 0)
     )
 
-    # favored cluster = unconstrained best-rated cluster
+    # favored cluster = unconstrained best-rated cluster (same engine
+    # dispatch as lp_round; a singleton's own label never appears among
+    # its neighbors' labels, so own-exclusion is harmless here)
     neighbor_cluster = labels[graph.dst]
-    seg_g, key_g, w_g = aggregate_by_key(graph.src, neighbor_cluster, graph.edge_w)
-    favored, _ = argmax_per_segment(seg_g, key_g, w_g, n_pad, tie_salt=seed)
+    engine = _select_engine(cfg, cluster_weights.shape[0], graph.m_pad)
+    if engine == "hash":
+        slot_label, slot_w = hashed_rating_table(
+            graph.src, neighbor_cluster, graph.edge_w, n_pad,
+            cfg.num_slots, seed,
+        )
+        favored, _ = best_from_rating_table(
+            slot_label, slot_w, labels, cluster_weights, graph.node_w,
+            jnp.broadcast_to(max_cluster_weight, (cluster_weights.shape[0],)),
+            seed, require_fit=False,
+        )
+    else:
+        seg_g, key_g, w_g = aggregate_by_key(
+            graph.src, neighbor_cluster, graph.edge_w
+        )
+        favored, _ = argmax_per_segment(
+            seg_g, key_g, w_g, n_pad, tie_salt=seed
+        )
 
     fav = jnp.where(singleton & (favored >= 0), favored, -1)
     fav_c = jnp.clip(fav, 0, n_pad - 1)

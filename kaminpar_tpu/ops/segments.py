@@ -265,3 +265,200 @@ def compact_unique(labels: jax.Array, n_pad: int) -> Tuple[jax.Array, jax.Array]
     dense = rank[labels].astype(jnp.int32)
     num = jnp.sum(used)
     return dense, num
+
+
+# ---------------------------------------------------------------------------
+# Sort-free rating engines
+# ---------------------------------------------------------------------------
+#
+# aggregate_by_key is exact but costs a full 2-key sort of the edge list per
+# LP round — the dominant cost of the whole framework on TPU (XLA sorts are
+# many HBM passes; scatter-adds are one).  These engines produce the same
+# per-node (best cluster, weight) decisions with segment_sum/segment_max
+# only:
+#
+#   * hashed_rating_table — clustering (unbounded label space): per node, a
+#     fixed row of `num_slots` hash slots; each slot's *winner* label gets
+#     an EXACT connection-weight sum (every edge with that label lands in
+#     the same slot).  Colliding (non-winning) labels are simply not rated
+#     this round — the analog of the reference's two-phase rating-map
+#     overflow handling (label_propagation.h:62 kRatingMapThreshold), and
+#     the per-round salt rotates which label wins a contested slot.
+#
+#   * dense_block_ratings — refinement (labels are the k blocks): the full
+#     exact (n_pad, k) connection table in one segment_sum, no slots, no
+#     collisions.
+
+
+def hashed_rating_table(
+    src: jax.Array,
+    neighbor_label: jax.Array,
+    edge_w: jax.Array,
+    n_pad: int,
+    num_slots: int,
+    salt,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-node hashed rating rows.
+
+    Returns (slot_label, slot_w), both [n_pad, num_slots]: slot_label is
+    the slot's winning label (-1 for empty slots) and slot_w its exact
+    total connection weight from the row's node.
+    """
+    if n_pad * num_slots >= 2**31:
+        raise ValueError("n_pad * num_slots must fit in int32")
+    slot = hash_u32(neighbor_label, salt) % jnp.int32(num_slots)
+    flat = src.astype(jnp.int32) * num_slots + slot
+    total = n_pad * num_slots
+    # winner of a contested slot: max hashed key, ties broken by max label
+    key = hash_u32(neighbor_label, salt ^ 0x3779B97F)  # fits int32
+    kmax = jax.ops.segment_max(key, flat, num_segments=total)
+    is_kwin = key == kmax[flat]
+    lwin = jax.ops.segment_max(
+        jnp.where(is_kwin, neighbor_label, -1), flat, num_segments=total
+    )
+    is_win = is_kwin & (neighbor_label == lwin[flat])
+    w = jax.ops.segment_sum(
+        jnp.where(is_win, edge_w, 0).astype(ACC_DTYPE),
+        flat,
+        num_segments=total,
+    )
+    slot_label = jnp.where(kmax >= 0, lwin, -1)
+    return (
+        slot_label.reshape(n_pad, num_slots),
+        w.reshape(n_pad, num_slots),
+    )
+
+
+def best_from_rating_table(
+    slot_label: jax.Array,
+    slot_w: jax.Array,
+    labels: jax.Array,
+    cluster_weights: jax.Array,
+    node_w: jax.Array,
+    cap: jax.Array,
+    salt,
+    communities: jax.Array | None = None,
+    require_fit: bool = True,
+    label_range: Tuple[jax.Array, jax.Array] | None = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-node best move target from a hashed rating table: the
+    highest-weight slot whose label is not the node's own, fits under the
+    weight cap (unless require_fit=False), and shares the node's community
+    (when given).  `label_range=(lo, hi)` restricts targets to labels in
+    [lo, hi) — the LocalLPClusterer device-owned restriction.  Hashed
+    tie-breaking, same contract as argmax_per_segment: (best_label,
+    best_w) with -1/INT32_MIN when none.
+    """
+    n_pad, H = slot_label.shape
+    C = cluster_weights.shape[0]
+    lab_c = jnp.clip(slot_label, 0, C - 1)
+    feas = (slot_label >= 0) & (slot_label != labels[:, None])
+    if label_range is not None:
+        lo, hi = label_range
+        feas = feas & (slot_label >= lo) & (slot_label < hi)
+    if require_fit:
+        cap_b = jnp.broadcast_to(cap, (C,))
+        feas = feas & (
+            cluster_weights[lab_c].astype(ACC_DTYPE)
+            + node_w[:, None].astype(ACC_DTYPE)
+            <= cap_b[lab_c]
+        )
+    if communities is not None:
+        feas = feas & (communities[lab_c] == communities[:, None])
+    score = jnp.where(feas, slot_w, INT32_MIN)
+    best_w = jnp.max(score, axis=1)
+    has = best_w > INT32_MIN
+    is_best = feas & (score == best_w[:, None])
+    tb = hash_u32(slot_label, salt)
+    best_tb = jnp.max(jnp.where(is_best, tb, -1), axis=1)
+    winner = is_best & (tb == best_tb[:, None])
+    best = jnp.max(jnp.where(winner, slot_label, -1), axis=1)
+    return (
+        jnp.where(has, best, -1),
+        jnp.where(has, best_w, INT32_MIN),
+    )
+
+
+def connection_to_own_label(
+    src: jax.Array,
+    neighbor_label: jax.Array,
+    edge_w: jax.Array,
+    labels: jax.Array,
+    n_pad: int,
+) -> jax.Array:
+    """Exact per-node connection weight to the node's own label — one
+    masked segment_sum (sort-free replacement for connection_to_label)."""
+    match = neighbor_label == labels[jnp.clip(src, 0, n_pad - 1)]
+    return jax.ops.segment_sum(
+        jnp.where(match, edge_w, 0).astype(ACC_DTYPE),
+        src,
+        num_segments=n_pad,
+    )
+
+
+def dense_block_ratings(
+    src: jax.Array,
+    dst: jax.Array,
+    edge_w: jax.Array,
+    labels: jax.Array,
+    n_pad: int,
+    num_blocks: int,
+) -> jax.Array:
+    """Exact (n_pad, k) connection table in one flat segment_sum — the
+    rating engine for refinement, where labels are the k blocks (no sort,
+    no hash collisions; identical to gains.build_dense_gain_cache but on
+    raw arrays)."""
+    lab_c = jnp.clip(labels, 0, num_blocks - 1)
+    flat = src.astype(jnp.int32) * num_blocks + lab_c[dst]
+    conn = jax.ops.segment_sum(
+        edge_w.astype(ACC_DTYPE), flat, num_segments=n_pad * num_blocks
+    )
+    return conn.reshape(n_pad, num_blocks)
+
+
+def best_from_dense(
+    conn: jax.Array,
+    labels: jax.Array,
+    cluster_weights: jax.Array,
+    node_w: jax.Array,
+    cap: jax.Array,
+    salt,
+    communities: jax.Array | None = None,
+    require_fit: bool = True,
+    allowed: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-node (best_block, best_w, w_own) from a dense rating table,
+    excluding the node's own block, with hashed tie-breaking.
+
+    `communities` (clustering only — there column j is node id j) masks
+    columns whose community differs from the row node's; `allowed`
+    (bool[k]) masks whole columns (balancer target restrictions)."""
+    n_pad, k = conn.shape
+    lab_col = jnp.clip(labels, 0, k - 1)
+    w_own = jnp.take_along_axis(conn, lab_col[:, None], axis=1)[:, 0]
+    cols = jnp.arange(k, dtype=jnp.int32)
+    feas = cols[None, :] != lab_col[:, None]
+    if allowed is not None:
+        feas = feas & allowed[None, :]
+    if require_fit:
+        cap_b = jnp.broadcast_to(cap, (k,)).astype(ACC_DTYPE)
+        feas = feas & (
+            cluster_weights[None, :].astype(ACC_DTYPE)
+            + node_w[:, None].astype(ACC_DTYPE)
+            <= cap_b[None, :]
+        )
+    if communities is not None:
+        feas = feas & (communities[:k][None, :] == communities[:, None])
+    score = jnp.where(feas, conn, INT32_MIN)
+    best_w = jnp.max(score, axis=1)
+    has = best_w > INT32_MIN
+    is_best = feas & (score == best_w[:, None])
+    tb = hash_u32(jnp.broadcast_to(cols[None, :], conn.shape), salt)
+    best_tb = jnp.max(jnp.where(is_best, tb, -1), axis=1)
+    winner = is_best & (tb == best_tb[:, None])
+    best = jnp.max(jnp.where(winner, cols[None, :], -1), axis=1)
+    return (
+        jnp.where(has, best, -1),
+        jnp.where(has, best_w, INT32_MIN),
+        w_own,
+    )

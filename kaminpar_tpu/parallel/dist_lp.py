@@ -48,8 +48,13 @@ from ..ops.segments import (
     accept_prefix_by_capacity,
     aggregate_by_key,
     argmax_per_segment,
+    best_from_dense,
+    best_from_rating_table,
+    dense_block_ratings,
     connection_to_label,
+    connection_to_own_label,
     hash_u32,
+    hashed_rating_table,
     move_weight_delta,
 )
 from .dist_graph import DistGraph
@@ -84,28 +89,57 @@ def _dist_lp_round(
     labels_l = lax.dynamic_slice(labels, (offset,), (n_loc,))
     node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
 
-    # -- rate: local segmented rating-map fill (seg = local node id) -----
+    # -- rate: per-owned-node best cluster over the local edge shard,
+    # same engine dispatch as the single-chip lp_round (ops/lp.py): the
+    # device holds every edge of its owned nodes, so hashed winner sums
+    # and dense tables are exact locally
+    from ..ops.lp import _select_engine
+
     neighbor_cluster = labels[dst_l]
     seg = src_l - offset
-    seg_g, key_g, w_g = aggregate_by_key(seg, neighbor_cluster, ew_l)
-
-    key_c = jnp.clip(key_g, 0, C - 1)
-    seg_c = jnp.clip(seg_g, 0, n_loc - 1)
-    fits = (
-        weights[key_c].astype(ACC_DTYPE) + nw_l[seg_c].astype(ACC_DTYPE)
-        <= cap[key_c]
-    )
-    is_current = key_g == labels_l[seg_c]
-    feasible = (seg_g >= 0) & (is_current | fits)
-    if cfg.dist_local_only:
-        # LocalLPClusterer semantics: only join clusters led by an owned
-        # node, so clusters never span device boundaries
-        owned = (key_g >= offset) & (key_g < offset + n_loc)
-        feasible = feasible & (is_current | owned)
-    best, best_w = argmax_per_segment(
-        seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=feasible
-    )
-    w_cur = connection_to_label(seg_g, key_g, w_g, labels_l, n_loc)
+    engine = _select_engine(cfg, C, src_l.shape[0])
+    if engine == "dense":
+        conn = dense_block_ratings(seg, dst_l, ew_l, labels, n_loc, C)
+        allowed = None
+        if cfg.dist_local_only:
+            # LocalLPClusterer: only clusters led by owned nodes
+            col = jnp.arange(C, dtype=jnp.int32)
+            allowed = (col >= offset) & (col < offset + n_loc)
+        best, best_w, w_cur = best_from_dense(
+            conn, labels_l, weights, nw_l, cap, salt, allowed=allowed
+        )
+    elif engine == "hash":
+        slot_label, slot_w = hashed_rating_table(
+            seg, neighbor_cluster, ew_l, n_loc, cfg.num_slots, salt
+        )
+        label_range = None
+        if cfg.dist_local_only:
+            # LocalLPClusterer semantics: only join clusters led by an
+            # owned node, so clusters never span device boundaries
+            label_range = (offset, offset + n_loc)
+        best, best_w = best_from_rating_table(
+            slot_label, slot_w, labels_l, weights, nw_l, cap,
+            salt ^ 0x51AB, label_range=label_range,
+        )
+        w_cur = connection_to_own_label(
+            seg, neighbor_cluster, ew_l, labels_l, n_loc
+        )
+    else:  # sort
+        seg_g, key_g, w_g = aggregate_by_key(seg, neighbor_cluster, ew_l)
+        key_c = jnp.clip(key_g, 0, C - 1)
+        seg_c = jnp.clip(seg_g, 0, n_loc - 1)
+        fits = (
+            weights[key_c].astype(ACC_DTYPE) + nw_l[seg_c].astype(ACC_DTYPE)
+            <= cap[key_c]
+        )
+        feasible = (seg_g >= 0) & (key_g != labels_l[seg_c]) & fits
+        if cfg.dist_local_only:
+            owned = (key_g >= offset) & (key_g < offset + n_loc)
+            feasible = feasible & owned
+        best, best_w = argmax_per_segment(
+            seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=feasible
+        )
+        w_cur = connection_to_label(seg_g, key_g, w_g, labels_l, n_loc)
 
     # -- select (same policy as the single-chip lp_round) ----------------
     gain = best_w - w_cur

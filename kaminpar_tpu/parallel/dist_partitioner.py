@@ -137,25 +137,35 @@ class dKaMinPar:
                 levels.append((dg, cmap, current))
                 current = coarse
 
-        # initial partitioning: shm pipeline on the coarsest graph
-        # (replicate_graph_everywhere + shm KaMinPar analog)
+        # initial partitioning: shm pipeline on the coarsest graph.  The
+        # reference replicates the coarsest graph onto every PE, runs shm
+        # KaMinPar per PE with that PE's seed, and keeps the best cut
+        # (replicate_graph_everywhere + distribute_best_partition,
+        # kaminpar-dist/partitioning/deep_multilevel.cc:125-176).  One
+        # host plays all PEs: independent seeded runs, best cut wins.
         with timer.scoped_timer("dist-initial-partitioning"):
             from ..kaminpar import KaMinPar
             from ..utils.logger import OutputLevel, output_level, set_output_level
 
-            shm_ctx = self.ctx.shm.copy()
-            shm = KaMinPar(shm_ctx)
-            # quiet the nested shm run without leaking the process-global
-            # logger level past this scope
+            num_replicas = max(1, min(self.mesh.devices.size, 4))
             outer_level = output_level()
-            shm.set_output_level(OutputLevel.QUIET)
+            partition = None
+            best_cut = None
             try:
-                shm.set_graph(current)
-                partition = shm.compute_partition(
-                    k=k,
-                    epsilon=self.ctx.partition.epsilon,
-                    seed=self.ctx.seed,
-                )
+                for r in range(num_replicas):
+                    shm = KaMinPar(self.ctx.shm.copy())
+                    # quiet the nested shm runs without leaking the
+                    # process-global logger level past this scope
+                    shm.set_output_level(OutputLevel.QUIET)
+                    shm.set_graph(current)
+                    cand = shm.compute_partition(
+                        k=k,
+                        epsilon=self.ctx.partition.epsilon,
+                        seed=(self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
+                    )
+                    cut = self._host_cut(current, cand)
+                    if best_cut is None or cut < best_cut:
+                        partition, best_cut = cand, cut
             finally:
                 set_output_level(outer_level)
 

@@ -54,6 +54,8 @@ def build_dense_gain_cache(
     One flat segment_sum over the COO edge list (the bulk analog of
     SparseGainCache::initialize's per-node aggregation)."""
     n_pad = graph.n_pad
+    if n_pad * k >= 2**31:
+        raise ValueError("n_pad * k must fit in int32")
     part_c = jnp.clip(partition, 0, k - 1)
     flat = graph.src.astype(jnp.int32) * k + part_c[graph.dst]
     conn = jax.ops.segment_sum(

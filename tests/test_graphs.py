@@ -113,3 +113,32 @@ def test_extract_block_subgraphs():
         validate(sub)
     # block 0 = left 2x2 square => 4 undirected internal edges
     assert ext.subgraphs[0].m == 8
+
+
+def test_kagen_style_generators():
+    """KaGen generator parity (dist_skagen.cc analog): every generator
+    yields a valid undirected HostGraph of the requested size."""
+    from kaminpar_tpu.graphs.factories import generate
+    from kaminpar_tpu.graphs.host import validate
+
+    for spec, n_expect in [
+        ("rgg2d;n=512;avg_degree=6.0;seed=1", 512),
+        ("rgg3d;n=512;avg_degree=6.0;seed=1", 512),
+        ("rmat;n=256;m=2048;seed=2", 256),
+        ("gnm;n=300;m=1500;seed=3", 300),
+        ("ba;n=200;d=3;seed=4", 200),
+        ("grid2d;rows=8;cols=9", 72),
+        ("grid3d;x=4;y=5;z=6", 120),
+    ]:
+        g = generate(spec)
+        validate(g)
+        assert g.n == n_expect, spec
+        assert g.m > 0, spec
+
+
+def test_generator_cli_spec_errors():
+    from kaminpar_tpu.graphs.factories import generate
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        generate("nosuch;n=5")
