@@ -64,6 +64,7 @@ from .segments import (
     dense_block_ratings,
     hash_u32,
     hashed_rating_table,
+    rating_top3_by_sort,
 )
 
 
@@ -94,14 +95,27 @@ class LPConfig:
     hash_threshold: int = 1 << 21
 
 
-def _select_engine(cfg: LPConfig, num_clusters: int, m_pad: int) -> str:
-    """Static (trace-time) rating engine choice."""
+def _select_engine(
+    cfg: LPConfig,
+    num_clusters: int,
+    m_pad: int,
+    has_communities: bool = False,
+) -> str:
+    """Static (trace-time) rating engine choice.  sort2 (the fastest
+    clustering engine — one edge gather + two sorts, no scatters) does not
+    support the v-cycle community restriction, so community-constrained
+    clustering falls back to the hashed engine."""
     if cfg.rating != "auto":
+        if cfg.rating == "sort2" and has_communities:
+            raise ValueError(
+                "rating='sort2' cannot enforce the community restriction; "
+                "use 'hash' or 'sort' (or rating='auto')"
+            )
         return cfg.rating
     if num_clusters <= 256:
         return "dense"
     if m_pad >= cfg.hash_threshold:
-        return "hash"
+        return "hash" if has_communities else "sort2"
     return "sort"
 
 
@@ -130,13 +144,47 @@ def lp_round(
     n_pad = graph.n_pad
     C = cluster_weights.shape[0]
     cap = jnp.broadcast_to(max_cluster_weight, (C,))
-    engine = _select_engine(cfg, C, graph.m_pad)
+    engine = _select_engine(cfg, C, graph.m_pad, communities is not None)
 
     # -- rate: per-node best non-own cluster under the weight cap, plus
-    # the exact connection to the own cluster.  Three engines with one
-    # contract (see ops/segments.py "Sort-free rating engines").
+    # the exact connection to the own cluster.  Engines with one contract
+    # (see ops/segments.py "Sort-free rating engines").
     neighbor_cluster = labels[graph.dst]
-    if engine == "dense":
+    if engine == "sort2":
+        # top-3 clusters per node, then node-level own-exclusion +
+        # feasibility fallback chain.  w_cur is exact when the own
+        # cluster ranks top-3, else bounded above by the 3rd total —
+        # which UNDERestimates gains, i.e. errs toward fewer moves
+        lab3w = rating_top3_by_sort(graph, neighbor_cluster, salt)
+        l1, v1, l2, v2, l3, v3 = lab3w
+        own = labels
+        w_cur = jnp.where(
+            l1 == own, v1,
+            jnp.where(
+                l2 == own, v2,
+                jnp.where(
+                    l3 == own, v3,
+                    jnp.where(l3 >= 0, jnp.maximum(v3, 0), 0),
+                ),
+            ),
+        )
+
+        def fits(lab):
+            lab_c = jnp.clip(lab, 0, C - 1)
+            return (lab >= 0) & (
+                cluster_weights[lab_c].astype(ACC_DTYPE)
+                + graph.node_w.astype(ACC_DTYPE)
+                <= cap[lab_c]
+            )
+
+        ok1 = (l1 != own) & fits(l1)
+        ok2 = (l2 != own) & fits(l2)
+        ok3 = (l3 != own) & fits(l3)
+        best = jnp.where(ok1, l1, jnp.where(ok2, l2, jnp.where(ok3, l3, -1)))
+        best_w = jnp.where(
+            ok1, v1, jnp.where(ok2, v2, jnp.where(ok3, v3, INT32_MIN))
+        )
+    elif engine == "dense":
         conn = dense_block_ratings(
             graph.src, graph.dst, graph.edge_w, labels, n_pad, C
         )
@@ -207,8 +255,13 @@ def lp_round(
     )
 
     # -- active set refresh (label_propagation.h:507-513): a node is active
-    # next round iff it or one of its neighbors moved this round
-    if cfg.use_active_set:
+    # next round iff it or one of its neighbors moved this round.  In the
+    # async reference this SAVES work (inactive nodes are skipped); in a
+    # bulk-synchronous round every node is computed regardless, so the
+    # neighbor propagation is pure overhead (an edge-wide gather+scatter,
+    # the two most expensive TPU ops) — the fast engine keeps everyone
+    # active and lets the num_wanting convergence test do its job
+    if cfg.use_active_set and engine != "sort2":
         moved_i32 = accept.astype(jnp.int32)
         neigh_moved = jax.ops.segment_max(
             moved_i32[graph.dst], graph.src, num_segments=n_pad
@@ -438,7 +491,13 @@ def two_hop_cluster(
     # its neighbors' labels, so own-exclusion is harmless here)
     neighbor_cluster = labels[graph.dst]
     engine = _select_engine(cfg, cluster_weights.shape[0], graph.m_pad)
-    if engine == "hash":
+    if engine == "sort2":
+        # a singleton's own label never appears among its neighbors, so
+        # the top-1 rated cluster IS the favored cluster
+        favored, _, _, _, _, _ = rating_top3_by_sort(
+            graph, neighbor_cluster, seed
+        )
+    elif engine == "hash":
         slot_label, slot_w = hashed_rating_table(
             graph.src, neighbor_cluster, graph.edge_w, n_pad,
             cfg.num_slots, seed,
