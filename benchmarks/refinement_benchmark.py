@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Standalone refinement benchmark.
+
+Analog of apps/benchmarks/shm_refinement_benchmark.cc: drive ONE refiner
+on a given graph + random (or supplied) partition and report wall-clock
+and cut improvement.
+
+Usage:
+  python benchmarks/refinement_benchmark.py <graph|gen:spec> -k 16
+      --refiner jet|lp|balancer [--reps 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("graph")
+    p.add_argument("-k", type=int, default=16)
+    p.add_argument("--refiner", default="jet", choices=["jet", "lp", "balancer"])
+    p.add_argument("--epsilon", type=float, default=0.03)
+    p.add_argument("--reps", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaminpar_tpu import io as io_mod
+    from kaminpar_tpu.context import JetRefinementContext
+    from kaminpar_tpu.graphs.csr import device_graph_from_host
+    from kaminpar_tpu.graphs.factories import generate
+    from kaminpar_tpu.ops import metrics
+    from kaminpar_tpu.ops.balancer import overload_balance
+    from kaminpar_tpu.ops.jet import jet_refine
+    from kaminpar_tpu.ops.lp import lp_refine
+
+    if args.graph.startswith("gen:"):
+        host = generate(args.graph)
+    else:
+        host = io_mod.load_graph(args.graph)
+    graph = device_graph_from_host(host)
+    k = args.k
+    rng = np.random.default_rng(args.seed)
+    part0 = np.zeros(graph.n_pad, np.int32)
+    part0[: host.n] = rng.integers(0, k, host.n)
+    part0 = jnp.asarray(part0)
+    nw = host.node_weight_array()
+    cap = int(np.ceil(nw.sum() / k * (1 + args.epsilon)))
+    caps = jnp.full((k,), cap, jnp.int32)
+
+    def run(seed):
+        if args.refiner == "jet":
+            return jet_refine(graph, part0, k, caps, jnp.int32(seed),
+                              JetRefinementContext())
+        if args.refiner == "lp":
+            return lp_refine(graph, part0, k, caps, jnp.int32(seed))
+        return overload_balance(graph, part0, k, caps, jnp.int32(seed))
+
+    cut0 = int(metrics.edge_cut(graph, part0))
+    out = run(args.seed)
+    int(jnp.sum(out))
+    best = float("inf")
+    for r in range(args.reps):
+        t = time.perf_counter()
+        out = run(args.seed + r)
+        int(jnp.sum(out))
+        best = min(best, time.perf_counter() - t)
+    cut1 = int(metrics.edge_cut(graph, out))
+    bw = np.zeros(k, np.int64)
+    np.add.at(bw, np.asarray(out)[: host.n], nw)
+    print(json.dumps({
+        "n": int(host.n), "m": int(host.m), "k": k,
+        "refiner": args.refiner,
+        "seconds": round(best, 4),
+        "cut_before": cut0, "cut_after": cut1,
+        "max_block_weight": int(bw.max()), "cap": cap,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

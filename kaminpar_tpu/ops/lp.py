@@ -91,8 +91,13 @@ class LPConfig:
     # see ops/segments.py "Sort-free rating engines"
     rating: str = "auto"
     num_slots: int = 32  # hashed engine slots per node
-    # m_pad at which "auto" switches sort -> hash
+    # m_pad at which "auto" switches sort -> sort2/hash
     hash_threshold: int = 1 << 21
+    # sort2: how many top clusters to read per node (n-sized reads, cheap)
+    topk: int = 6
+    # sort2: below this m_pad, compute the own-cluster connection exactly
+    # with one edge-wide pass instead of the top-K bound
+    exact_wcur_threshold: int = 1 << 23
 
 
 def _select_engine(
@@ -151,23 +156,31 @@ def lp_round(
     # (see ops/segments.py "Sort-free rating engines").
     neighbor_cluster = labels[graph.dst]
     if engine == "sort2":
-        # top-3 clusters per node, then node-level own-exclusion +
-        # feasibility fallback chain.  w_cur is exact when the own
-        # cluster ranks top-3, else bounded above by the 3rd total —
-        # which UNDERestimates gains, i.e. errs toward fewer moves
-        lab3w = rating_top3_by_sort(graph, neighbor_cluster, salt)
-        l1, v1, l2, v2, l3, v3 = lab3w
-        own = labels
-        w_cur = jnp.where(
-            l1 == own, v1,
-            jnp.where(
-                l2 == own, v2,
-                jnp.where(
-                    l3 == own, v3,
-                    jnp.where(l3 >= 0, jnp.maximum(v3, 0), 0),
-                ),
-            ),
+        # top-K clusters per node, then node-level own-exclusion +
+        # feasibility fallback chain
+        K = cfg.topk
+        topk = rating_top3_by_sort(
+            graph, neighbor_cluster, salt, k_best=K
         )
+        labs = topk[0::2]
+        vals = topk[1::2]
+        own = labels
+
+        # w_cur: exact when the own cluster ranks top-K or when the edge
+        # list is small enough that an exact edge-wide pass is cheap;
+        # otherwise bounded above by the K-th total (which UNDERestimates
+        # gains, i.e. errs toward fewer moves).  Dense coarse levels have
+        # small m, so they get the exact path and keep converging.
+        if graph.m_pad <= cfg.exact_wcur_threshold:
+            w_cur = connection_to_own_label(
+                graph.src, neighbor_cluster, graph.edge_w, labels, n_pad
+            )
+        else:
+            w_cur = jnp.where(
+                labs[-1] >= 0, jnp.maximum(vals[-1], 0), 0
+            )
+            for lab_j, val_j in zip(reversed(labs), reversed(vals)):
+                w_cur = jnp.where(lab_j == own, val_j, w_cur)
 
         def fits(lab):
             lab_c = jnp.clip(lab, 0, C - 1)
@@ -177,13 +190,12 @@ def lp_round(
                 <= cap[lab_c]
             )
 
-        ok1 = (l1 != own) & fits(l1)
-        ok2 = (l2 != own) & fits(l2)
-        ok3 = (l3 != own) & fits(l3)
-        best = jnp.where(ok1, l1, jnp.where(ok2, l2, jnp.where(ok3, l3, -1)))
-        best_w = jnp.where(
-            ok1, v1, jnp.where(ok2, v2, jnp.where(ok3, v3, INT32_MIN))
-        )
+        best = jnp.full(n_pad, -1, dtype=jnp.int32)
+        best_w = jnp.full(n_pad, INT32_MIN, dtype=ACC_DTYPE)
+        for lab_j, val_j in zip(reversed(labs), reversed(vals)):
+            ok = (lab_j != own) & fits(lab_j)
+            best = jnp.where(ok, lab_j, best)
+            best_w = jnp.where(ok, val_j, best_w)
     elif engine == "dense":
         conn = dense_block_ratings(
             graph.src, graph.dst, graph.edge_w, labels, n_pad, C
@@ -493,20 +505,21 @@ def two_hop_cluster(
     engine = _select_engine(cfg, cluster_weights.shape[0], graph.m_pad)
     if engine == "sort2":
         # a singleton's own label never appears among its neighbors, so
-        # the top-1 rated cluster IS the favored cluster
-        favored, _, _, _, _, _ = rating_top3_by_sort(
-            graph, neighbor_cluster, seed
-        )
+        # the top-1 rated cluster IS the favored cluster; zero-weight
+        # ratings (sparsified-away or pad edges) are not real favorites
+        top = rating_top3_by_sort(graph, neighbor_cluster, seed, k_best=1)
+        favored = jnp.where(top[1] > 0, top[0], -1)
     elif engine == "hash":
         slot_label, slot_w = hashed_rating_table(
             graph.src, neighbor_cluster, graph.edge_w, n_pad,
             cfg.num_slots, seed,
         )
-        favored, _ = best_from_rating_table(
+        favored, fav_w = best_from_rating_table(
             slot_label, slot_w, labels, cluster_weights, graph.node_w,
             jnp.broadcast_to(max_cluster_weight, (cluster_weights.shape[0],)),
             seed, require_fit=False,
         )
+        favored = jnp.where(fav_w > 0, favored, -1)
     else:
         seg_g, key_g, w_g = aggregate_by_key(
             graph.src, neighbor_cluster, graph.edge_w

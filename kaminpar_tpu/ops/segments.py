@@ -474,9 +474,11 @@ def rating_top3_by_sort(
     graph,
     neighbor_label: jax.Array,
     salt,
+    k_best: int = 3,
 ) -> Tuple[jax.Array, ...]:
-    """Top-3 rated clusters per node with NO scatters and NO node->edge
-    label expansion — the fast clustering rating engine ("sort2").
+    """Top-k_best rated clusters per node with NO scatters and NO
+    node->edge label expansion — the fast clustering rating engine
+    ("sort2").
 
     TPU cost model (measured on v5e): irregular gathers/scatters cost
     ~7.5 ns *per index* (a 33M-edge expansion is ~250 ms) while sorts are
@@ -491,13 +493,16 @@ def rating_top3_by_sort(
               (cum - w at group starts is monotone because weights >= 0)
       sort2   order by (src, group_total, tie_hash): each node's top
               clusters land at the end of its CSR row span
-      read    the 3 best (label, weight) pairs per node at row end - j
+      read    the k_best best (label, weight) pairs per node at row end-j
 
-    Returns (lab1, w1, lab2, w2, lab3, w3), each [n_pad]; absent entries
-    are (-1, INT32_MIN).  Own-cluster exclusion, feasibility, and the
-    connection-to-own estimate are applied by the caller at node level
-    (see ops/lp.py), trading the reference's exact rating-time feasibility
-    (find_best_cluster:461-541) for a 33M-gather-free round.
+    Returns (lab1, w1, ..., lab_k, w_k) for the `k_best` top clusters,
+    each [n_pad]; absent entries are (-1, INT32_MIN).  Own-cluster
+    exclusion, feasibility, and the connection-to-own estimate are applied
+    by the caller at node level (see ops/lp.py), trading the reference's
+    exact rating-time feasibility (find_best_cluster:461-541) for a
+    33M-gather-free round.  The extra top-j reads are n-sized gathers —
+    nearly free — so a larger k_best costs almost nothing and improves the
+    caller's own-connection estimate on dense (coarse) graphs.
     """
     n_pad = graph.n_pad
     src = graph.src
@@ -522,7 +527,7 @@ def rating_top3_by_sort(
     deg = graph.row_ptr[1:] - graph.row_ptr[:-1]
     end = graph.row_ptr[1:]
     out = []
-    for j in range(3):
+    for j in range(k_best):
         pos = jnp.clip(end - 1 - j, 0, prio2.shape[0] - 1)
         valid = (deg > j) & (prio2[pos] >= 0)
         out.append(jnp.where(valid, lab2[pos], -1))

@@ -9,9 +9,11 @@ is preserved), turns dropped edges into inert pad edges, and rescales kept
 edge weights by 1/keep_ratio so expected cut weights are preserved — the
 unbiased-sparsifier trick the paper uses.
 
-Dropped edges keep their slots (static shapes); `row_ptr` degrees become
-upper bounds, which only affects the isolated-node heuristic, not
-correctness.
+Dropped edges keep their slots AND their src (static shapes, and the CSR
+row spans stay exact — the sort2 rating engine reads per-node results at
+row boundaries, segments.py rating_top3_by_sort); only dst is repointed
+to the pad node and the weight zeroed, which makes them inert in ratings,
+cuts, and contractions.
 """
 
 from __future__ import annotations
@@ -52,7 +54,7 @@ def sparsify_edges(
 
     return DeviceGraph(
         row_ptr=graph.row_ptr,
-        src=jnp.where(drop, pad_node, graph.src),
+        src=graph.src,  # keep: CSR row spans must stay exact for sort2
         dst=jnp.where(drop, pad_node, graph.dst),
         edge_w=jnp.where(drop, 0, jnp.where(is_real, new_w, 0)),
         node_w=graph.node_w,

@@ -97,11 +97,18 @@ def _dist_lp_round(
 
     neighbor_cluster = labels[dst_l]
     seg = src_l - offset
+    if cfg.rating == "sort2":
+        # sort2 needs CSR row spans, which the sharded COO layout does not
+        # carry — reject the explicit request rather than silently running
+        # a different engine
+        raise ValueError(
+            "rating='sort2' is not available on the distributed path; "
+            "use 'hash', 'sort', or 'auto'"
+        )
     engine = _select_engine(cfg, C, src_l.shape[0])
     if engine == "sort2":
-        # sort2 needs CSR row spans, which the sharded COO layout does not
-        # carry (pad edges break per-device src ordering); the hashed
-        # engine is the fast path for large local shards here
+        # auto selection: the hashed engine is the fast path for large
+        # local shards here
         engine = "hash"
     if engine == "dense":
         conn = dense_block_ratings(seg, dst_l, ew_l, labels, n_loc, C)
