@@ -271,6 +271,11 @@ class PartitioningSchemeContext:
     )
     deep_initial_partitioning_load: float = 1.0
     refine_after_extending_partition: bool = False
+    # extend_partition blocks at least this large are bipartitioned through
+    # the device pipeline (LP coarsening + 2-way device refinement) instead
+    # of the sequential host pool — the TPU answer to the reference running
+    # many host bipartitions in parallel TBB tasks (helper.cc:220)
+    device_bipartition_threshold: int = 1 << 14
     vcycles: List[int] = field(default_factory=list)
     restrict_vcycle_refinement: bool = False
     rb_enable_kway_toplevel_refinement: bool = False

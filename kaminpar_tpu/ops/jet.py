@@ -120,113 +120,147 @@ def _jet_iteration(
 
 @partial(
     jax.jit,
-    static_argnames=(
-        "k",
-        "num_rounds",
-        "max_iterations",
-        "max_fruitless",
-        "balancer_rounds",
-    ),
+    static_argnames=("k", "max_fruitless", "balancer_rounds"),
 )
+def _jet_chunk(
+    graph: DeviceGraph,
+    part: jax.Array,
+    lock: jax.Array,
+    best: jax.Array,
+    best_cut: jax.Array,
+    fruitless: jax.Array,
+    i0: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    gain_temp: jax.Array,
+    fruitless_threshold: jax.Array,
+    seed: jax.Array,
+    rnd: jax.Array,
+    limit: jax.Array,
+    max_fruitless: int,
+    balancer_rounds: int,
+):
+    """A bounded chunk of Jet iterations in one device program.
+
+    Jet used to run all (up to 64) iterations inside a single fused
+    while_loop; at ~33M-edge shapes the multi-minute single launch
+    reproducibly killed the TPU worker.  The host now drives the
+    iteration loop in chunks, reading back the fruitless counter between
+    chunks (one scalar sync per `chunk` iterations)."""
+
+    def is_feasible(p):
+        bw = jax.ops.segment_sum(
+            graph.node_w.astype(ACC_DTYPE), p, num_segments=k
+        )
+        return jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
+
+    def iter_cond(state):
+        j, fruitless, part, lock, best, best_cut = state
+        # `limit` is traced, so a short remainder chunk reuses the same
+        # compiled program instead of triggering a second trace
+        return (j < limit) & (fruitless < max_fruitless)
+
+    def iter_body(state):
+        j, fruitless, part, lock, best, best_cut = state
+        i = i0 + j
+        salt = (
+            seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
+        ) & 0x7FFFFFFF
+        part, lock = _jet_iteration(
+            graph,
+            part,
+            lock,
+            k,
+            max_block_weights,
+            gain_temp,
+            salt,
+            balancer_rounds,
+        )
+        cut = edge_cut(graph, part)
+        # while best_cut is still the no-feasible-partition sentinel,
+        # "improvement" means finding the first feasible partition —
+        # comparing against the sentinel would defeat the fruitless
+        # early-exit entirely
+        has_best = best_cut < jnp.iinfo(jnp.int32).max
+        improved_enough = jnp.where(
+            has_best,
+            (best_cut - cut).astype(jnp.float32)
+            > (1.0 - fruitless_threshold)
+            * jnp.abs(best_cut).astype(jnp.float32),
+            is_feasible(part),
+        )
+        fruitless = jnp.where(improved_enough, 0, fruitless + 1)
+        is_best = (cut <= best_cut) & is_feasible(part)
+        best = jnp.where(is_best, part, best)
+        best_cut = jnp.where(is_best, cut, best_cut)
+        return (j + 1, fruitless, part, lock, best, best_cut)
+
+    _, fruitless, part, lock, best, best_cut = lax.while_loop(
+        iter_cond,
+        iter_body,
+        (jnp.int32(0), fruitless, part, lock, best, best_cut),
+    )
+    return part, lock, best, best_cut, fruitless
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _jet_init(graph: DeviceGraph, partition: jax.Array, k: int,
+              max_block_weights: jax.Array):
+    part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
+    bw = jax.ops.segment_sum(
+        graph.node_w.astype(ACC_DTYPE), part0, num_segments=k
+    )
+    feasible = jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
+    # snapshots track the best FEASIBLE cut; an infeasible input (e.g.
+    # everything in one block, cut 0) must not pin the snapshot
+    best_cut0 = jnp.where(
+        feasible, edge_cut(graph, part0), jnp.iinfo(jnp.int32).max
+    )
+    return part0, best_cut0
+
+
 def _jet_refine_impl(
     graph: DeviceGraph,
     partition: jax.Array,
     k: int,
     max_block_weights: jax.Array,
     seed: jax.Array,
-    initial_gain_temp: jax.Array,
-    final_gain_temp: jax.Array,
-    fruitless_threshold: jax.Array,
+    initial_gain_temp,
+    final_gain_temp,
+    fruitless_threshold,
     num_rounds: int,
     max_iterations: int,
     max_fruitless: int,
     balancer_rounds: int,
+    chunk: int = 4,
 ) -> jax.Array:
-    part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
-
-    def is_feasible(part):
-        bw = jax.ops.segment_sum(
-            graph.node_w.astype(ACC_DTYPE), part, num_segments=k
-        )
-        return jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
-
-    # snapshots track the best FEASIBLE cut; an infeasible input (e.g.
-    # everything in one block, cut 0) must not pin the snapshot
-    best0 = part0
-    best_cut0 = jnp.where(
-        is_feasible(part0), edge_cut(graph, part0), jnp.iinfo(jnp.int32).max
-    )
-
-    def round_body(rnd, carry):
-        part, best, best_cut = carry
-        gain_temp = jnp.where(
-            num_rounds > 1,
-            initial_gain_temp
-            + (final_gain_temp - initial_gain_temp)
-            * rnd.astype(jnp.float32)
-            / jnp.float32(max(num_rounds - 1, 1)),
-            initial_gain_temp,
-        )
-
-        def iter_cond(state):
-            i, fruitless, part, lock, best, best_cut, last_best = state
-            return (i < max_iterations) & (fruitless < max_fruitless)
-
-        def iter_body(state):
-            i, fruitless, part, lock, best, best_cut, last_best = state
-            salt = (
-                seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
-            ) & 0x7FFFFFFF
-            part, lock = _jet_iteration(
-                graph,
-                part,
-                lock,
-                k,
-                max_block_weights,
-                gain_temp,
-                salt,
+    part, best_cut = _jet_init(graph, partition, k, max_block_weights)
+    best = part
+    for rnd in range(num_rounds):
+        if num_rounds > 1:
+            gain_temp = initial_gain_temp + (
+                final_gain_temp - initial_gain_temp
+            ) * rnd / max(num_rounds - 1, 1)
+        else:
+            gain_temp = initial_gain_temp
+        lock = jnp.zeros(graph.n_pad, dtype=jnp.int32)
+        fruitless = jnp.int32(0)
+        i = 0
+        while i < max_iterations:
+            part, lock, best, best_cut, fruitless = _jet_chunk(
+                graph, part, lock, best, best_cut, fruitless,
+                jnp.int32(i), k, max_block_weights,
+                jnp.float32(gain_temp), jnp.float32(fruitless_threshold),
+                seed, jnp.int32(rnd),
+                jnp.int32(min(chunk, max_iterations - i)), max_fruitless,
                 balancer_rounds,
             )
-            cut = edge_cut(graph, part)
-            # while best_cut is still the no-feasible-partition sentinel,
-            # "improvement" means finding the first feasible partition —
-            # comparing against the sentinel would defeat the fruitless
-            # early-exit entirely
-            has_best = best_cut < jnp.iinfo(jnp.int32).max
-            improved_enough = jnp.where(
-                has_best,
-                (best_cut - cut).astype(jnp.float32)
-                > (1.0 - fruitless_threshold)
-                * jnp.abs(best_cut).astype(jnp.float32),
-                is_feasible(part),
-            )
-            fruitless = jnp.where(improved_enough, 0, fruitless + 1)
-            is_best = (cut <= best_cut) & is_feasible(part)
-            best = jnp.where(is_best, part, best)
-            best_cut = jnp.where(is_best, cut, best_cut)
-            return (i + 1, fruitless, part, lock, best, best_cut, is_best)
-
-        lock0 = jnp.zeros(graph.n_pad, dtype=jnp.int32)
-        (_, _, part, _, best, best_cut, _) = lax.while_loop(
-            iter_cond,
-            iter_body,
-            (
-                jnp.int32(0),
-                jnp.int32(0),
-                part,
-                lock0,
-                best,
-                best_cut,
-                jnp.array(True),
-            ),
-        )
+            i += chunk
+            if int(fruitless) >= max_fruitless:  # host-side early exit
+                break
         # rollback to best (jet_refiner.cc:221-227): the round continues
         # from the best partition seen
-        return (best, best, best_cut)
-
-    part, best, _ = lax.fori_loop(
-        0, num_rounds, round_body, (part0, best0, best_cut0)
-    )
+        part = best
     return best
 
 

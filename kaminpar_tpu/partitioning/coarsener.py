@@ -94,25 +94,52 @@ class Coarsener:
                     seed ^ jnp.int32(0x51A5),
                 )
         mcw = jnp.int32(min(max_cluster_weight, 2**31 - 1))
-        if c_ctx.algorithm == CoarseningAlgorithm.OVERLAY_CLUSTERING:
-            # OverlayClusterCoarsener (PASCO): intersect several
-            # independent clusterings — nodes merge only when every
-            # clustering agrees, which guards quality on hard instances
-            from ..ops.segments import combine_labels
 
-            with timer.scoped_timer("lp-clustering"):
+        def cluster_once(cap, salt_off):
+            if c_ctx.algorithm == CoarseningAlgorithm.OVERLAY_CLUSTERING:
+                # OverlayClusterCoarsener (PASCO): intersect several
+                # independent clusterings — nodes merge only when every
+                # clustering agrees, which guards quality on hard instances
+                from ..ops.segments import combine_labels
+
                 labels = None
                 for r in range(max(1, c_ctx.clustering.num_overlays)):
                     li = lp_cluster(
-                        cluster_input, mcw, seed + jnp.int32(7 * r + 1),
+                        cluster_input, cap,
+                        seed + jnp.int32(7 * r + 1 + salt_off),
                         self._lp_cfg,
                     )
-                    labels = li if labels is None else combine_labels(labels, li)
-        else:
-            with timer.scoped_timer("lp-clustering"):
-                labels = lp_cluster(cluster_input, mcw, seed, self._lp_cfg)
+                    labels = (
+                        li if labels is None else combine_labels(labels, li)
+                    )
+                return labels
+            return lp_cluster(
+                cluster_input, cap, seed + jnp.int32(salt_off), self._lp_cfg
+            )
+
+        with timer.scoped_timer("lp-clustering"):
+            labels = cluster_once(mcw, 0)
         with timer.scoped_timer("contraction"):
             coarse, c_n, c_m = contract_clustering(self.current, labels)
+
+        # forced-shrink retries (abstract_cluster_coarsener.cc:118-142
+        # shrink-factor logic): when clustering stalls but the graph is
+        # still far above the contraction limit, relax the cluster weight
+        # cap and re-cluster with the SAME configured clusterer — a
+        # stalled hierarchy otherwise leaves a huge "coarsest" graph for
+        # the sequential initial partitioner
+        retries = 0
+        while (
+            c_n >= (1.0 - c_ctx.convergence_threshold) * self.current_n
+            and self.current_n > 4 * c_ctx.contraction_limit
+            and retries < 3
+        ):
+            retries += 1
+            mcw = jnp.int32(min(int(mcw) * 2, 2**31 - 1))
+            with timer.scoped_timer("lp-clustering"):
+                labels = cluster_once(mcw, retries * 977)
+            with timer.scoped_timer("contraction"):
+                coarse, c_n, c_m = contract_clustering(self.current, labels)
 
         if c_n >= (1.0 - c_ctx.convergence_threshold) * self.current_n:
             # converged: drop this level (not enough shrinkage)

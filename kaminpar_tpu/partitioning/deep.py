@@ -188,6 +188,70 @@ class DeepMultilevelPartitioner:
             num_levels=num_levels,
         )
 
+    def _device_bipartition(
+        self, sub: HostGraph, max_block_weights: np.ndarray, rng
+    ) -> np.ndarray:
+        """Bipartition a large block subgraph through the device pipeline:
+        LP coarsening + contraction on device until ~2000 nodes, host pool
+        bipartition of the coarsest, then per-level 2-way LP refinement on
+        device (the large-block replacement for the sequential
+        InitialMultilevelBipartitioner inside extend_partition,
+        helper.cc:220 — same structure, device-speed hot loops)."""
+        from ..ops.contraction import contract_clustering
+        from ..ops.lp import lp_cluster, lp_refine
+
+        ctx = self.ctx
+        ic = ctx.initial_partitioning.coarsening
+        seed = int(rng.integers(0, 2**31 - 1))
+        max_w = np.asarray(max_block_weights, dtype=np.int64)
+        mcw = max(1, int(ic.cluster_weight_multiplier * max_w.max()))
+
+        dg = device_graph_from_host(sub)
+        levels = []
+        current, cur_n = dg, sub.n
+        # hand off to the sequential host pool at the same scale the main
+        # pipeline does (deep coarsening threshold = 2 * contraction_limit)
+        stop_n = max(2, 2 * ctx.coarsening.contraction_limit)
+        while cur_n > stop_n:
+            labels = lp_cluster(
+                current,
+                jnp.int32(min(mcw, 2**31 - 1)),
+                jnp.int32((seed + 31 * len(levels)) & 0x7FFFFFFF),
+            )
+            coarse, c_n, _ = contract_clustering(current, labels)
+            if c_n >= (1.0 - ic.convergence_threshold) * cur_n:
+                break
+            levels.append((current, coarse))
+            current, cur_n = coarse.graph, c_n
+
+        coarsest_host = (
+            sub if not levels else host_graph_from_device(current)
+        )
+        bp = InitialMultilevelBipartitioner(
+            ctx.initial_partitioning
+        ).bipartition(coarsest_host, max_w, rng)
+
+        part = np.zeros(current.n_pad, dtype=np.int32)
+        part[: coarsest_host.n] = bp
+        part = jnp.asarray(part)
+        caps = jnp.asarray(np.minimum(max_w, 2**31 - 1), dtype=jnp.int32)
+        for lvl, (fine_graph, coarse) in enumerate(reversed(levels)):
+            part = coarse.project_up(part)
+            part = lp_refine(
+                fine_graph, part, 2, caps,
+                jnp.int32((seed ^ 0x5F3759) + 101 * lvl),
+            )
+        # Jet polish of the 2-way cut at the subgraph's finest level — the
+        # device replacement for the host FM pass the sequential
+        # bipartitioner would have run per level (initial_fm_refiner.h:68)
+        from ..ops.jet import jet_refine
+
+        part = jet_refine(
+            dg, part, 2, caps, jnp.int32(seed ^ 0x2545F491),
+            ctx.refinement.jet,
+        )
+        return np.asarray(part)[: sub.n].astype(np.int8)
+
     def _current_block_weights(self, k: int):
         ctx = self.ctx
         spans = self._spans
@@ -239,7 +303,10 @@ class DeepMultilevelPartitioner:
                     max_w = bipartition_max_block_weights(
                         ctx, span.first, span.count, sub.total_node_weight
                     )
-                    bp = bipartitioner.bipartition(sub, max_w, rng)
+                    if sub.n >= ctx.partitioning.device_bipartition_threshold:
+                        bp = self._device_bipartition(sub, max_w, rng)
+                    else:
+                        bp = bipartitioner.bipartition(sub, max_w, rng)
                     k0, k1 = split_k(span.count)
                     new_ids_base.append((next_id, next_id + 1))
                     new_spans.append(_BlockSpan(span.first, k0))

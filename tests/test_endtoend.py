@@ -99,3 +99,25 @@ def test_infeasible_raises():
     p = ktp.KaMinPar(ctx).set_graph(g)
     with pytest.raises(ValueError):
         p.compute_partition(k=2, max_block_weights=np.array([4, 4]))
+
+
+def test_deep_with_device_bipartition_extension():
+    """Large-block extension through the device bipartition path
+    (helper.cc:220 analog): force the threshold low so every extension
+    uses it; results must stay feasible with a sane cut."""
+    from kaminpar_tpu.graphs.factories import make_grid_graph
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    g = make_grid_graph(40, 40)
+    p = KaMinPar("default")
+    p.ctx.partitioning.device_bipartition_threshold = 64
+    part = p.set_graph(g).compute_partition(k=8, epsilon=0.03, seed=3)
+    nw = g.node_weight_array()
+    bw = np.zeros(8, np.int64)
+    np.add.at(bw, part, nw)
+    cap = int((1 + 0.03) * np.ceil(nw.sum() / 8)) + int(nw.max())
+    assert bw.max() <= cap
+    src = g.edge_sources()
+    cut = int((part[src] != part[g.adjncy]).sum()) // 2
+    # grid 40x40 into 8 blocks: a sane cut is well under 400
+    assert cut < 400
