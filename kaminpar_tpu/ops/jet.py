@@ -256,7 +256,10 @@ def _jet_refine_impl(
                 balancer_rounds,
             )
             i += chunk
-            if int(fruitless) >= max_fruitless:  # host-side early exit
+            # the readback is a blocking device sync; skip it when the
+            # fruitless early-exit is disabled so chunks enqueue
+            # back-to-back
+            if max_fruitless < max_iterations and int(fruitless) >= max_fruitless:
                 break
         # rollback to best (jet_refiner.cc:221-227): the round continues
         # from the best partition seen

@@ -62,6 +62,7 @@ from .segments import (
     connection_to_label,
     connection_to_own_label,
     dense_block_ratings,
+    afterburner_filter,
     hash_u32,
     hashed_rating_table,
     rating_top3_by_sort,
@@ -120,7 +121,15 @@ def _select_engine(
     if num_clusters <= 256:
         return "dense"
     if m_pad >= cfg.hash_threshold:
-        return "hash" if has_communities else "sort2"
+        if has_communities:
+            return "hash"
+        # sort2 sees only the top-K clusters per node: ideal on sparse
+        # fine levels (few adjacent clusters), blind on dense coarse
+        # levels where nodes border hundreds of clusters — there the
+        # hashed slot table (num_slots candidates + exact own-connection)
+        # keeps LP converging
+        avg_degree = m_pad / max(num_clusters, 1)
+        return "sort2" if avg_degree <= 32 else "hash"
     return "sort"
 
 
@@ -254,6 +263,23 @@ def lp_round(
         (best >= 0) & (best != labels) & improves & active & (node_ids < graph.n)
     )
     target = jnp.where(wants & participate, best, -1)
+
+    if cfg.refinement:
+        # afterburner (Jet's filter step, jet_refiner.cc:133-170): in a
+        # bulk-synchronous round, simultaneous moves of ADJACENT nodes can
+        # increase the cut even though each individual gain is positive;
+        # keep only candidates whose adjusted gain stays positive.  The
+        # async reference never needs this (moves see latest labels);
+        # without it bulk-sync LP refinement can DOUBLE the cut.
+        # `wants` is deliberately NOT masked: filtered/unsampled nodes
+        # must stay in the convergence count and the active set.
+        gain_full = jnp.where(target >= 0, gain, INT32_MIN)
+        adj_gain = afterburner_filter(
+            graph.src, graph.dst, graph.edge_w,
+            labels[graph.src], labels[graph.dst],
+            gain_full, target, graph.src, n_pad,
+        )
+        target = jnp.where(adj_gain > 0, target, -1)
 
     # -- commit: never exceed the cap even under simultaneous joins ------
     headroom = jnp.maximum(cap - cluster_weights.astype(ACC_DTYPE), 0)

@@ -533,3 +533,46 @@ def rating_top3_by_sort(
         out.append(jnp.where(valid, lab2[pos], -1))
         out.append(jnp.where(valid, prio2[pos], INT32_MIN))
     return tuple(out)
+
+
+def afterburner_filter(
+    src: jax.Array,
+    dst: jax.Array,
+    edge_w: jax.Array,
+    labels_of_src: jax.Array,
+    labels_of_dst: jax.Array,
+    gain_by_node: jax.Array,
+    target_by_node: jax.Array,
+    seg: jax.Array,
+    num_segments: int,
+) -> jax.Array:
+    """Jet's afterburner (jet_refiner.cc:133-170) as a reusable filter:
+    re-evaluate each move candidate's gain assuming every neighbor that
+    orders strictly before it — by (gain, smaller id) — is already at its
+    target, and return the adjusted gain per segment (node).  Bulk-
+    synchronous LP refinement needs this because simultaneous moves of
+    adjacent nodes can jointly increase the cut even when each individual
+    gain is positive.
+
+    `gain_by_node` must be INT32_MIN for non-candidates; `labels_of_*`
+    and `target_by_node` are indexed by global node id; `seg` maps each
+    edge to its output segment (local node id on sharded layouts).
+    """
+    gain_u = gain_by_node[src]
+    gain_v = gain_by_node[dst]
+    v_before_u = (gain_v > INT32_MIN) & (
+        (gain_v > gain_u) | ((gain_v == gain_u) & (dst < src))
+    )
+    block_v = jnp.where(v_before_u, target_by_node[dst], labels_of_dst)
+    to_u = target_by_node[src]
+    from_u = labels_of_src
+    contrib = jnp.where(
+        to_u == block_v,
+        edge_w,
+        jnp.where(from_u == block_v, -edge_w, 0),
+    )
+    return jax.ops.segment_sum(
+        jnp.where(gain_u > INT32_MIN, contrib, 0),
+        jnp.clip(seg, 0, num_segments - 1),
+        num_segments=num_segments,
+    )

@@ -175,6 +175,22 @@ def _dist_lp_round(
     )
     target_l = jnp.where(wants & participate, best, -1)
 
+    if cfg.refinement:
+        # afterburner (shared with ops/lp.py lp_round): bulk-synchronous
+        # adjacent moves can jointly increase the cut; costs one extra
+        # all_gather pair per round.  `wants` stays unmasked so filtered
+        # or unsampled nodes remain in the convergence count/active set.
+        from ..ops.segments import INT32_MIN, afterburner_filter
+
+        gain_cand_l = jnp.where(target_l >= 0, gain, INT32_MIN)
+        gain_g = lax.all_gather(gain_cand_l, NODE_AXIS, tiled=True)
+        target_g = lax.all_gather(target_l, NODE_AXIS, tiled=True)
+        adj_gain = afterburner_filter(
+            src_l, dst_l, ew_l, labels[src_l], labels[dst_l],
+            gain_g, target_g, seg, n_loc,
+        )
+        target_l = jnp.where(adj_gain > 0, target_l, -1)
+
     # -- weight control: psum'd demand, throttled local capacity ---------
     local_cap = throttled_local_capacity(target_l, nw_l, weights, cap)
 

@@ -78,7 +78,7 @@ def test_heap_profiler_and_statistics(capfd):
         out = capfd.readouterr().out
         assert "partitioning: peak" in out
         assert "STATS" in out
-        assert "cut_after_lp" in out
+        assert "cut_after_jet" in out  # default refiner is Jet
     finally:
         heap_profiler.disable()
         heap_profiler.reset()
