@@ -230,3 +230,25 @@ def test_sort2_engine_rejects_communities():
             dg, jnp.int32(16), jnp.int32(0), LPConfig(rating="sort2"),
             communities=comm,
         )
+
+
+def test_lp_refine_never_increases_cut():
+    """Regression (the afterburner bug): bulk-synchronous LP refinement
+    used to DOUBLE the cut via simultaneous adjacent moves.  On dense
+    random graphs the refined cut must never exceed the input cut."""
+    from kaminpar_tpu.ops import metrics
+
+    for seed in (0, 1, 2):
+        g = factories.make_rmat(2048, 16384, seed=seed)
+        dg = device_graph_from_host(g)
+        rng = np.random.default_rng(seed)
+        k = 8
+        part = np.zeros(dg.n_pad, np.int32)
+        part[: g.n] = rng.integers(0, k, g.n)
+        part_j = jnp.asarray(part)
+        nw = g.node_weight_array()
+        caps = jnp.full((k,), int(np.ceil(nw.sum() / k * 1.1)), jnp.int32)
+        cut0 = int(metrics.edge_cut(dg, part_j))
+        out = lp_refine(dg, part_j, k, caps, jnp.int32(seed + 7))
+        cut1 = int(metrics.edge_cut(dg, out))
+        assert cut1 <= cut0, (seed, cut0, cut1)
