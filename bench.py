@@ -75,14 +75,13 @@ def main() -> None:
         k=BENCH_K, epsilon=BENCH_EPS, seed=1
     )
 
-    src = host.edge_sources()
-    ew = host.edge_weight_array()
+    from kaminpar_tpu.graphs.host import host_partition_metrics
+
+    res = host_partition_metrics(host, part, BENCH_K)
+    cut = res["cut"]
     nw = host.node_weight_array()
-    cut = int(((part[src] != part[host.adjncy]) * ew).sum()) // 2
-    bw = np.zeros(BENCH_K, dtype=np.int64)
-    np.add.at(bw, part, nw)
     cap = (1 + BENCH_EPS) * np.ceil(nw.sum() / BENCH_K)
-    feasible = bool(bw.max() <= cap)
+    feasible = bool(res["block_weights"].max() <= cap)
 
     vs = 0.0
     baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_CPU.json")

@@ -58,6 +58,7 @@ class RefinementAlgorithm(str, enum.Enum):
     UNDERLOAD_BALANCER = "underload-balancer"
     JET = "jet"
     GREEDY_FM = "fm"
+    MTKAHYPAR = "mtkahypar"
 
 
 class TwoHopStrategy(str, enum.Enum):

@@ -54,10 +54,15 @@ class KaMinPar:
         kaminpar-shm/datastructures/graph.h:24-62)."""
         from .graphs.compressed import CompressedHostGraph, compress_host_graph
 
+        from .utils.assertions import heavy_assertions_enabled
+
         if isinstance(graph, CompressedHostGraph):
             self._graph = graph
         else:
-            if validate:
+            # heavy assertion level always validates, mirroring the
+            # KASSERT(validate_graph(...), assert::heavy) call in
+            # kaminpar-shm/kaminpar.cc:176
+            if validate or heavy_assertions_enabled():
                 validate_graph(graph)
             if self.ctx.compression.enabled:
                 graph = compress_host_graph(graph)
@@ -150,6 +155,15 @@ class KaMinPar:
             else:
                 partition = self._partition_core(graph, ctx)
 
+        from .utils.assertions import AssertionLevel, kassert
+
+        kassert(
+            lambda: partition.shape == (graph.n,)
+            and (partition >= 0).all()
+            and (partition < k).all(),
+            "partition labels out of range (validate_partition analog)",
+            AssertionLevel.LIGHT,
+        )
         if self.output_level >= OutputLevel.APPLICATION:
             self._print_result(graph, partition)
         return partition

@@ -72,6 +72,13 @@ class dKaMinPar:
         self._graph = graph
         return self
 
+    def set_output_level(self, level) -> "dKaMinPar":
+        """Process-wide output level (dkaminpar.h set_output_level analog)."""
+        from ..utils.logger import set_output_level
+
+        set_output_level(level)
+        return self
+
     def copy_graph(self, vtxdist, xadj, adjncy, vwgt=None, adjwgt=None):
         """ParMETIS-style ingestion (dkaminpar.cc:400-448).  vtxdist is
         accepted for API parity; the host assembles the global graph."""
@@ -101,8 +108,13 @@ class dKaMinPar:
         with timer.scoped_timer("dist-partitioning"):
             partition = self._partition(graph, k)
 
-        cut = self._host_cut(graph, partition)
-        log(f"RESULT cut={cut} k={k} (distributed, {self.mesh.devices.size} devices)")
+        from ..graphs.host import host_partition_metrics
+
+        res = host_partition_metrics(graph, partition, k)
+        log(
+            f"RESULT cut={res['cut']} imbalance={res['imbalance']:.6f} "
+            f"k={k} devices={self.mesh.devices.size}"
+        )
         return partition
 
     # -- multilevel driver ------------------------------------------------

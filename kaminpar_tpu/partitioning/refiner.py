@@ -96,6 +96,24 @@ class RefinerPipeline:
                         level=level,
                         num_levels=num_levels,
                     )
+            elif algorithm == RefinementAlgorithm.MTKAHYPAR:
+                from ..refinement.mtkahypar import mtkahypar_refine_host
+
+                with timer.scoped_timer("mtkahypar"):
+                    host = host_graph_from_device(graph)
+                    part_h = np.asarray(partition)[: host.n]
+                    refined = mtkahypar_refine_host(
+                        host,
+                        part_h,
+                        k,
+                        max_block_weights=np.asarray(max_block_weights),
+                        epsilon=self.ctx.partition.epsilon,
+                        seed=seed + i,
+                        threads=self.ctx.parallel.num_workers,
+                    )
+                    full = np.zeros(graph.n_pad, dtype=np.int32)
+                    full[: host.n] = refined
+                    partition = jnp.asarray(full)
             elif algorithm == RefinementAlgorithm.GREEDY_FM:
                 from ..refinement.fm import fm_refine_host
 

@@ -1,3 +1,9 @@
-from . import math, rng, timer, logger  # noqa: F401
+from . import math, rng, timer, logger, assertions  # noqa: F401
 from .timer import GLOBAL_TIMER, Timer, scoped_timer  # noqa: F401
 from .logger import OutputLevel, set_output_level  # noqa: F401
+from .assertions import (  # noqa: F401
+    AssertionLevel,
+    assertion_level,
+    kassert,
+    set_assertion_level,
+)

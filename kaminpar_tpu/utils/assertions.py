@@ -1,0 +1,86 @@
+"""Leveled assertions (analog of kaminpar-common/assert.h KASSERT).
+
+The reference compiles assertions at four levels — always / light /
+normal / heavy (assert.h:39-50) — selected per build via
+KAMINPAR_ASSERTION_LEVEL; heavy-level checks include full graph and
+partition validation run inside the library (kaminpar-shm/kaminpar.cc:176,
+kaminpar-dist/dkaminpar.cc:507-509).
+
+Here the level is a process-global runtime knob (there is no compile
+step to gate on): set it with `set_assertion_level()` or the
+KAMINPAR_TPU_ASSERTION_LEVEL environment variable (name or number).
+`kassert(cond, msg, level)` raises AssertionError when the active level
+is at or above `level`.  `cond` may be a callable so heavy checks cost
+nothing when disabled.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+from typing import Callable, Union
+
+
+class AssertionLevel(enum.IntEnum):
+    """Mirrors kaminpar::assert levels (kaminpar-common/assert.h:39-50)."""
+
+    ALWAYS = 0
+    LIGHT = 1
+    NORMAL = 2
+    HEAVY = 3
+
+
+def _level_from_env() -> AssertionLevel:
+    raw = os.environ.get("KAMINPAR_TPU_ASSERTION_LEVEL", "")
+    if not raw:
+        return AssertionLevel.NORMAL
+    try:
+        return AssertionLevel(int(raw))
+    except ValueError:
+        try:
+            return AssertionLevel[raw.strip().upper()]
+        except KeyError:
+            import warnings
+
+            warnings.warn(
+                f"invalid KAMINPAR_TPU_ASSERTION_LEVEL={raw!r} "
+                f"(expected one of {[l.name for l in AssertionLevel]} or "
+                f"0-3); using NORMAL",
+                stacklevel=2,
+            )
+            return AssertionLevel.NORMAL
+
+
+_ASSERTION_LEVEL = _level_from_env()
+
+
+def assertion_level() -> AssertionLevel:
+    return _ASSERTION_LEVEL
+
+
+def set_assertion_level(level: Union[AssertionLevel, int, str]) -> None:
+    global _ASSERTION_LEVEL
+    if isinstance(level, str):
+        level = AssertionLevel[level.strip().upper()]
+    _ASSERTION_LEVEL = AssertionLevel(level)
+
+
+def kassert(
+    cond: Union[bool, Callable[[], bool]],
+    msg: str = "",
+    level: AssertionLevel = AssertionLevel.NORMAL,
+) -> None:
+    """Raise AssertionError if `cond` fails and `level` is active.
+
+    Pass a zero-arg callable for expensive conditions — it is only
+    evaluated when the level is enabled (the macro's compile-out analog).
+    """
+    if level > _ASSERTION_LEVEL:
+        return
+    ok = cond() if callable(cond) else cond
+    if not ok:
+        raise AssertionError(msg or "kassert failed")
+
+
+def heavy_assertions_enabled() -> bool:
+    return _ASSERTION_LEVEL >= AssertionLevel.HEAVY

@@ -1,0 +1,73 @@
+"""Leveled assertion tests (kaminpar-common/assert.h KASSERT analog)."""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.utils.assertions import (
+    AssertionLevel,
+    assertion_level,
+    heavy_assertions_enabled,
+    kassert,
+    set_assertion_level,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_level():
+    level = assertion_level()
+    yield
+    set_assertion_level(level)
+
+
+def test_kassert_raises_at_active_level():
+    set_assertion_level(AssertionLevel.NORMAL)
+    with pytest.raises(AssertionError, match="boom"):
+        kassert(False, "boom", AssertionLevel.NORMAL)
+    kassert(True, "fine", AssertionLevel.NORMAL)
+
+
+def test_kassert_skips_disabled_levels():
+    set_assertion_level(AssertionLevel.LIGHT)
+    # HEAVY check is compiled out: the callable must not even run
+    kassert(lambda: 1 / 0, "never evaluated", AssertionLevel.HEAVY)
+    assert not heavy_assertions_enabled()
+    set_assertion_level("heavy")
+    assert heavy_assertions_enabled()
+
+
+def test_always_level_fires_even_at_zero():
+    set_assertion_level(AssertionLevel.ALWAYS)
+    with pytest.raises(AssertionError):
+        kassert(False, "always", AssertionLevel.ALWAYS)
+
+
+def test_heavy_level_validates_graph_in_set_graph():
+    from kaminpar_tpu.graphs.host import HostGraph
+    from kaminpar_tpu.kaminpar import KaMinPar
+
+    # asymmetric adjacency: 0->1 without the reverse edge
+    bad = HostGraph(
+        xadj=np.array([0, 1, 1], dtype=np.int64),
+        adjncy=np.array([1], dtype=np.int32),
+    )
+    set_assertion_level(AssertionLevel.HEAVY)
+    with pytest.raises(ValueError):
+        KaMinPar("default").set_graph(bad)
+    # at normal level the same graph is accepted without validation
+    set_assertion_level(AssertionLevel.NORMAL)
+    KaMinPar("default").set_graph(bad)
+
+
+def test_mtkahypar_adapter_is_gated():
+    from kaminpar_tpu.refinement.mtkahypar import (
+        mtkahypar_available,
+        mtkahypar_refine_host,
+    )
+
+    if mtkahypar_available():  # pragma: no cover - not in this image
+        pytest.skip("external mtkahypar present")
+    from kaminpar_tpu.graphs.factories import make_grid_graph
+
+    g = make_grid_graph(4, 4)
+    with pytest.raises(RuntimeError, match="mtkahypar"):
+        mtkahypar_refine_host(g, np.zeros(16, dtype=np.int32), 2, epsilon=0.03)

@@ -1,0 +1,33 @@
+"""Distributed CLI tests (apps/dKaMinPar.cc surface)."""
+
+import numpy as np
+
+from kaminpar_tpu.dcli import main
+
+RGG = "/root/reference/misc/rgg2d.metis"
+
+
+def test_dcli_partitions_file_graph(tmp_path, capfd):
+    out = tmp_path / "part.txt"
+    rc = main(
+        [RGG, "-k", "4", "-n", "2", "-o", str(out), "-T", "--validate"]
+    )
+    assert rc == 0
+    captured = capfd.readouterr()
+    # the facade logs the single RESULT line (stderr); the CLI prints TIME
+    assert "RESULT cut=" in captured.err
+    assert "devices=2" in captured.err
+    assert "TIME io=" in captured.out
+    part = np.loadtxt(out, dtype=np.int64)
+    assert part.shape == (1024,)
+    assert set(np.unique(part)) <= set(range(4))
+
+
+def test_dcli_generator_input(capfd):
+    rc = main(["gen:rmat;n=256;m=1024;seed=1", "-k", "2", "-n", "2", "-q"])
+    assert rc == 0
+
+
+def test_dcli_errors_without_k(capfd):
+    assert main([RGG]) == 1
+    assert "need -k" in capfd.readouterr().err
