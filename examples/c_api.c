@@ -1,0 +1,42 @@
+/* C-ABI example (analog of examples/bindings-c).
+ *
+ * Build the shared library first:
+ *   python -m kaminpar_tpu.native.build_capi
+ * then:
+ *   gcc examples/c_api.c -I include -L kaminpar_tpu/native \
+ *       -lckaminpar_tpu -o /tmp/c_api_example
+ *   LD_LIBRARY_PATH=kaminpar_tpu/native PYTHONPATH=$PWD /tmp/c_api_example
+ *
+ * (PYTHONPATH is only needed when the package is not installed; the
+ * shared library embeds a Python interpreter that imports kaminpar_tpu.)
+ */
+#include <stdint.h>
+#include <stdio.h>
+
+#include "ckaminpar_tpu.h"
+
+int main(void) {
+  /* triangle plus pendant node (METIS convention: both edge directions) */
+  int64_t xadj[] = {0, 2, 4, 7, 8};
+  int32_t adjncy[] = {1, 2, 0, 2, 0, 1, 3, 2};
+  int32_t out[4];
+
+  kmp_partitioner *p = kmp_create("fast", /*seed=*/1);
+  if (!p) {
+    fprintf(stderr, "failed to create partitioner\n");
+    return 1;
+  }
+
+  int64_t cut = kmp_compute_partition(p, 4, xadj, adjncy, NULL, NULL,
+                                      /*k=*/2, /*epsilon=*/0.1, out);
+  if (cut < 0) {
+    fprintf(stderr, "error: %s\n", kmp_last_error(p));
+    kmp_free(p);
+    return 1;
+  }
+
+  printf("cut=%lld partition=[%d %d %d %d]\n", (long long)cut, out[0], out[1],
+         out[2], out[3]);
+  kmp_free(p);
+  return 0;
+}

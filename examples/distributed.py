@@ -1,0 +1,29 @@
+"""Multi-chip example (analog of the dKaMinPar usage in examples/).
+
+Partitions a generated RMAT graph over a device mesh.  On a CPU host,
+expose virtual devices first:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/distributed.py
+"""
+
+from kaminpar_tpu.graphs.factories import make_rmat
+from kaminpar_tpu.graphs.host import host_partition_metrics
+from kaminpar_tpu.parallel import dKaMinPar
+
+
+def main() -> None:
+    graph = make_rmat(1 << 12, 1 << 15, seed=7)
+
+    solver = dKaMinPar("default")  # mesh over all visible devices
+    part = solver.set_graph(graph).compute_partition(
+        k=8, epsilon=0.03, seed=1
+    )
+
+    res = host_partition_metrics(graph, part, 8)
+    print("edge cut:", res["cut"])
+    print("imbalance:", round(res["imbalance"], 4))
+
+
+if __name__ == "__main__":
+    main()

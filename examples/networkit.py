@@ -1,0 +1,26 @@
+"""NetworKit binding example (analog of examples/bindings-networkit).
+
+Requires the external `networkit` package; the adapter mirrors the
+reference binding surface
+(bindings/networkit: kaminpar.KaMinPar(G).computePartitionWithEpsilon).
+"""
+
+
+def main() -> None:
+    try:
+        import networkit as nk
+    except ImportError:
+        print("networkit not installed; skipping (the adapter is gated)")
+        return
+
+    from kaminpar_tpu.bindings.networkit import NetworKitKaMinPar
+
+    import numpy as np
+
+    G = nk.generators.HyperbolicGenerator(1000, k=8).generate()
+    partition = NetworKitKaMinPar(G).computePartitionWithEpsilon(4, 0.03)
+    print("block sizes:", np.bincount(partition, minlength=4).tolist())
+
+
+if __name__ == "__main__":
+    main()

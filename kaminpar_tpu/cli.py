@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import enum
+import os
 import sys
 import time
 from typing import Any, Dict, List, Optional
@@ -180,6 +181,18 @@ def build_parser() -> argparse.ArgumentParser:
         "--vcycles", type=int, nargs="+", default=None,
         help="block counts per v-cycle (vcycle mode)",
     )
+    # debug dumps (kaminpar_arguments.cc debug group / DebugContext flags)
+    p.add_argument(
+        "--debug-dump", nargs="+", default=None, metavar="WHAT",
+        choices=[
+            "toplevel-graph", "toplevel-partition", "coarsest-graph",
+            "coarsest-partition", "graph-hierarchy", "partition-hierarchy",
+        ],
+        help="write hierarchy dumps (debug.cc analog)",
+    )
+    p.add_argument(
+        "--debug-dump-dir", default=None, help="directory for debug dumps"
+    )
     return p
 
 
@@ -202,6 +215,11 @@ def make_context(args: argparse.Namespace) -> Context:
         ]
     if args.vcycles is not None:
         ctx.partitioning.vcycles = list(args.vcycles)
+    if args.debug_dump:
+        for what in args.debug_dump:
+            setattr(ctx.debug, "dump_" + what.replace("-", "_"), True)
+    if args.debug_dump_dir:
+        ctx.debug.dump_dir = args.debug_dump_dir
     if args.seed is not None:  # -C config may set the seed; flag wins
         ctx.seed = args.seed
     return ctx
@@ -240,31 +258,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         graph = io_mod.load_graph(args.graph, fmt=args.format)
     io_s = time.perf_counter() - t_io
+    if not ctx.debug.graph_name:
+        base = os.path.basename(args.graph)
+        ctx.debug.graph_name = os.path.splitext(base)[0] or "graph"
+
+    from .utils.logger import output_level as get_output_level
+    from .utils.logger import set_output_level as set_global_output_level
 
     partitioner = KaMinPar(ctx)
-    if args.quiet:
-        partitioner.set_output_level(OutputLevel.QUIET)
-    partitioner.set_graph(graph, validate=args.validate)
+    prior_level = get_output_level()
+    try:
+        if args.quiet:
+            partitioner.set_output_level(OutputLevel.QUIET)
+        partitioner.set_graph(graph, validate=args.validate)
 
-    if args.min_epsilon is not None:
-        # needs k/weights set up first; compute_partition redoes setup, so
-        # pre-setup here only to derive min weights
-        ctx.partition.setup(graph, k=args.k, epsilon=args.epsilon,
-                            max_block_weights=args.max_block_weights)
-        ctx.partition.setup_min_block_weights(args.min_epsilon)
+        if args.min_epsilon is not None:
+            # needs k/weights set up first; compute_partition redoes setup,
+            # so pre-setup here only to derive min weights
+            ctx.partition.setup(graph, k=args.k, epsilon=args.epsilon,
+                                max_block_weights=args.max_block_weights)
+            ctx.partition.setup_min_block_weights(args.min_epsilon)
 
-    t0 = time.perf_counter()
-    partition = partitioner.compute_partition(
-        k=args.k,
-        epsilon=args.epsilon,
-        max_block_weights=(
-            np.asarray(args.max_block_weights, dtype=np.int64)
-            if args.max_block_weights
-            else None
-        ),
-        seed=args.seed,
-    )
-    wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        partition = partitioner.compute_partition(
+            k=args.k,
+            epsilon=args.epsilon,
+            max_block_weights=(
+                np.asarray(args.max_block_weights, dtype=np.int64)
+                if args.max_block_weights
+                else None
+            ),
+            seed=args.seed,
+        )
+        wall = time.perf_counter() - t0
+    finally:
+        # the logger level is process-global; a -q run must not leave the
+        # embedding process muted
+        set_global_output_level(prior_level)
 
     if not args.quiet:
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")

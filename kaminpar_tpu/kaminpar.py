@@ -138,6 +138,9 @@ class KaMinPar:
         timer.GLOBAL_TIMER.reset()
         heap_profiler.reset()
         statistics.reset()
+        from .partitioning import debug
+
+        debug.dump_toplevel_graph(ctx, graph)
         with timer.scoped_timer("partitioning"), scoped_heap_profiler(
             "partitioning"
         ):
@@ -155,6 +158,7 @@ class KaMinPar:
             else:
                 partition = self._partition_core(graph, ctx)
 
+        debug.dump_toplevel_partition(ctx, partition)
         from .utils.assertions import AssertionLevel, kassert
 
         kassert(

@@ -68,7 +68,13 @@ class dKaMinPar:
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self._graph: Optional[HostGraph] = None
 
-    def set_graph(self, graph: HostGraph) -> "dKaMinPar":
+    def set_graph(self, graph) -> "dKaMinPar":
+        """Accepts a HostGraph or a CompressedHostGraph (decoded eagerly:
+        the distributed pipeline shards the plain CSR arrays)."""
+        from ..graphs.compressed import CompressedHostGraph
+
+        if isinstance(graph, CompressedHostGraph):
+            graph = graph.decode()
         self._graph = graph
         return self
 

@@ -65,6 +65,8 @@ class DeepMultilevelPartitioner:
         input_k = ctx.partition.k
         rng = rng_mod.host_rng(ctx.seed ^ 0xDEE9)
 
+        from . import debug
+
         with timer.scoped_timer("device-upload"):
             dgraph = device_graph_from_host(graph)
 
@@ -79,10 +81,17 @@ class DeepMultilevelPartitioner:
                     f"deep coarsening level {coarsener.level}: "
                     f"n={coarsener.current_n}"
                 )
+                if ctx.debug.dump_graph_hierarchy:
+                    debug.dump_graph_hierarchy(
+                        ctx,
+                        host_graph_from_device(coarsener.current),
+                        coarsener.level,
+                    )
 
         # --- initial bipartition of the coarsest graph (:185) ---
         with timer.scoped_timer("initial-partitioning"):
             coarsest_host = host_graph_from_device(coarsener.current)
+            debug.dump_coarsest_graph(ctx, coarsest_host)
             k0, k1 = split_k(input_k)
             spans = [_BlockSpan(0, k0), _BlockSpan(k0, k1)] if input_k > 1 else [
                 _BlockSpan(0, 1)
@@ -100,6 +109,7 @@ class DeepMultilevelPartitioner:
                 )
             current_k = len(spans)
             self._spans = spans
+            debug.dump_coarsest_partition(ctx, part_host)
             padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
             padded[: coarsest_host.n] = part_host
             partition = jnp.asarray(padded)
@@ -131,6 +141,12 @@ class DeepMultilevelPartitioner:
                     level,
                     num_levels,
                 )
+                if ctx.debug.dump_partition_hierarchy:
+                    debug.dump_partition_hierarchy(
+                        ctx,
+                        np.asarray(partition)[: coarsener.current_n],
+                        level,
+                    )
 
         # final extensions to input_k if not there yet
         while current_k < input_k:

@@ -51,6 +51,8 @@ class KWayMultilevelPartitioner:
         )
 
         # --- coarsening (kway_multilevel.cc:91-142) ---
+        from . import debug
+
         coarsener = Coarsener(ctx, dgraph, graph.n)
         threshold = max(k * ctx.coarsening.contraction_limit, 1)
         with timer.scoped_timer("coarsening"):
@@ -61,11 +63,19 @@ class KWayMultilevelPartitioner:
                     f"coarsening level {coarsener.level}: "
                     f"n={coarsener.current_n}"
                 )
+                if ctx.debug.dump_graph_hierarchy:
+                    debug.dump_graph_hierarchy(
+                        ctx,
+                        host_graph_from_device(coarsener.current),
+                        coarsener.level,
+                    )
 
         # --- initial partitioning on host (rb to k) ---
         with timer.scoped_timer("initial-partitioning"):
             coarsest_host = host_graph_from_device(coarsener.current)
+            debug.dump_coarsest_graph(ctx, coarsest_host)
             init_part = recursive_bipartition(coarsest_host, k, ctx, rng)
+            debug.dump_coarsest_partition(ctx, init_part)
             part_padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
             part_padded[: coarsest_host.n] = init_part
             partition = jnp.asarray(part_padded)
@@ -96,6 +106,12 @@ class KWayMultilevelPartitioner:
                     level=level,
                     num_levels=num_levels,
                 )
+                if ctx.debug.dump_partition_hierarchy:
+                    debug.dump_partition_hierarchy(
+                        ctx,
+                        np.asarray(partition)[: coarsener.current_n],
+                        level,
+                    )
 
         # strict balance backstop on the finest level
         partition = refiner.enforce_balance_host(

@@ -31,3 +31,14 @@ def test_dcli_generator_input(capfd):
 def test_dcli_errors_without_k(capfd):
     assert main([RGG]) == 1
     assert "need -k" in capfd.readouterr().err
+
+
+def test_dcli_compressed_input(tmp_path, capfd):
+    """dKaMinPar decodes compressed graphs eagerly (terapart input)."""
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.io import load_graph, write_compressed
+
+    path = str(tmp_path / "rgg2d.npz")
+    write_compressed(path, compress_host_graph(load_graph(RGG)))
+    rc = main([path, "-k", "2", "-n", "2", "-f", "compressed", "-q"])
+    assert rc == 0
