@@ -157,6 +157,10 @@ def build_parser() -> argparse.ArgumentParser:
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
+        "--machine-timers", action="store_true",
+        help="print the timer tree as one machine-readable line",
+    )
+    p.add_argument(
         "-H", "--heap-profile", action="store_true",
         help="profile host/device memory per phase (heap_profiler analog)",
     )
@@ -262,44 +266,38 @@ def main(argv: Optional[List[str]] = None) -> int:
         base = os.path.basename(args.graph)
         ctx.debug.graph_name = os.path.splitext(base)[0] or "graph"
 
-    from .utils.logger import output_level as get_output_level
-    from .utils.logger import set_output_level as set_global_output_level
-
     partitioner = KaMinPar(ctx)
-    prior_level = get_output_level()
-    try:
-        if args.quiet:
-            partitioner.set_output_level(OutputLevel.QUIET)
-        partitioner.set_graph(graph, validate=args.validate)
+    if args.quiet:
+        # instance-scoped: compute_partition applies and restores it
+        partitioner.set_output_level(OutputLevel.QUIET)
+    partitioner.set_graph(graph, validate=args.validate)
 
-        if args.min_epsilon is not None:
-            # needs k/weights set up first; compute_partition redoes setup,
-            # so pre-setup here only to derive min weights
-            ctx.partition.setup(graph, k=args.k, epsilon=args.epsilon,
-                                max_block_weights=args.max_block_weights)
-            ctx.partition.setup_min_block_weights(args.min_epsilon)
+    if args.min_epsilon is not None:
+        # needs k/weights set up first; compute_partition redoes setup,
+        # so pre-setup here only to derive min weights
+        ctx.partition.setup(graph, k=args.k, epsilon=args.epsilon,
+                            max_block_weights=args.max_block_weights)
+        ctx.partition.setup_min_block_weights(args.min_epsilon)
 
-        t0 = time.perf_counter()
-        partition = partitioner.compute_partition(
-            k=args.k,
-            epsilon=args.epsilon,
-            max_block_weights=(
-                np.asarray(args.max_block_weights, dtype=np.int64)
-                if args.max_block_weights
-                else None
-            ),
-            seed=args.seed,
-        )
-        wall = time.perf_counter() - t0
-    finally:
-        # the logger level is process-global; a -q run must not leave the
-        # embedding process muted
-        set_global_output_level(prior_level)
+    t0 = time.perf_counter()
+    partition = partitioner.compute_partition(
+        k=args.k,
+        epsilon=args.epsilon,
+        max_block_weights=(
+            np.asarray(args.max_block_weights, dtype=np.int64)
+            if args.max_block_weights
+            else None
+        ),
+        seed=args.seed,
+    )
+    wall = time.perf_counter() - t0
 
     if not args.quiet:
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
     if args.timers and not args.quiet:
         print(timer.GLOBAL_TIMER.render())
+    if args.machine_timers and not args.quiet:
+        print("TIMERS " + timer.GLOBAL_TIMER.render_machine())
     if args.heap_profile and not args.quiet:
         print(heap_profiler.render())
     if args.statistics and not args.quiet:

@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
+    p.add_argument(
+        "--machine-timers", action="store_true",
+        help="print the timer tree as one machine-readable line",
+    )
     return p
 
 
@@ -92,23 +96,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         validate(graph)
 
     from .parallel import dKaMinPar, make_mesh
-    from .utils.logger import output_level, set_output_level
 
     mesh = make_mesh(args.num_devices)
     solver = dKaMinPar(args.preset, mesh=mesh)
     solver.set_graph(graph)
-
-    prior_level = output_level()
     if args.quiet:
+        # instance-scoped: compute_partition applies and restores it
         solver.set_output_level(OutputLevel.QUIET)
-    try:
-        t0 = time.perf_counter()
-        partition = solver.compute_partition(
-            k=args.k, epsilon=args.epsilon, seed=args.seed
-        )
-        wall = time.perf_counter() - t0
-    finally:
-        set_output_level(prior_level)
+
+    t0 = time.perf_counter()
+    partition = solver.compute_partition(
+        k=args.k, epsilon=args.epsilon, seed=args.seed
+    )
+    wall = time.perf_counter() - t0
 
     if not args.quiet:
         # the facade logs the single RESULT line (cli.py pattern: the
@@ -116,6 +116,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
         if args.timers:
             print(timer.GLOBAL_TIMER.render())
+        if args.machine_timers:
+            print("TIMERS " + timer.GLOBAL_TIMER.render_machine())
 
     if args.output:
         io_mod.write_partition(args.output, partition)

@@ -29,43 +29,113 @@ def host_lp_cluster(
     rng: np.random.Generator,
     num_iterations: int = 3,
 ) -> np.ndarray:
-    """Sequential LP clustering (initial_coarsener's ClusteringAlgorithm):
-    visit nodes in random order, join the adjacent cluster with max
-    connection weight subject to the weight cap."""
+    """LP clustering for initial coarsening (initial_coarsener's
+    ClusteringAlgorithm analog), numpy-vectorized.
+
+    The reference visits nodes asynchronously in random order; a python
+    per-node loop is the wall-clock whale of the whole pipeline (the
+    coarsest graphs are a few thousand nodes but this runs hundreds of
+    times across extend-partition).  Vectorized scheme per sub-round:
+    rate all (node, adjacent-cluster) pairs with one groupby, pick each
+    node's best admissible cluster, filter movers by a coin flip (breaks
+    A<->B swap oscillation the async order avoided naturally), and admit
+    movers per target cluster in priority order up to the weight cap —
+    so the cap is never exceeded, exactly like the async version.
+    """
     n = graph.n
     labels = np.arange(n, dtype=np.int64)
-    cw = graph.node_weight_array().copy()
+    if n == 0 or graph.m == 0:
+        return labels
     node_w = graph.node_weight_array()
+    cw = node_w.astype(np.int64).copy()
     edge_w = graph.edge_weight_array()
+    src = graph.edge_sources()
+    dst = graph.adjncy
 
-    for _ in range(num_iterations):
-        moved = False
-        for u in rng.permutation(n):
-            lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
-            if lo == hi:
-                continue
-            neigh = graph.adjncy[lo:hi]
-            w = edge_w[lo:hi]
-            cl = labels[neigh]
-            # rating map: sum weights per adjacent cluster
-            uniq, inv = np.unique(cl, return_inverse=True)
-            ratings = np.bincount(inv, weights=w)
-            cur = labels[u]
-            ok = (uniq == cur) | (cw[uniq] + node_w[u] <= max_cluster_weight)
-            if not ok.any():
-                continue
-            ratings = np.where(ok, ratings, -1)
-            best_rating = ratings.max()
-            ties = np.flatnonzero(ratings == best_rating)
-            best = int(uniq[ties[rng.integers(0, len(ties))]])
-            cur_rating = ratings[uniq == cur][0] if (uniq == cur).any() else 0
-            if best != cur and best_rating >= max(cur_rating, 1):
-                cw[cur] -= node_w[u]
-                cw[best] += node_w[u]
-                labels[u] = best
-                moved = True
-        if not moved:
-            break
+    dry_subrounds = 0
+    for it in range(2 * num_iterations):
+        cl = labels[dst]
+        # rate: groupby (src, cluster) -> summed edge weight
+        key = src.astype(np.int64) * n + cl
+        order = np.argsort(key, kind="stable")
+        k_s, u_s, cl_s = key[order], src[order], cl[order]
+        w_s = edge_w[order].astype(np.int64)
+        new_group = np.empty(len(k_s), dtype=bool)
+        new_group[0] = True
+        new_group[1:] = k_s[1:] != k_s[:-1]
+        gid = np.cumsum(new_group) - 1
+        g_rating = np.bincount(gid, weights=w_s).astype(np.int64)
+        g_u = u_s[new_group]
+        g_cl = cl_s[new_group]
+
+        # admissible: own cluster, or target under the weight cap
+        own = g_cl == labels[g_u]
+        ok = own | (cw[g_cl] + node_w[g_u] <= max_cluster_weight)
+
+        # current-cluster rating per node (0 if no internal edge)
+        cur_rating = np.zeros(n, dtype=np.int64)
+        cur_rating[g_u[own]] = g_rating[own]
+
+        # best admissible cluster per node: sort groups by (u, rating,
+        # tie hash) and take the last group of each node's run
+        tie = (g_cl * 1000003 + it * 7919) % 1013904223
+        sort2 = np.lexsort((tie, np.where(ok, g_rating, -1), g_u))
+        gu2 = g_u[sort2]
+        last = np.empty(len(gu2), dtype=bool)
+        last[:-1] = gu2[:-1] != gu2[1:]
+        last[-1] = True
+        top = sort2[last]
+        best_u = g_u[top]
+        best_cl = np.where(ok[top], g_cl[top], labels[best_u])
+        best_rating = np.where(ok[top], g_rating[top], 0)
+
+        target = labels.copy()
+        target[best_u] = best_cl
+        rating_of_target = np.zeros(n, dtype=np.int64)
+        rating_of_target[best_u] = best_rating
+
+        move = (target != labels) & (
+            rating_of_target >= np.maximum(cur_rating, 1)
+        )
+        # coin filter: half the nodes per sub-round (swap-oscillation guard)
+        coin = ((np.arange(n) * 2654435761 + it * 40503) >> 7) & 1
+        move &= coin == (it & 1)
+        movers = np.flatnonzero(move)
+        if len(movers) == 0:
+            # converged only when BOTH coin halves of a pair are dry — a
+            # single empty half says nothing about the other half's nodes
+            if dry_subrounds >= 1:
+                break
+            dry_subrounds += 1
+            continue
+
+        # capacity commit: per target cluster, admit movers in hashed
+        # priority order while the cluster stays under the cap
+        t = target[movers]
+        prio = (movers * 1566083941 + it * 12345) % 2147483647
+        corder = np.lexsort((prio, t))
+        t_s = t[corder]
+        m_s = movers[corder]
+        w_m = node_w[m_s].astype(np.int64)
+        csum = np.cumsum(w_m)
+        first = np.empty(len(t_s), dtype=bool)
+        first[0] = True
+        first[1:] = t_s[1:] != t_s[:-1]
+        base = np.where(first, csum - w_m, 0)
+        np.maximum.accumulate(base, out=base)
+        within = csum - base  # cumulative weight within the target group
+        admit = cw[t_s] + within <= max_cluster_weight
+        adm = m_s[admit]
+        if len(adm) == 0:
+            if dry_subrounds >= 1:
+                break
+            dry_subrounds += 1
+            continue
+        dry_subrounds = 0
+        old = labels[adm]
+        labels[adm] = target[adm]
+        np.subtract.at(cw, old, node_w[adm])
+        np.add.at(cw, target[adm], node_w[adm])
     return labels
 
 

@@ -86,8 +86,12 @@ class KaMinPar:
         return self
 
     def set_output_level(self, level: OutputLevel) -> "KaMinPar":
-        self.output_level = level
-        set_output_level(level)
+        """Instance-scoped (kaminpar.h set_output_level): applied to the
+        process-global logger only for the duration of compute_partition,
+        so a QUIET instance does not mute the embedding process.  When
+        never called, the global level is left untouched."""
+        self.output_level = OutputLevel(level)
+        self._explicit_level = self.output_level
         return self
 
     def graph(self) -> Optional[HostGraph]:
@@ -139,24 +143,32 @@ class KaMinPar:
         heap_profiler.reset()
         statistics.reset()
         from .partitioning import debug
+        from .utils.logger import output_level as global_output_level
 
         debug.dump_toplevel_graph(ctx, graph)
-        with timer.scoped_timer("partitioning"), scoped_heap_profiler(
-            "partitioning"
-        ):
-            # isolated-node preprocessing (kaminpar.cc:392-404)
-            num_isolated = count_isolated_nodes(graph)
-            if num_isolated and graph.n > num_isolated:
-                core, perm, _ = remove_isolated_nodes(graph)
-                core_ctx = ctx  # weights already set up from the full graph
-                part_core = self._partition_core(core, core_ctx)
-                partition = self._reintegrate_isolated(
-                    graph, core, perm, num_isolated, part_core
-                )
-            elif num_isolated == graph.n and graph.n > 0:
-                partition = self._partition_only_isolated(graph)
-            else:
-                partition = self._partition_core(graph, ctx)
+        # the logger is process-global; apply this instance's level only
+        # for the duration of the computation
+        prior_level = global_output_level()
+        try:
+            set_output_level(getattr(self, "_explicit_level", prior_level))
+            with timer.scoped_timer("partitioning"), scoped_heap_profiler(
+                "partitioning"
+            ):
+                # isolated-node preprocessing (kaminpar.cc:392-404)
+                num_isolated = count_isolated_nodes(graph)
+                if num_isolated and graph.n > num_isolated:
+                    core, perm, _ = remove_isolated_nodes(graph)
+                    core_ctx = ctx  # weights already set up from the full graph
+                    part_core = self._partition_core(core, core_ctx)
+                    partition = self._reintegrate_isolated(
+                        graph, core, perm, num_isolated, part_core
+                    )
+                elif num_isolated == graph.n and graph.n > 0:
+                    partition = self._partition_only_isolated(graph)
+                else:
+                    partition = self._partition_core(graph, ctx)
+        finally:
+            set_output_level(prior_level)
 
         debug.dump_toplevel_partition(ctx, partition)
         from .utils.assertions import AssertionLevel, kassert

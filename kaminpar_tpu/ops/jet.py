@@ -109,12 +109,34 @@ def _jet_iteration(
     new_lock = accept.astype(jnp.int32)  # moved nodes rest next iteration
 
     # ---- rebalance (jet_refiner.cc:185-187) ----
-    def bal_body(i, p):
-        s = (salt + i * 7919) & 0x7FFFFFFF
-        p2, _ = overload_balance_round(graph, p, k, max_block_weights, s)
-        return p2
+    # while_loop, not fori: Jet iterations usually keep the partition
+    # feasible, and a false condition skips the edge-wide balancer body
+    # entirely — the dominant per-iteration cost otherwise.  The overload
+    # total rides in the loop state so the condition is a scalar check,
+    # not a second block-weight reduction per round.
+    def _overload(p):
+        bw = jax.ops.segment_sum(
+            graph.node_w.astype(ACC_DTYPE), p, num_segments=k
+        )
+        return jnp.sum(
+            jnp.maximum(bw - max_block_weights.astype(ACC_DTYPE), 0)
+        )
 
-    new_part = lax.fori_loop(0, balancer_rounds, bal_body, new_part)
+    def bal_cond(state):
+        i, p, moved, over = state
+        return (i < balancer_rounds) & (over > 0) & (moved != 0)
+
+    def bal_body(state):
+        i, p, _, _ = state
+        s = (salt + i * 7919) & 0x7FFFFFFF
+        p2, moved = overload_balance_round(graph, p, k, max_block_weights, s)
+        return (i + 1, p2, moved, _overload(p2))
+
+    _, new_part, _, _ = lax.while_loop(
+        bal_cond,
+        bal_body,
+        (jnp.int32(0), new_part, jnp.int32(1), _overload(new_part)),
+    )
     return new_part, new_lock
 
 

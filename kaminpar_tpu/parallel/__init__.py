@@ -8,7 +8,7 @@ an XLA collective inside `shard_map` — `psum` for cluster/block weight
 control and cut reduction, `all_gather` for label/ghost synchronization.
 """
 
-from .mesh import make_mesh, NODE_AXIS
+from .mesh import make_mesh, make_torus_mesh, NODE_AXIS
 from .dist_graph import DistGraph, dist_graph_from_host
 from .dist_lp import dist_lp_cluster, dist_lp_cluster_from, dist_lp_refine
 from .dist_metrics import dist_edge_cut
@@ -31,6 +31,7 @@ from .dist_partitioner import dKaMinPar
 
 __all__ = [
     "make_mesh",
+    "make_torus_mesh",
     "NODE_AXIS",
     "DistGraph",
     "dist_graph_from_host",

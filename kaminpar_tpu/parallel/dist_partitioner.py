@@ -79,10 +79,12 @@ class dKaMinPar:
         return self
 
     def set_output_level(self, level) -> "dKaMinPar":
-        """Process-wide output level (dkaminpar.h set_output_level analog)."""
-        from ..utils.logger import set_output_level
+        """Instance-scoped output level (dkaminpar.h set_output_level
+        analog): applied to the process-global logger only while
+        compute_partition runs."""
+        from ..utils.logger import OutputLevel
 
-        set_output_level(level)
+        self._output_level = OutputLevel(level)
         return self
 
     def copy_graph(self, vtxdist, xadj, adjncy, vwgt=None, adjwgt=None):
@@ -111,16 +113,25 @@ class dKaMinPar:
         ctx.partition.setup(graph, k=k, epsilon=epsilon)
         k = ctx.partition.k
 
-        with timer.scoped_timer("dist-partitioning"):
-            partition = self._partition(graph, k)
+        from ..utils.logger import output_level, set_output_level
 
-        from ..graphs.host import host_partition_metrics
+        prior_level = output_level()
+        try:
+            set_output_level(
+                getattr(self, "_output_level", prior_level)
+            )
+            with timer.scoped_timer("dist-partitioning"):
+                partition = self._partition(graph, k)
 
-        res = host_partition_metrics(graph, partition, k)
-        log(
-            f"RESULT cut={res['cut']} imbalance={res['imbalance']:.6f} "
-            f"k={k} devices={self.mesh.devices.size}"
-        )
+            from ..graphs.host import host_partition_metrics
+
+            res = host_partition_metrics(graph, partition, k)
+            log(
+                f"RESULT cut={res['cut']} imbalance={res['imbalance']:.6f} "
+                f"k={k} devices={self.mesh.devices.size}"
+            )
+        finally:
+            set_output_level(prior_level)
         return partition
 
     # -- multilevel driver ------------------------------------------------

@@ -99,6 +99,21 @@ class Timer:
         rec(self.root, 0)
         return "\n".join(lines)
 
+    def render_machine(self) -> str:
+        """One-line machine-readable dump: dotted-path=seconds pairs
+        (the analog of the reference's machine-readable timer tree that
+        backs its parseable TIME output, kaminpar-common/timer.h:135)."""
+        parts = []
+
+        def rec(node: TimerNode, path: str) -> None:
+            for child in node.children.values():
+                child_path = f"{path}.{child.name}" if path else child.name
+                parts.append(f"{child_path}={child.elapsed:.6f}")
+                rec(child, child_path)
+
+        rec(self.root, "")
+        return " ".join(parts)
+
 
 GLOBAL_TIMER = Timer()
 

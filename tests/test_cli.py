@@ -87,3 +87,15 @@ def test_cli_refinement_override():
 def test_cli_errors_without_k(capfd):
     assert main([RGG]) == 1
     assert main([]) == 1
+
+
+def test_cli_machine_timers(capfd):
+    rc = main([RGG, "-k", "2", "--machine-timers"])
+    assert rc == 0
+    out = capfd.readouterr().out
+    line = [l for l in out.splitlines() if l.startswith("TIMERS ")]
+    assert line, out
+    pairs = dict(p.split("=") for p in line[0][len("TIMERS "):].split())
+    assert "partitioning" in pairs
+    assert float(pairs["partitioning"]) > 0
+    assert any(key.startswith("partitioning.") for key in pairs)

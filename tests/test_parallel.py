@@ -384,3 +384,48 @@ def test_dist_cluster_balancer_moves_whole_clusters_when_needed():
     bal = np.asarray(dist_cluster_balance(dg, jnp.asarray(part), k, caps, 3))
     bw = np.bincount(bal[: graph.n], weights=nw, minlength=k)
     assert bw.max() <= cap
+
+
+def test_snake_flatten_is_hamiltonian_path():
+    """Consecutive entries of the snake order are always grid neighbors
+    (the placement property that lets ring collectives ride ICI links,
+    grid_alltoall.h analog)."""
+    import numpy as np
+
+    from kaminpar_tpu.parallel.mesh import snake_flatten
+
+    for rows, cols in [(2, 4), (3, 3), (4, 2), (1, 5)]:
+        grid = np.arange(rows * cols).reshape(rows, cols)
+        pos = {int(v): (r, c) for r in range(rows) for c in range(cols)
+               for v in [grid[r, c]]}
+        flat = snake_flatten(grid)
+        assert sorted(flat.tolist()) == list(range(rows * cols))
+        for a, b in zip(flat[:-1], flat[1:]):
+            (r1, c1), (r2, c2) = pos[int(a)], pos[int(b)]
+            assert abs(r1 - r2) + abs(c1 - c2) == 1, (rows, cols, a, b)
+
+
+def test_torus_mesh_runs_dist_pipeline():
+    """make_torus_mesh is a drop-in 1D node axis for every dist kernel."""
+    import numpy as np
+
+    from kaminpar_tpu.graphs.factories import make_grid_graph
+    from kaminpar_tpu.parallel import (
+        dist_edge_cut,
+        dist_graph_from_host,
+        dist_lp_cluster,
+        make_torus_mesh,
+    )
+
+    mesh = make_torus_mesh(2, 4)
+    assert mesh.devices.shape == (8,)
+    assert len({d.id for d in mesh.devices.flat}) == 8
+    host = make_grid_graph(8, 8)
+    graph = dist_graph_from_host(host, mesh)
+    labels = dist_lp_cluster(graph, 8, seed=0)
+    part = np.asarray(labels)[: host.n] % 2
+    import jax.numpy as jnp
+
+    cut = dist_edge_cut(graph, jnp.asarray(
+        np.pad(part, (0, graph.n_pad - host.n)).astype(np.int32)))
+    assert 0 < int(cut) <= host.m
