@@ -20,6 +20,7 @@ import numpy as np
 
 from ..context import InitialPartitioningContext, InitialPoolContext
 from ..graphs.host import HostGraph
+from ..utils import timer
 from .coarsening import coarsen_for_bipartition
 from .flat import bfs_bipartition, ggg_bipartition, random_bipartition
 from .fm import fm_bipartition_refine
@@ -98,11 +99,13 @@ class PoolBipartitioner:
                 ranked = sorted(self.entries, key=lambda e: e.score())
                 active = ranked[:-1]
             for entry in active:
-                part = entry.fn(graph, max_block_weights, rng)
+                with timer.scoped_timer(f"ip-flat-{entry.name}"):
+                    part = entry.fn(graph, max_block_weights, rng)
                 if not ctx.refinement.disabled:
-                    fm_bipartition_refine(
-                        graph, part, max_block_weights, ctx.refinement, rng
-                    )
+                    with timer.scoped_timer("ip-fm"):
+                        fm_bipartition_refine(
+                            graph, part, max_block_weights, ctx.refinement, rng
+                        )
                 cut = _host_cut(graph, part)
                 bw = _host_block_weights(graph, part)
                 overload = int(
@@ -136,12 +139,13 @@ class InitialMultilevelBipartitioner:
         if graph.n == 0:
             return np.zeros(0, dtype=np.int8)
         max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
-        levels = coarsen_for_bipartition(
-            graph,
-            self.ctx.coarsening,
-            rng,
-            max_block_weight=int(max_block_weights.max()),
-        )
+        with timer.scoped_timer("ip-coarsen"):
+            levels = coarsen_for_bipartition(
+                graph,
+                self.ctx.coarsening,
+                rng,
+                max_block_weight=int(max_block_weights.max()),
+            )
         coarsest = levels[-1].graph if levels else graph
         part = self.pool.bipartition(coarsest, max_block_weights, rng)
 
@@ -149,9 +153,11 @@ class InitialMultilevelBipartitioner:
             part = part[levels[i].cmap]  # project up
             fine_graph = levels[i - 1].graph if i > 0 else graph
             if not self.ctx.refinement.disabled:
-                fm_bipartition_refine(
-                    fine_graph, part, max_block_weights, self.ctx.refinement, rng
-                )
+                with timer.scoped_timer("ip-fm"):
+                    fm_bipartition_refine(
+                        fine_graph, part, max_block_weights,
+                        self.ctx.refinement, rng,
+                    )
         return part.astype(np.int8)
 
 

@@ -97,8 +97,12 @@ def host_lp_cluster(
         move = (target != labels) & (
             rating_of_target >= np.maximum(cur_rating, 1)
         )
-        # coin filter: half the nodes per sub-round (swap-oscillation guard)
-        coin = ((np.arange(n) * 2654435761 + it * 40503) >> 7) & 1
+        # coin filter: half the nodes per sub-round (swap-oscillation
+        # guard).  The coin is a fixed per-node hash — independent of the
+        # sub-round — so sub-rounds 2j and 2j+1 cover COMPLEMENTARY
+        # halves and the two-dry-sub-rounds convergence check below
+        # really has seen every node
+        coin = ((np.arange(n) * 2654435761) >> 7) & 1
         move &= coin == (it & 1)
         movers = np.flatnonzero(move)
         if len(movers) == 0:

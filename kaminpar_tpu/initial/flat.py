@@ -95,13 +95,20 @@ def bfs_bipartition(
     visited[seed] = True
     w0 = 0
     reseed_streak = 0
+    admitted_since_reseed = 0
     while w0 < stop_at:
-        # admit the weight-prefix of this level that fits under target0
-        csum = w0 + np.cumsum(node_w[frontier])
-        admit = frontier[csum <= target0]
+        # admit lightest-first until the target: within a BFS level the
+        # queue order is arbitrary, and this matches the original's
+        # skip-too-heavy-but-keep-going rule (a single heavy node never
+        # blocks the light nodes behind it)
+        order = frontier[np.argsort(node_w[frontier], kind="stable")]
+        csum = w0 + np.cumsum(node_w[order])
+        fits = csum <= target0
+        admit = order[fits]
         if len(admit):
             part[admit] = 0
-            w0 = int(csum[csum <= target0][-1])
+            w0 = int(csum[fits][-1])
+            admitted_since_reseed += len(admit)
         neigh = np.unique(_expand_frontier(graph, admit))
         nxt = neigh[~visited[neigh]]
         visited[nxt] = True
@@ -109,23 +116,25 @@ def bfs_bipartition(
             remaining = np.flatnonzero(~visited)
             if len(remaining) == 0 or w0 >= stop_at:
                 break
+            # a dead end right after a reseed means the seeded component
+            # was tiny; many in a row means the remainder is fragmented
+            # and the original's one-node-per-pop reseed loop would
+            # degenerate to python-per-node — bulk-admit a random
+            # weight-prefix instead.  A reseed that grew a real region
+            # (several admissions) resets the streak.
+            if admitted_since_reseed >= 4:
+                reseed_streak = 0
             if reseed_streak >= 16:
-                # 16 consecutive one-node components: the remainder is
-                # fragmented, and the original's one-node-per-pop reseed
-                # loop degenerates to python-per-node — equivalent bulk
-                # step: admit a random weight-prefix up to the target
                 order = rng.permutation(remaining)
                 csum = w0 + np.cumsum(node_w[order])
                 fits = (csum <= target0) & (csum - node_w[order] < stop_at)
                 part[order[fits]] = 0
                 break
             reseed_streak += 1
+            admitted_since_reseed = 0
             s = int(rng.choice(remaining))
             visited[s] = True
             nxt = np.array([s], dtype=np.int64)
-        else:
-            if len(nxt) > 1:
-                reseed_streak = 0
         frontier = nxt
     return part
 

@@ -36,6 +36,31 @@ INT32_MIN = jnp.iinfo(jnp.int32).min
 ACC_DTYPE = jnp.int32
 
 
+def pad_k_bucket(k, max_block_weights, min_block_weights=None):
+    """Round k up to a power of two with zero-capacity phantom blocks.
+
+    k is shape-defining for every refinement kernel ((n, k) tables,
+    k-segment reductions), so each distinct k would compile its own
+    executable per shape bucket — with deep k-doubling that is log2(k)
+    recompiles of the largest programs.  Phantom blocks get zero max
+    (and min) weight: no node can move into them, results are
+    identical, and one compiled program serves every k in the bucket.
+
+    Returns (k_pad, max_block_weights, min_block_weights).
+    """
+    k_pad = max(2, 1 << (int(k) - 1).bit_length())
+    if k_pad != k:
+        pad = jnp.zeros(k_pad - int(k), dtype=jnp.int32)
+        max_block_weights = jnp.concatenate(
+            [jnp.asarray(max_block_weights, dtype=jnp.int32), pad]
+        )
+        if min_block_weights is not None:
+            min_block_weights = jnp.concatenate(
+                [jnp.asarray(min_block_weights, dtype=jnp.int32), pad]
+            )
+    return k_pad, max_block_weights, min_block_weights
+
+
 def hash_u32(x: jax.Array, salt) -> jax.Array:
     """murmur3-style finalizer; returns non-negative int32."""
     x = x.astype(jnp.uint32) * jnp.uint32(0x9E3779B1) + jnp.uint32(salt)

@@ -243,6 +243,10 @@ def create_dist_refiner(ctx: DistContext) -> Callable:
     algorithms = list(ctx.refinement)
 
     def refine(graph, partition, k, max_block_weights, seed, level=0):
+        # k is shape-defining for the dist kernels too (see pad_k_bucket)
+        from ..ops.segments import pad_k_bucket
+
+        k, max_block_weights, _ = pad_k_bucket(k, max_block_weights)
         part = partition
         for j, algo in enumerate(algorithms):
             s = (int(seed) * 1013904223 + j * 12345) & 0x7FFFFFFF

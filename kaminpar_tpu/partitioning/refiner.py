@@ -48,33 +48,11 @@ class RefinerPipeline:
         num_levels: int = 1,
     ) -> jax.Array:
         from ..utils import statistics
-        from ..utils.math import ceil2
+        from ..ops.segments import pad_k_bucket
 
-        k = self.k
-        # k is shape-defining for every device kernel ((n, k) tables,
-        # k-segment reductions), so each distinct k would compile its own
-        # executable per shape bucket — with deep k-doubling that is
-        # log2(k) full recompiles of the largest programs.  Round k up to
-        # a power of two and give phantom blocks zero capacity: no node
-        # can move into them, results are identical, and one compiled
-        # program serves every k in the bucket.
-        k_pad = max(2, ceil2(k))
-        if k_pad != k:
-            pad = k_pad - k
-            max_block_weights = jnp.concatenate(
-                [
-                    jnp.asarray(max_block_weights, dtype=jnp.int32),
-                    jnp.zeros(pad, dtype=jnp.int32),
-                ]
-            )
-            if min_block_weights is not None:
-                min_block_weights = jnp.concatenate(
-                    [
-                        jnp.asarray(min_block_weights, dtype=jnp.int32),
-                        jnp.zeros(pad, dtype=jnp.int32),
-                    ]
-                )
-        k = k_pad
+        k, max_block_weights, min_block_weights = pad_k_bucket(
+            self.k, max_block_weights, min_block_weights
+        )
         for i, algorithm in enumerate(self.ctx.refinement.algorithms):
             salt = jnp.int32((seed * 2654435761 + i * 40503 + level) & 0x7FFFFFFF)
             if algorithm == RefinementAlgorithm.NOOP:
