@@ -100,9 +100,19 @@ def fm_bipartition_refine(
     else:
         stopper = _SimpleStopper(num_fruitless_moves=ctx.num_fruitless_moves)
 
+    # static CSR views as plain lists, converted once per refine call —
+    # the per-move loop in _fm_pass reads them millions of times and
+    # python list access beats numpy scalar indexing severalfold
+    csr = (
+        graph.xadj.tolist(),
+        graph.adjncy.tolist(),
+        edge_w.tolist(),
+        node_w.tolist(),
+    )
     for _ in range(max(1, ctx.num_iterations)):
         improvement = _fm_pass(
-            graph, partition, node_w, edge_w, max_block_weights, stopper, rng
+            graph, partition, node_w, edge_w, max_block_weights, stopper,
+            rng, csr,
         )
         total_improvement += improvement
         if improvement == 0:
@@ -121,18 +131,31 @@ def _gains(graph, partition, edge_w):
     return ext - internal
 
 
-def _fm_pass(graph, partition, node_w, edge_w, max_block_weights, stopper, rng):
+def _fm_pass(
+    graph, partition, node_w, edge_w, max_block_weights, stopper, rng, csr
+):
+    """One FM pass.  Hot loop works on plain python lists/ints: numpy
+    scalar indexing in the per-move inner loop is several times slower
+    than list access, and this pass runs hundreds of times per
+    partition call (same algorithm, same results)."""
     n = graph.n
-    gain = _gains(graph, partition, edge_w)
-    block_w = np.zeros(2, dtype=np.int64)
-    np.add.at(block_w, partition, node_w)
+    gain = _gains(graph, partition, edge_w).tolist()
+    bw0 = int(node_w[partition == 0].sum())
+    bw1 = int(node_w[partition == 1].sum())
+    block_w = [bw0, bw1]
+    max_bw = [int(max_block_weights[0]), int(max_block_weights[1])]
+
+    part = partition.tolist()
+    xadj, adjncy, edge_w_l, node_w_l = csr
 
     # two PQs keyed by gain with random tiebreak (lazy deletion)
+    tie = rng.random(n).tolist()
     pqs = ([], [])
-    tie = rng.random(n)
     for u in range(n):
-        heapq.heappush(pqs[partition[u]], (-int(gain[u]), tie[u], u))
-    locked = np.zeros(n, dtype=bool)
+        pqs[part[u]].append((-gain[u], tie[u], u))
+    heapq.heapify(pqs[0])
+    heapq.heapify(pqs[1])
+    locked = bytearray(n)
     stopper.reset()
 
     moves = []
@@ -144,17 +167,18 @@ def _fm_pass(graph, partition, node_w, edge_w, max_block_weights, stopper, rng):
         # choose source block: prefer the feasible move with higher gain
         candidates = []
         for b in (0, 1):
-            while pqs[b]:
-                negg, t, u = pqs[b][0]
-                if locked[u] or partition[u] != b or -negg != gain[u]:
-                    heapq.heappop(pqs[b])
+            pq = pqs[b]
+            while pq:
+                negg, t, u = pq[0]
+                if locked[u] or part[u] != b or -negg != gain[u]:
+                    heapq.heappop(pq)
                     continue
                 candidates.append((negg, t, u, b))
                 break
         feasible = [
             c
             for c in candidates
-            if block_w[1 - c[3]] + node_w[c[2]] <= max_block_weights[1 - c[3]]
+            if block_w[1 - c[3]] + node_w_l[c[2]] <= max_bw[1 - c[3]]
         ]
         if feasible:
             feasible.sort()
@@ -171,10 +195,10 @@ def _fm_pass(graph, partition, node_w, edge_w, max_block_weights, stopper, rng):
         heapq.heappop(pqs[b])
 
         # apply move u: b -> 1-b
-        locked[u] = True
-        partition[u] = 1 - b
-        block_w[b] -= node_w[u]
-        block_w[1 - b] += node_w[u]
+        locked[u] = 1
+        part[u] = 1 - b
+        block_w[b] -= node_w_l[u]
+        block_w[1 - b] += node_w_l[u]
         g = -negg
         cur_delta += g
         moves.append(u)
@@ -184,17 +208,16 @@ def _fm_pass(graph, partition, node_w, edge_w, max_block_weights, stopper, rng):
             best_len = len(moves)
 
         # update neighbor gains
-        lo, hi = int(graph.xadj[u]), int(graph.xadj[u + 1])
-        for e in range(lo, hi):
-            v = int(graph.adjncy[e])
-            w = int(edge_w[e])
+        for e in range(xadj[u], xadj[u + 1]):
+            v = adjncy[e]
+            w = edge_w_l[e]
             # v's connection to u's old block fell, to new block rose
-            if partition[v] == b:
+            if part[v] == b:
                 gain[v] += 2 * w
             else:
                 gain[v] -= 2 * w
             if not locked[v]:
-                heapq.heappush(pqs[partition[v]], (-int(gain[v]), tie[v], v))
+                heapq.heappush(pqs[part[v]], (-gain[v], tie[v], v))
         gain[u] = -gain[u]
 
         if stopper.should_stop():
@@ -202,5 +225,6 @@ def _fm_pass(graph, partition, node_w, edge_w, max_block_weights, stopper, rng):
 
     # roll back to best prefix
     for u in moves[best_len:]:
-        partition[u] = 1 - partition[u]
+        part[u] = 1 - part[u]
+    partition[:] = part
     return best_delta

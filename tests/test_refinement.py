@@ -93,3 +93,24 @@ def test_fm_host_improves_partition():
     assert after < before
     bw = np.asarray(metrics.block_weights(dg, out, 2))
     assert (bw <= 40).all()
+
+
+def test_k_bucketing_never_uses_phantom_blocks():
+    """RefinerPipeline pads k to a power of two with zero-capacity
+    phantom blocks (ops/segments.pad_k_bucket); labels must stay < k."""
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+    from kaminpar_tpu.ops.segments import pad_k_bucket
+
+    k_pad, max_bw, min_bw = pad_k_bucket(5, np.array([10, 10, 10, 10, 10]))
+    assert k_pad == 8
+    assert max_bw.shape == (8,) and int(max_bw[5:].sum()) == 0
+    assert min_bw is None
+
+    g = factories.make_rmat(1 << 10, 6_000, seed=4)
+    for k in (3, 5, 11):
+        p = KaMinPar("default")
+        p.set_output_level(OutputLevel.QUIET)
+        part = p.set_graph(g).compute_partition(k=k, epsilon=0.05, seed=2)
+        assert part.min() >= 0 and part.max() < k
+        assert len(np.unique(part)) == k  # all real blocks populated
