@@ -144,9 +144,19 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["auto", "metis", "parhip", "compressed"],
         help="input graph format",
     )
+    p.add_argument(
+        "--node-ordering", default="natural",
+        choices=["natural", "degree-buckets"],
+        help="node ordering applied after loading (NodeOrdering analog)",
+    )
     p.add_argument("-o", "--output", default=None, help="partition output file")
     p.add_argument(
         "--output-block-sizes", default=None, help="block size output file"
+    )
+    p.add_argument(
+        "--output-remapping", default=None,
+        help="write the node remapping applied by --node-ordering "
+        "(write_remapping analog)",
     )
     p.add_argument("-q", "--quiet", action="store_true", help="no output")
     p.add_argument(
@@ -261,6 +271,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         graph = generate(args.graph)
     else:
         graph = io_mod.load_graph(args.graph, fmt=args.format)
+    perm = None
+    if args.node_ordering == "degree-buckets":
+        from .graphs.compressed import CompressedHostGraph
+
+        if isinstance(graph, CompressedHostGraph):
+            print(
+                "error: --node-ordering is not supported for compressed "
+                "containers",
+                file=sys.stderr,
+            )
+            return 1
+        from .graphs import apply_permutation, degree_bucket_permutation
+
+        perm = degree_bucket_permutation(graph)
+        graph = apply_permutation(graph, perm)
     io_s = time.perf_counter() - t_io
     if not ctx.debug.graph_name:
         base = os.path.basename(args.graph)
@@ -303,6 +328,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.statistics and not args.quiet:
         print(statistics.render())
 
+    if perm is not None:
+        # partition is indexed by reordered node ids; write in file order
+        # (the permutation-aware output of kaminpar.cc:437-448)
+        partition = partition[perm.old_to_new]
+        if args.output_remapping:
+            io_mod.write_remapping(args.output_remapping, perm.old_to_new)
     if args.output:
         io_mod.write_partition(args.output, partition)
     if args.output_block_sizes:

@@ -16,14 +16,20 @@ from .partition import (  # noqa: F401
     write_partition,
     read_block_sizes,
     write_block_sizes,
+    write_remapping,
 )
 from ..graphs.host import HostGraph
 
 
-def load_graph(path: str, fmt: str = "auto"):
+def load_graph(path: str, fmt: str = "auto", ordering: str = "natural"):
     """Load a graph by file format (kaminpar_io.h read_graph analog).
     fmt: 'metis', 'parhip', 'compressed', or 'auto' (sniff by extension
-    then content).  'compressed' returns a CompressedHostGraph."""
+    then content).  'compressed' returns a CompressedHostGraph.
+    ordering: 'natural' keeps file order; 'degree-buckets' rearranges
+    nodes into exponentially-spaced degree buckets (NodeOrdering
+    analog; not applicable to compressed containers)."""
+    if ordering not in ("natural", "degree-buckets"):
+        raise ValueError(f"unknown node ordering: {ordering}")
     if fmt == "auto":
         ext = os.path.splitext(path)[1].lower()
         if ext in (".metis", ".graph", ".txt"):
@@ -37,12 +43,23 @@ def load_graph(path: str, fmt: str = "auto"):
                 head = f.read(64)
             fmt = "metis" if _looks_like_text(head) else "parhip"
     if fmt == "metis":
-        return load_metis(path)
-    if fmt == "parhip":
-        return load_parhip(path)
-    if fmt == "compressed":
-        return load_compressed(path)
-    raise ValueError(f"unknown graph format: {fmt}")
+        graph = load_metis(path)
+    elif fmt == "parhip":
+        graph = load_parhip(path)
+    elif fmt == "compressed":
+        graph = load_compressed(path)
+        if ordering != "natural":
+            raise ValueError(
+                "ordering is not supported for compressed containers"
+            )
+        return graph
+    else:
+        raise ValueError(f"unknown graph format: {fmt}")
+    if ordering == "degree-buckets":
+        from ..graphs.host import apply_permutation, degree_bucket_permutation
+
+        graph = apply_permutation(graph, degree_bucket_permutation(graph))
+    return graph
 
 
 def _looks_like_text(head: bytes) -> bool:

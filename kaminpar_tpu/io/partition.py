@@ -24,3 +24,9 @@ def write_block_sizes(path: str, partition: np.ndarray, k: int) -> None:
 
 def read_block_sizes(path: str) -> np.ndarray:
     return np.loadtxt(path, dtype=np.int64, ndmin=1)
+
+
+def write_remapping(path: str, mapping: np.ndarray) -> None:
+    """One new node id per line (kaminpar_io.h write_remapping analog;
+    used to persist e.g. the degree-bucket permutation)."""
+    np.savetxt(path, np.asarray(mapping, dtype=np.int64), fmt="%d")

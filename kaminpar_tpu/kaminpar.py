@@ -151,6 +151,8 @@ class KaMinPar:
         prior_level = global_output_level()
         try:
             set_output_level(getattr(self, "_explicit_level", prior_level))
+            if self.output_level >= OutputLevel.APPLICATION:
+                self._print_context_summary(graph, ctx)
             with timer.scoped_timer("partitioning"), scoped_heap_profiler(
                 "partitioning"
             ):
@@ -243,6 +245,24 @@ class KaMinPar:
         node_w = graph.node_weight_array()
         bw = np.zeros(p.k, dtype=np.int64)
         return _fill_blocks_by_headroom(node_w, bw, p.max_block_weights)
+
+    def _print_context_summary(self, graph, ctx: Context) -> None:
+        """Startup banner + compact context block (the analog of the
+        reference's version banner and context printer,
+        kaminpar-shm/context.cc / kaminpar-common console_io)."""
+        from . import __version__
+
+        p = ctx.partition
+        log(f"kaminpar-tpu v{__version__} (preset '{ctx.preset_name}', "
+            f"seed {ctx.seed})")
+        log(f"  graph: n={graph.n} m={graph.m} "
+            f"total_node_weight={graph.total_node_weight}")
+        log(f"  partition: k={p.k} eps={p.epsilon} "
+            f"mode={ctx.partitioning.mode.value}")
+        log(f"  coarsening: {ctx.coarsening.algorithm.value} "
+            f"(contraction limit {ctx.coarsening.contraction_limit}), "
+            f"refinement: "
+            f"{';'.join(a.value for a in ctx.refinement.algorithms)}")
 
     def _print_result(self, graph, partition) -> None:
         """Parseable RESULT line (kaminpar-shm/kaminpar.cc:48)."""

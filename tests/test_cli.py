@@ -99,3 +99,25 @@ def test_cli_machine_timers(capfd):
     assert "partitioning" in pairs
     assert float(pairs["partitioning"]) > 0
     assert any(key.startswith("partitioning.") for key in pairs)
+
+
+def test_cli_degree_bucket_ordering_outputs_file_order(tmp_path):
+    """--node-ordering reorders internally but the written partition is
+    in original file order (permutation-aware output)."""
+    out_nat = tmp_path / "nat.txt"
+    out_db = tmp_path / "db.txt"
+    remap = tmp_path / "remap.txt"
+    assert main([RGG, "-k", "4", "-q", "-o", str(out_nat)]) == 0
+    assert main([RGG, "-k", "4", "-q", "--node-ordering", "degree-buckets",
+                 "-o", str(out_db), "--output-remapping", str(remap)]) == 0
+    mapping = np.loadtxt(remap, dtype=np.int64)
+    assert sorted(mapping.tolist()) == list(range(1024))
+    from kaminpar_tpu.io import load_graph
+
+    g = load_graph(RGG)
+    src, dst = g.edge_sources(), g.adjncy
+    for path in (out_nat, out_db):
+        part = np.loadtxt(path, dtype=np.int64)
+        assert part.shape == (g.n,)
+        cut = int((part[src] != part[dst]).sum()) // 2
+        assert 0 < cut < g.m  # sane cut in FILE order for both runs

@@ -83,3 +83,26 @@ def test_load_graph_auto_detect(tmp_path):
     write_parhip(g, pp)
     assert load_graph(mp).m == g.m
     assert load_graph(pp).m == g.m
+
+
+def test_load_graph_degree_bucket_ordering(tmp_path):
+    """read_graph NodeOrdering analog: degree-buckets rearrangement."""
+    import numpy as np
+
+    from kaminpar_tpu.io import load_graph, write_remapping
+
+    g_nat = load_graph("/root/reference/misc/rgg2d.metis")
+    g_db = load_graph(
+        "/root/reference/misc/rgg2d.metis", ordering="degree-buckets"
+    )
+    assert g_db.n == g_nat.n and g_db.m == g_nat.m
+    deg = np.diff(g_db.xadj)
+    # bucket = floor(log2(deg)) + 1 (0 for isolated) must be sorted
+    bucket = np.where(
+        deg > 0, np.floor(np.log2(np.maximum(deg, 1))) + 1, 0
+    )
+    assert (np.diff(bucket) >= 0).all()
+
+    path = tmp_path / "remap.txt"
+    write_remapping(str(path), np.arange(g_db.n))
+    assert np.loadtxt(path, dtype=np.int64).shape == (g_db.n,)
