@@ -332,8 +332,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # partition is indexed by reordered node ids; write in file order
         # (the permutation-aware output of kaminpar.cc:437-448)
         partition = partition[perm.old_to_new]
-        if args.output_remapping:
-            io_mod.write_remapping(args.output_remapping, perm.old_to_new)
+    if args.output_remapping:
+        io_mod.write_remapping(
+            args.output_remapping,
+            perm.old_to_new if perm is not None
+            else np.arange(graph.n, dtype=np.int64),  # natural = identity
+        )
     if args.output:
         io_mod.write_partition(args.output, partition)
     if args.output_block_sizes:

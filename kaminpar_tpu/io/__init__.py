@@ -42,17 +42,14 @@ def load_graph(path: str, fmt: str = "auto", ordering: str = "natural"):
             with open(path, "rb") as f:
                 head = f.read(64)
             fmt = "metis" if _looks_like_text(head) else "parhip"
+    if fmt == "compressed" and ordering != "natural":
+        raise ValueError("ordering is not supported for compressed containers")
     if fmt == "metis":
         graph = load_metis(path)
     elif fmt == "parhip":
         graph = load_parhip(path)
     elif fmt == "compressed":
-        graph = load_compressed(path)
-        if ordering != "natural":
-            raise ValueError(
-                "ordering is not supported for compressed containers"
-            )
-        return graph
+        return load_compressed(path)
     else:
         raise ValueError(f"unknown graph format: {fmt}")
     if ordering == "degree-buckets":

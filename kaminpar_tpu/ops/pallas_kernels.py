@@ -1,0 +1,176 @@
+"""Pallas TPU kernels for the regular-access hot ops.
+
+The pipeline's irregular ops (edge gathers, scatters) have no Pallas
+path on TPU — vector fancy-indexing is rejected by the Mosaic lowering
+("Cannot do int indexing on TPU"), so the XLA gather is their floor
+(see docs/performance.md).  The *regular* hot op that does benefit is
+the dense (n, k) best-block reduction used by every refinement round
+(segments.best_from_dense): XLA materializes ~6 (n, k) temporaries
+(feasibility mask, score, two maxes, tie hashes, winner mask) through
+HBM, while one Pallas kernel streams a (TILE_N, k) block through VMEM
+once and emits the three n-vectors directly.
+
+The kernel is numerically identical to the XLA path (verified on
+device) and ~8x faster *standalone*: 0.13 s vs ~1 s for the XLA chain
+at n=2^20, k=16.  Inside the big fused refinement programs, however,
+XLA's own fusion already keeps the chain in registers/VMEM and the
+measured Jet iteration time is unchanged — while the embedded
+pallas_call changes every program hash and forces a full recompile of
+the persistent cache.  The dispatch is therefore OPT-IN: set
+KAMINPAR_TPU_PALLAS=1 to route `best_from_dense` through this kernel
+on TPU (no community mask, k <= 128, n_pad % 1024 == 0).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# the hash MUST be the same function as the XLA path's tie-break — a
+# diverging copy would produce different partitions under the opt-in
+# (it is plain jnp ops, kernel-safe; no import cycle: segments imports
+# this module only lazily inside best_from_dense)
+from .segments import INT32_MIN, hash_u32 as _hash_u32
+
+TILE_N = 1024  # 1D int32 XLA layout tile on TPU (Mosaic requires matching blocks)
+
+
+def eligible(n_pad: int, k: int) -> bool:
+    """Kernel preconditions (single source for the dispatch guard)."""
+    return n_pad % TILE_N == 0 and k <= 128
+
+
+def _kernel(
+    salt_ref,
+    conn_ref,
+    labels_ref,
+    cw_ref,
+    node_w_ref,
+    cap_ref,
+    allowed_ref,
+    best_ref,
+    best_w_ref,
+    w_own_ref,
+    *,
+    k: int,
+    require_fit: bool,
+):
+    conn = conn_ref[...]  # (TILE_N, k)
+    labels = labels_ref[...]  # (TILE_N,)
+    salt = salt_ref[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (TILE_N, k), 1)
+    lab_col = jnp.clip(labels, 0, k - 1)[:, None]
+
+    own = cols == lab_col
+    # w_own via masked reduction: conn[row, label[row]] without indexing
+    w_own = jnp.max(jnp.where(own, conn, INT32_MIN), axis=1)
+
+    feas = ~own
+    feas = feas & (allowed_ref[...][None, :] != 0)
+    if require_fit:
+        fits = (
+            cw_ref[...][None, :] + node_w_ref[...][:, None]
+            <= cap_ref[...][None, :]
+        )
+        feas = feas & fits
+
+    score = jnp.where(feas, conn, INT32_MIN)
+    best_w = jnp.max(score, axis=1)
+    has = best_w > INT32_MIN
+    is_best = feas & (score == best_w[:, None])
+    tb = _hash_u32(cols, salt)
+    best_tb = jnp.max(jnp.where(is_best, tb, -1), axis=1)
+    winner = is_best & (tb == best_tb[:, None])
+    best = jnp.max(jnp.where(winner, cols, -1), axis=1)
+
+    best_ref[...] = jnp.where(has, best, -1)
+    best_w_ref[...] = jnp.where(has, best_w, INT32_MIN)
+    w_own_ref[...] = w_own
+
+
+@functools.partial(
+    jax.jit, static_argnames=("require_fit", "interpret")
+)
+def best_from_dense_pallas(
+    conn,
+    labels,
+    cluster_weights,
+    node_w,
+    cap,
+    salt,
+    require_fit: bool = True,
+    allowed=None,
+    interpret: bool = False,
+):
+    """Pallas twin of segments.best_from_dense (no `communities` mask)."""
+    n_pad, k = conn.shape
+    assert n_pad % TILE_N == 0, n_pad
+    cap_b = jnp.broadcast_to(
+        jnp.asarray(cap, dtype=jnp.int32), (k,)
+    )
+    allowed_i = (
+        jnp.ones((k,), dtype=jnp.int32)
+        if allowed is None
+        else jnp.asarray(allowed).astype(jnp.int32)
+    )
+    salt_arr = jnp.asarray(salt, dtype=jnp.int32).reshape((1,))
+    grid = (n_pad // TILE_N,)
+    row_block = pl.BlockSpec((TILE_N, k), lambda i: (i, 0))
+    vec_block = pl.BlockSpec((TILE_N,), lambda i: (i,))
+    k_block = pl.BlockSpec((k,), lambda i: (0,))
+    out = pl.pallas_call(
+        functools.partial(_kernel, k=k, require_fit=require_fit),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda i: (0,)),  # salt
+            row_block,  # conn
+            vec_block,  # labels
+            k_block,  # cluster_weights
+            vec_block,  # node_w
+            k_block,  # cap
+            k_block,  # allowed
+        ],
+        out_specs=[vec_block, vec_block, vec_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+            jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        salt_arr,
+        conn.astype(jnp.int32),
+        jnp.asarray(labels, dtype=jnp.int32),
+        jnp.asarray(cluster_weights, dtype=jnp.int32),
+        jnp.asarray(node_w, dtype=jnp.int32),
+        cap_b,
+        allowed_i,
+    )
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available() -> bool:
+    """Opt-in check + one-time probe that the kernel compiles here."""
+    if not os.environ.get("KAMINPAR_TPU_PALLAS"):
+        return False
+    try:
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return False
+        conn = jnp.zeros((TILE_N, 4), dtype=jnp.int32)
+        r = best_from_dense_pallas(
+            conn,
+            jnp.zeros(TILE_N, dtype=jnp.int32),
+            jnp.zeros(4, dtype=jnp.int32),
+            jnp.zeros(TILE_N, dtype=jnp.int32),
+            jnp.zeros(4, dtype=jnp.int32),
+            jnp.int32(0),
+        )
+        jax.block_until_ready(r)
+        return True
+    except Exception:  # pragma: no cover - backend specific
+        return False

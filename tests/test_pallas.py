@@ -1,0 +1,37 @@
+"""Pallas kernel tests (interpret mode — numerical twin of the XLA path)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from kaminpar_tpu.ops.pallas_kernels import TILE_N, best_from_dense_pallas
+from kaminpar_tpu.ops.segments import best_from_dense
+
+
+@pytest.mark.parametrize("require_fit", [True, False])
+@pytest.mark.parametrize("with_allowed", [False, True])
+def test_best_from_dense_pallas_matches_xla(require_fit, with_allowed):
+    rng = np.random.default_rng(0)
+    n_pad, k = 2 * TILE_N, 8
+    conn = jnp.asarray(rng.integers(0, 100, size=(n_pad, k)), dtype=jnp.int32)
+    labels = jnp.asarray(rng.integers(0, k, size=n_pad), dtype=jnp.int32)
+    cw = jnp.asarray(rng.integers(0, 50, size=k), dtype=jnp.int32)
+    node_w = jnp.asarray(rng.integers(1, 5, size=n_pad), dtype=jnp.int32)
+    cap = jnp.full((k,), 52, dtype=jnp.int32)
+    allowed = (
+        jnp.asarray(rng.integers(0, 2, size=k).astype(bool))
+        if with_allowed
+        else None
+    )
+    salt = jnp.int32(7)
+
+    ref = best_from_dense(
+        conn, labels, cw, node_w, cap, salt,
+        require_fit=require_fit, allowed=allowed,
+    )
+    got = best_from_dense_pallas(
+        conn, labels, cw, node_w, cap, salt,
+        require_fit=require_fit, allowed=allowed, interpret=True,
+    )
+    for a, b, name in zip(ref, got, ("best", "best_w", "w_own")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
