@@ -429,3 +429,28 @@ def test_torus_mesh_runs_dist_pipeline():
     cut = dist_edge_cut(graph, jnp.asarray(
         np.pad(part, (0, graph.n_pad - host.n)).astype(np.int32)))
     assert 0 < int(cut) <= host.m
+
+
+def test_dist_quality_tracks_shm():
+    """The distributed driver's cut stays within 2x of the shm pipeline
+    on the same graph (dist refinement is chunked/bulk-synchronous, so
+    exact parity is not expected — the reference makes the same
+    trade, dkaminpar vs kaminpar)."""
+    from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.graphs.host import host_partition_metrics
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.parallel import dKaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    g = make_rmat(1 << 12, 30_000, seed=13)
+    shm = KaMinPar("fast")
+    shm.set_output_level(OutputLevel.QUIET)
+    part_shm = shm.set_graph(g).compute_partition(k=8, epsilon=0.05, seed=1)
+    cut_shm = host_partition_metrics(g, part_shm, 8)["cut"]
+
+    dist = dKaMinPar("default", n_devices=4).set_graph(g)
+    dist.set_output_level(OutputLevel.QUIET)
+    part_dist = dist.compute_partition(k=8, epsilon=0.05, seed=1)
+    cut_dist = host_partition_metrics(g, part_dist, 8)["cut"]
+
+    assert cut_dist <= 2 * cut_shm, (cut_dist, cut_shm)
