@@ -272,6 +272,10 @@ class PartitioningSchemeContext:
     )
     deep_initial_partitioning_load: float = 1.0
     refine_after_extending_partition: bool = False
+    # single-round Jet for intermediate k-doubling extensions (another
+    # doubling follows immediately): ~13% faster end-to-end for ~0.1%
+    # cut on the RMAT bench — on for the fast preset, off by default
+    light_intermediate_refinement: bool = False
     # extend_partition blocks at least this large are bipartitioned through
     # the device pipeline (LP coarsening + 2-way device refinement) instead
     # of the sequential host pool — the TPU answer to the reference running

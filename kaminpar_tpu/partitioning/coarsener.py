@@ -117,8 +117,18 @@ class Coarsener:
                 cluster_input, cap, seed + jnp.int32(salt_off), self._lp_cfg
             )
 
+        # dispatch is async and block_until_ready is unreliable over the
+        # remote backend; a scalar readback inside the scope keeps the
+        # LP/contraction attribution honest (otherwise the first host
+        # sync in contract_clustering absorbs the whole LP runtime).
+        # Only worth a host round-trip when the timer actually records.
+        def drain(x):
+            if timer.GLOBAL_TIMER.enabled:
+                int(jnp.sum(x[:1]))
+
         with timer.scoped_timer("lp-clustering"):
             labels = cluster_once(mcw, 0)
+            drain(labels)
         with timer.scoped_timer("contraction"):
             coarse, c_n, c_m = contract_clustering(self.current, labels)
 
@@ -138,6 +148,7 @@ class Coarsener:
             mcw = jnp.int32(min(int(mcw) * 2, 2**31 - 1))
             with timer.scoped_timer("lp-clustering"):
                 labels = cluster_once(mcw, retries * 977)
+                drain(labels)
             with timer.scoped_timer("contraction"):
                 coarse, c_n, c_m = contract_clustering(self.current, labels)
 

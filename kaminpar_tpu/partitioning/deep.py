@@ -178,22 +178,31 @@ class DeepMultilevelPartitioner:
         ctx = self.ctx
         partition = self._refine(dgraph, partition, current_k, level, num_levels)
         desired_k = compute_k_for_n(n, ctx)
-        while current_k < min(desired_k, ctx.partition.k):
+        target_k = min(desired_k, ctx.partition.k)
+        while current_k < target_k:
             partition, spans, current_k = self._extend_partition(
                 dgraph, partition, spans, min(2 * current_k, ctx.partition.k), rng
             )
             if ctx.partitioning.refine_after_extending_partition:
+                # with light_intermediate_refinement, extensions that are
+                # followed by another doubling get a single-round Jet —
+                # the partition is refined again at the next doubling;
+                # only the final extension's refine is the real polish
                 partition = self._refine(
-                    dgraph, partition, current_k, level, num_levels
+                    dgraph, partition, current_k, level, num_levels,
+                    light=(
+                        ctx.partitioning.light_intermediate_refinement
+                        and current_k < target_k
+                    ),
                 )
         return partition, spans, current_k
 
-    def _refine(self, dgraph, partition, k, level, num_levels):
+    def _refine(self, dgraph, partition, k, level, num_levels, light=False):
         ctx = self.ctx
         # block weight caps for the *current* k: each current block's cap is
         # the sum of its final sub-blocks' caps (helper.cc block splitting)
         max_bw, min_bw = self._current_block_weights(k)
-        refiner = RefinerPipeline(ctx, k)
+        refiner = RefinerPipeline(ctx, k, light=light)
         return refiner.refine(
             dgraph,
             partition,

@@ -8,6 +8,7 @@ kernels: LP refinement (ops/lp.lp_refine), overload/underload balancing
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -24,11 +25,17 @@ from ..utils.logger import log_debug, log_warning
 
 
 class RefinerPipeline:
-    """Runs the context's refiner list in order (MultiRefiner analog)."""
+    """Runs the context's refiner list in order (MultiRefiner analog).
 
-    def __init__(self, ctx: Context, k: int):
+    `light=True` marks refinement of an intermediate k-doubling
+    extension (another doubling immediately follows): Jet runs a single
+    round there — the partition gets its full-strength refine at the
+    final extension of the level."""
+
+    def __init__(self, ctx: Context, k: int, light: bool = False):
         self.ctx = ctx
         self.k = k
+        self.light = light
         self._lp_cfg = LPConfig(
             num_iterations=ctx.refinement.lp.num_iterations,
             participation=ctx.refinement.lp.participation,
@@ -88,6 +95,13 @@ class RefinerPipeline:
             elif algorithm == RefinementAlgorithm.JET:
                 from ..ops.jet import jet_refine
 
+                jet_ctx = self.ctx.refinement.jet
+                if self.light:
+                    jet_ctx = dataclasses.replace(
+                        jet_ctx,
+                        num_rounds_on_fine_level=1,
+                        num_rounds_on_coarse_level=1,
+                    )
                 with timer.scoped_timer("jet"):
                     partition = jet_refine(
                         graph,
@@ -95,7 +109,7 @@ class RefinerPipeline:
                         k,
                         max_block_weights,
                         salt,
-                        self.ctx.refinement.jet,
+                        jet_ctx,
                         level=level,
                         num_levels=num_levels,
                     )

@@ -60,6 +60,7 @@ def create_fast_context() -> Context:
     ctx.initial_partitioning.pool.min_num_repetitions = 1
     ctx.initial_partitioning.pool.min_num_non_adaptive_repetitions = 1
     ctx.initial_partitioning.pool.max_num_repetitions = 1
+    ctx.partitioning.light_intermediate_refinement = True
     return ctx
 
 
