@@ -53,7 +53,12 @@ def _jet_iteration(
     gain_temp: jax.Array,
     salt: jax.Array,
     balancer_rounds: int,
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One Jet move round.  Returns (new_part, new_lock, own_sum) where
+    own_sum = sum of each real node's connection to its own block in the
+    INPUT partition — the rating table gives the input partition's edge
+    cut for free as (total_directed_edge_weight - own_sum) / 2, saving
+    the driver a separate edge-wide cut pass per iteration."""
     n_pad = graph.n_pad
     node_ids = jnp.arange(n_pad, dtype=jnp.int32)
     is_real = node_ids < graph.n
@@ -69,6 +74,7 @@ def _jet_iteration(
         conn, part, jnp.zeros((k,), ACC_DTYPE), graph.node_w,
         jnp.zeros((k,), ACC_DTYPE), salt, require_fit=False,
     )
+    own_sum = jnp.sum(jnp.where(is_real, conn_own, 0).astype(ACC_DTYPE))
     gain = best_conn - conn_own  # gain of moving to best external block
     is_border = best >= 0
     threshold = -jnp.floor(gain_temp * conn_own.astype(jnp.float32)).astype(
@@ -181,7 +187,7 @@ def _jet_iteration(
         bal_body,
         (jnp.int32(0), new_part, jnp.int32(1), _overload(new_part)),
     )
-    return new_part, new_lock
+    return new_part, new_lock, own_sum
 
 
 @partial(
@@ -203,6 +209,7 @@ def _jet_chunk(
     seed: jax.Array,
     rnd: jax.Array,
     limit: jax.Array,
+    total_w: jax.Array,
     max_fruitless: int,
     balancer_rounds: int,
 ):
@@ -232,7 +239,7 @@ def _jet_chunk(
         salt = (
             seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
         ) & 0x7FFFFFFF
-        part, lock = _jet_iteration(
+        new_part, lock, own_sum = _jet_iteration(
             graph,
             part,
             lock,
@@ -242,7 +249,10 @@ def _jet_chunk(
             salt,
             balancer_rounds,
         )
-        cut = edge_cut(graph, part)
+        # snapshot the state ENTERING this iteration (its cut falls out
+        # of the rating); the state leaving the round's final iteration
+        # is closed out by _jet_round_close in the driver
+        cut = (total_w - own_sum) // 2
         # while best_cut is still the no-feasible-partition sentinel,
         # "improvement" means finding the first feasible partition —
         # comparing against the sentinel would defeat the fruitless
@@ -259,7 +269,7 @@ def _jet_chunk(
         is_best = (cut <= best_cut) & is_feasible(part)
         best = jnp.where(is_best, part, best)
         best_cut = jnp.where(is_best, cut, best_cut)
-        return (j + 1, fruitless, part, lock, best, best_cut)
+        return (j + 1, fruitless, new_part, lock, best, best_cut)
 
     _, fruitless, part, lock, best, best_cut = lax.while_loop(
         iter_cond,
@@ -267,6 +277,27 @@ def _jet_chunk(
         (jnp.int32(0), fruitless, part, lock, best, best_cut),
     )
     return part, lock, best, best_cut, fruitless
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _jet_round_close(
+    graph: DeviceGraph,
+    part: jax.Array,
+    best: jax.Array,
+    best_cut: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+):
+    """Evaluate the round's final (post-move) state once: the in-loop
+    snapshots cover every state except the last one."""
+    from .metrics import is_feasible as feasibility
+
+    cut = edge_cut(graph, part)
+    is_best = (cut <= best_cut) & feasibility(graph, part, max_block_weights)
+    return (
+        jnp.where(is_best, part, best),
+        jnp.where(is_best, cut, best_cut),
+    )
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -302,6 +333,10 @@ def _jet_refine_impl(
 ) -> jax.Array:
     part, best_cut = _jet_init(graph, partition, k, max_block_weights)
     best = part
+    # directed total edge weight (pad edges weigh 0): each iteration's
+    # rating table then yields the visited partition's exact cut as
+    # (total_w - own_sum) / 2 — no separate edge-wide cut pass
+    total_w = jnp.sum(graph.edge_w.astype(ACC_DTYPE))
     for rnd in range(num_rounds):
         if num_rounds > 1:
             gain_temp = initial_gain_temp + (
@@ -312,21 +347,40 @@ def _jet_refine_impl(
         lock = jnp.zeros(graph.n_pad, dtype=jnp.int32)
         fruitless = jnp.int32(0)
         i = 0
+        closed = False
         while i < max_iterations:
             part, lock, best, best_cut, fruitless = _jet_chunk(
                 graph, part, lock, best, best_cut, fruitless,
                 jnp.int32(i), k, max_block_weights,
                 jnp.float32(gain_temp), jnp.float32(fruitless_threshold),
                 seed, jnp.int32(rnd),
-                jnp.int32(min(chunk, max_iterations - i)), max_fruitless,
-                balancer_rounds,
+                jnp.int32(min(chunk, max_iterations - i)), total_w,
+                max_fruitless, balancer_rounds,
             )
             i += chunk
             # the readback is a blocking device sync; skip it when the
             # fruitless early-exit is disabled so chunks enqueue
             # back-to-back
             if max_fruitless < max_iterations and int(fruitless) >= max_fruitless:
+                # the in-loop snapshots lag one iteration; before giving
+                # up, evaluate the (uncounted) final state — if it just
+                # improved the best cut, the plateau was illusory and
+                # the round keeps going
+                prev_best = int(best_cut)
+                best, best_cut = _jet_round_close(
+                    graph, part, best, best_cut, k, max_block_weights
+                )
+                closed = True
+                if int(best_cut) < prev_best:
+                    fruitless = jnp.int32(0)
+                    closed = False
+                    continue
                 break
+        if not closed:
+            # close out the round's final (post-move, unrated) state
+            best, best_cut = _jet_round_close(
+                graph, part, best, best_cut, k, max_block_weights
+            )
         # rollback to best (jet_refiner.cc:221-227): the round continues
         # from the best partition seen
         part = best
