@@ -39,6 +39,7 @@ from .metrics import edge_cut
 from .segments import (
     ACC_DTYPE,
     INT32_MIN,
+    MAX_FUSED_EDGE_SLOTS,
     best_from_dense,
     dense_block_ratings,
 )
@@ -53,12 +54,17 @@ def _jet_iteration(
     gain_temp: jax.Array,
     salt: jax.Array,
     balancer_rounds: int,
+    wdeg: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One Jet move round.  Returns (new_part, new_lock, own_sum) where
-    own_sum = sum of each real node's connection to its own block in the
-    INPUT partition — the rating table gives the input partition's edge
-    cut for free as (total_directed_edge_weight - own_sum) / 2, saving
-    the driver a separate edge-wide cut pass per iteration."""
+    """One Jet move round.  Returns (new_part, new_lock, ext_sum) where
+    ext_sum = sum over real nodes of (weighted degree - connection to own
+    block) in the INPUT partition — the rating table gives the input
+    partition's edge cut for free as ext_sum / 2, saving the driver a
+    separate edge-wide cut pass per iteration.  ext_sum = 2*cut stays in
+    int32 exactly when edge_cut itself would (unlike a total-edge-weight
+    sum, which overflows first on heavy graphs).  `wdeg` is the static
+    per-node weighted degree; when None, ext_sum is returned as 0 (the
+    caller does not use it)."""
     n_pad = graph.n_pad
     node_ids = jnp.arange(n_pad, dtype=jnp.int32)
     is_real = node_ids < graph.n
@@ -74,7 +80,12 @@ def _jet_iteration(
         conn, part, jnp.zeros((k,), ACC_DTYPE), graph.node_w,
         jnp.zeros((k,), ACC_DTYPE), salt, require_fit=False,
     )
-    own_sum = jnp.sum(jnp.where(is_real, conn_own, 0).astype(ACC_DTYPE))
+    if wdeg is not None:
+        ext_sum = jnp.sum(
+            jnp.where(is_real, wdeg - conn_own, 0).astype(ACC_DTYPE)
+        )
+    else:
+        ext_sum = jnp.int32(0)
     gain = best_conn - conn_own  # gain of moving to best external block
     is_border = best >= 0
     threshold = -jnp.floor(gain_temp * conn_own.astype(jnp.float32)).astype(
@@ -187,7 +198,7 @@ def _jet_iteration(
         bal_body,
         (jnp.int32(0), new_part, jnp.int32(1), _overload(new_part)),
     )
-    return new_part, new_lock, own_sum
+    return new_part, new_lock, ext_sum
 
 
 @partial(
@@ -209,7 +220,7 @@ def _jet_chunk(
     seed: jax.Array,
     rnd: jax.Array,
     limit: jax.Array,
-    total_w: jax.Array,
+    wdeg: jax.Array,
     max_fruitless: int,
     balancer_rounds: int,
 ):
@@ -239,7 +250,7 @@ def _jet_chunk(
         salt = (
             seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
         ) & 0x7FFFFFFF
-        new_part, lock, own_sum = _jet_iteration(
+        new_part, lock, ext_sum = _jet_iteration(
             graph,
             part,
             lock,
@@ -248,11 +259,12 @@ def _jet_chunk(
             gain_temp,
             salt,
             balancer_rounds,
+            wdeg=wdeg,
         )
         # snapshot the state ENTERING this iteration (its cut falls out
         # of the rating); the state leaving the round's final iteration
         # is closed out by _jet_round_close in the driver
-        cut = (total_w - own_sum) // 2
+        cut = ext_sum // 2
         # while best_cut is still the no-feasible-partition sentinel,
         # "improvement" means finding the first feasible partition —
         # comparing against the sentinel would defeat the fruitless
@@ -333,10 +345,21 @@ def _jet_refine_impl(
 ) -> jax.Array:
     part, best_cut = _jet_init(graph, partition, k, max_block_weights)
     best = part
-    # directed total edge weight (pad edges weigh 0): each iteration's
-    # rating table then yields the visited partition's exact cut as
-    # (total_w - own_sum) / 2 — no separate edge-wide cut pass
-    total_w = jnp.sum(graph.edge_w.astype(ACC_DTYPE))
+    # static per-node weighted degree (one streaming pass per refine
+    # call, via the CSR row spans): each iteration's rating table then
+    # yields the visited partition's exact cut as sum(wdeg - conn_own)/2
+    # — no per-iteration cut pass
+    csum = jnp.cumsum(graph.edge_w.astype(ACC_DTYPE))
+    csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
+    row_ptr = jnp.clip(graph.row_ptr, 0, graph.edge_w.shape[0])
+    wdeg = csum0[row_ptr[1:]] - csum0[row_ptr[:-1]]
+    # scale the iteration chunk down with edge count so each launch
+    # stays short (see segments.MAX_FUSED_EDGE_SLOTS)
+    m_pad = graph.src.shape[0]
+    if m_pad > MAX_FUSED_EDGE_SLOTS:
+        chunk = 1
+    elif m_pad > MAX_FUSED_EDGE_SLOTS // 2:
+        chunk = min(chunk, 2)
     for rnd in range(num_rounds):
         if num_rounds > 1:
             gain_temp = initial_gain_temp + (
@@ -354,7 +377,7 @@ def _jet_refine_impl(
                 jnp.int32(i), k, max_block_weights,
                 jnp.float32(gain_temp), jnp.float32(fruitless_threshold),
                 seed, jnp.int32(rnd),
-                jnp.int32(min(chunk, max_iterations - i)), total_w,
+                jnp.int32(min(chunk, max_iterations - i)), wdeg,
                 max_fruitless, balancer_rounds,
             )
             i += chunk
@@ -365,13 +388,13 @@ def _jet_refine_impl(
                 # the in-loop snapshots lag one iteration; before giving
                 # up, evaluate the (uncounted) final state — if it just
                 # improved the best cut, the plateau was illusory and
-                # the round keeps going
+                # the round keeps going (when iterations remain)
                 prev_best = int(best_cut)
                 best, best_cut = _jet_round_close(
                     graph, part, best, best_cut, k, max_block_weights
                 )
                 closed = True
-                if int(best_cut) < prev_best:
+                if int(best_cut) < prev_best and i < max_iterations:
                     fruitless = jnp.int32(0)
                     closed = False
                     continue

@@ -404,8 +404,52 @@ def lp_cluster(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "k", "num_iterations"))
+@partial(jax.jit, static_argnames=("cfg",))
+def _lp_refine_round_launch(graph, part, bw, max_block_weights, active,
+                            salt, cfg: LPConfig):
+    return lp_round(graph, part, bw, max_block_weights, active, salt, cfg)
+
+
 def lp_refine(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    seed: jax.Array,
+    cfg: LPConfig = LPConfig(refinement=True),
+    num_iterations: int | None = None,
+) -> jax.Array:
+    """LP refinement entry point.  Above MAX_FUSED_EDGE_SLOTS a
+    multi-round fused launch runs for minutes and reproducibly kills the
+    TPU worker (same failure mode Jet's chunking guards against), so
+    huge graphs run one round per launch — keeping the fused path's
+    active set and moved==0 convergence exit across launches."""
+    from .segments import MAX_FUSED_EDGE_SLOTS
+
+    iters = num_iterations if num_iterations is not None else cfg.num_iterations
+    if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
+        part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
+        bw = jax.ops.segment_sum(
+            graph.node_w.astype(ACC_DTYPE), part, num_segments=k
+        ).astype(jnp.int32)
+        active = jnp.ones(graph.n_pad, dtype=bool)
+        for i in range(iters):
+            salt = (
+                jnp.asarray(seed, jnp.int32) * 92821 + i * 1566083941
+            ) & 0x7FFFFFFF
+            part, bw, active, moved = _lp_refine_round_launch(
+                graph, part, bw, max_block_weights, active, salt, cfg
+            )
+            if int(moved) == 0:
+                break
+        return part
+    return _lp_refine_fused(
+        graph, partition, k, max_block_weights, seed, cfg, iters
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "k", "num_iterations"))
+def _lp_refine_fused(
     graph: DeviceGraph,
     partition: jax.Array,
     k: int,

@@ -35,6 +35,12 @@ INT32_MIN = jnp.iinfo(jnp.int32).min
 # graphs whose total edge weight exceeds 2^31 need the (future) 64-bit build.
 ACC_DTYPE = jnp.int32
 
+# A single fused device launch that runs for many minutes reproducibly
+# kills the TPU worker (observed at 33M edges with a fully fused Jet
+# round and at 128M with 4-iteration chunks); refiners split their
+# multi-round launches above this many edge slots.
+MAX_FUSED_EDGE_SLOTS = 1 << 26
+
 
 def pad_k_bucket(k, max_block_weights, min_block_weights=None):
     """Round k up to a power of two with zero-capacity phantom blocks.
