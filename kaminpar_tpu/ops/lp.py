@@ -434,9 +434,10 @@ def lp_refine(
         ).astype(jnp.int32)
         active = jnp.ones(graph.n_pad, dtype=bool)
         for i in range(iters):
-            salt = (
-                jnp.asarray(seed, jnp.int32) * 92821 + i * 1566083941
-            ) & 0x7FFFFFFF
+            # keep the python-side constant inside int32 before it mixes
+            # with the traced seed (a >2^31 python int fails arg parsing)
+            off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
+            salt = (jnp.asarray(seed, jnp.int32) * 92821 + off) & 0x7FFFFFFF
             part, bw, active, moved = _lp_refine_round_launch(
                 graph, part, bw, max_block_weights, active, salt, cfg
             )
