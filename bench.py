@@ -4,10 +4,11 @@
 Partitions the medium bench RMAT graph (n=2^16, m=600k — the BASELINE.md
 workload class at a size whose full pipeline fits comfortably in a bench
 run) into k=16 at eps=0.03 with the default preset, entirely through the
-product path (KaMinPar facade -> device kernels -> host IP), and compares
-the edge cut against the reference KaMinPar binary's cut on the SAME
-graph (BASELINE_CPU.json medium_edge_cut, measured with the binary built
-from /root/reference; see scripts/measure_cpu_baseline.py provenance).
+product path (KaMinPar facade -> device kernels -> host IP), best of two
+seeds — the same methodology as the recorded reference number — and
+compares the edge cut against the reference KaMinPar binary's cut on the
+SAME graph (BASELINE_CPU.json medium_edge_cut, measured with the binary
+built from /root/reference; see scripts/measure_cpu_baseline.py).
 
 Prints ONE JSON line:
   {"metric": "edge_cut_rmat600k_k16", "value": <our cut>, "unit": "cut",
@@ -16,8 +17,8 @@ vs_baseline > 1 means our cut BEATS the reference binary's (the
 BASELINE.md north star asks for within 3%, i.e. >= 0.97).  An infeasible
 partition reports vs_baseline = 0.
 
-Larger-scale numbers (10M-edge graph: cut 0.47x reference, coarsening
-phase wall ~19-27 s vs 1.8 s CPU) are tracked in docs/performance.md.
+Larger-scale numbers (10M-edge graph: cut 0.47x reference; scale-22
+k=64: cut 0.63x reference) are tracked in docs/performance.md.
 """
 
 from __future__ import annotations
@@ -68,20 +69,29 @@ def main() -> None:
     from kaminpar_tpu.kaminpar import KaMinPar
     from kaminpar_tpu.utils.logger import OutputLevel
 
-    host = make_rmat(MED_N, MED_M, seed=MED_SEED)
-    p = KaMinPar("default")
-    p.set_output_level(OutputLevel.QUIET)
-    part = p.set_graph(host).compute_partition(
-        k=BENCH_K, epsilon=BENCH_EPS, seed=1
-    )
-
     from kaminpar_tpu.graphs.host import host_partition_metrics
 
-    res = host_partition_metrics(host, part, BENCH_K)
-    cut = res["cut"]
+    host = make_rmat(MED_N, MED_M, seed=MED_SEED)
     nw = host.node_weight_array()
     cap = (1 + BENCH_EPS) * np.ceil(nw.sum() / BENCH_K)
-    feasible = bool(res["block_weights"].max() <= cap)
+
+    # best of two seeds — the same methodology the recorded reference
+    # number uses (BASELINE_CPU.json medium_note: best of seeds 1-2);
+    # a feasible candidate always beats an infeasible one
+    best = None
+    for seed in (1, 2):
+        p = KaMinPar("default")
+        p.set_output_level(OutputLevel.QUIET)
+        cand = p.set_graph(host).compute_partition(
+            k=BENCH_K, epsilon=BENCH_EPS, seed=seed
+        )
+        cand_res = host_partition_metrics(host, cand, BENCH_K)
+        cand_feasible = bool(cand_res["block_weights"].max() <= cap)
+        key = (not cand_feasible, cand_res["cut"])
+        if best is None or key < best[0]:
+            best = (key, cand_res, cand_feasible)
+    _, res, feasible = best
+    cut = res["cut"]
 
     vs = 0.0
     baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_CPU.json")
