@@ -41,7 +41,7 @@ Post-passes mirroring the reference:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 from typing import Tuple
 
@@ -427,6 +427,11 @@ def lp_refine(
     from .segments import MAX_FUSED_EDGE_SLOTS
 
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
+    if not cfg.refinement:
+        # normalize once for BOTH launch strategies so the chunked path
+        # never runs with clustering semantics (tie moves, no positive-gain
+        # restriction); replace() preserves the caller's engine settings
+        cfg = replace(cfg, allow_tie_moves=False, refinement=True)
     if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
         part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
         bw = jax.ops.segment_sum(
@@ -434,8 +439,10 @@ def lp_refine(
         ).astype(jnp.int32)
         active = jnp.ones(graph.n_pad, dtype=bool)
         for i in range(iters):
-            # keep the python-side constant inside int32 before it mixes
-            # with the traced seed (a >2^31 python int fails arg parsing)
+            # equivalent to the fused while_loop's traced int32-wraparound
+            # `i * 1566083941`: the final & 0x7FFFFFFF drops bit 31, and
+            # bit 31 of an addend cannot reach lower sum bits — so masking
+            # the python product to 31 bits visits identical states
             off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
             salt = (jnp.asarray(seed, jnp.int32) * 92821 + off) & 0x7FFFFFFF
             part, bw, active, moved = _lp_refine_round_launch(
@@ -465,13 +472,7 @@ def _lp_refine_fused(
     the per-block max weights.  Returns the refined partition."""
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     if not cfg.refinement:
-        cfg = LPConfig(
-            num_iterations=cfg.num_iterations,
-            participation=cfg.participation,
-            allow_tie_moves=False,
-            use_active_set=cfg.use_active_set,
-            refinement=True,
-        )
+        cfg = replace(cfg, allow_tie_moves=False, refinement=True)
     n_pad = graph.n_pad
     part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
     bw0 = jax.ops.segment_sum(
