@@ -78,12 +78,27 @@ def main() -> None:
     # best of two seeds — the same methodology the recorded reference
     # number uses (BASELINE_CPU.json medium_note: best of seeds 1-2);
     # a feasible candidate always beats an infeasible one
+    import time
+
+    from kaminpar_tpu.utils import timer
+
     best = None
+    coarsening_times = []
+    total_times = []
     for seed in (1, 2):
         p = KaMinPar("default")
         p.set_output_level(OutputLevel.QUIET)
+        t0 = time.perf_counter()
         cand = p.set_graph(host).compute_partition(
             k=BENCH_K, epsilon=BENCH_EPS, seed=seed
+        )
+        total_times.append(time.perf_counter() - t0)  # returns synced numpy
+        # LP clustering + contraction wall-clock of this run, from the
+        # hierarchical timer (compute_partition resets it; the coarsener
+        # forces a scalar readback inside each lp scope, so attribution
+        # is honest on the async remote backend)
+        coarsening_times.append(
+            timer.GLOBAL_TIMER.elapsed("partitioning", "coarsening")
         )
         cand_res = host_partition_metrics(host, cand, BENCH_K)
         cand_feasible = bool(cand_res["block_weights"].max() <= cap)
@@ -92,25 +107,38 @@ def main() -> None:
             best = (key, cand_res, cand_feasible)
     _, res, feasible = best
     cut = res["cut"]
+    # times are min-over-seeds (steady state): the first seed's run may
+    # include remote XLA compiles / cache loads, and the CPU denominator
+    # is likewise the binary's fastest run
+    coarsening_s = min(coarsening_times)
+    total_s = min(total_times)
 
     vs = 0.0
+    vs_cpu = None
     baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_CPU.json")
-    if feasible and os.path.exists(baseline_path):
+    if os.path.exists(baseline_path):
         with open(baseline_path) as f:
-            ref = json.load(f).get("medium_edge_cut")
-        if ref:
+            base = json.load(f)
+        ref = base.get("medium_edge_cut")
+        if feasible and ref:
             vs = ref / max(cut, 1)
+        cpu_coarsening = base.get("medium_coarsening_s")
+        if cpu_coarsening and coarsening_s > 0.01:
+            # >1 means the TPU coarsening phase is FASTER than the
+            # reference binary's (8-thread) coarsening on the same graph
+            vs_cpu = round(cpu_coarsening / coarsening_s, 3)
 
-    print(
-        json.dumps(
-            {
-                "metric": "edge_cut_rmat600k_k16",
-                "value": cut,
-                "unit": "cut",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    line = {
+        "metric": "edge_cut_rmat600k_k16",
+        "value": cut,
+        "unit": "cut",
+        "vs_baseline": round(vs, 3),
+        "lp_coarsening_seconds": round(coarsening_s, 2),
+        "total_seconds": round(total_s, 2),
+    }
+    if vs_cpu is not None:
+        line["vs_cpu_coarsening"] = vs_cpu
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -86,6 +86,28 @@ class DeviceGraph:
         return jnp.sum(self.edge_w.astype(ACC_DTYPE))
 
 
+def shape_floors() -> tuple[int, int]:
+    """(n_floor, m_floor) shape-bucket floors for device graphs.
+
+    On the remote TPU backend every distinct shape bucket costs a multi-
+    minute XLA compile through the tunnel, and a limping coarsening tail
+    (n shrinking ~10% per level) otherwise mints a fresh m_pad bucket per
+    level — observed as 30-80 s of compiles for graphs of a few thousand
+    nodes.  Padding every small level into ONE floor bucket trades ~0.2 s
+    of extra warm work per call for ~a minute of compile per avoided
+    bucket.  CPU (tests, fallback) keeps small floors so tiny unit-test
+    graphs stay tiny."""
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend == "cpu":
+        return 256, 256
+    return 1 << 13, 1 << 20
+
+
 def device_graph_from_host(
     graph: HostGraph,
     n_pad: Optional[int] = None,
@@ -94,8 +116,9 @@ def device_graph_from_host(
 ) -> DeviceGraph:
     """Upload a HostGraph into the padded device layout."""
     n, m = graph.n, graph.m
-    n_pad = n_pad if n_pad is not None else pad_size(n + 1)
-    m_pad = m_pad if m_pad is not None else pad_size(max(m, 1))
+    n_floor, m_floor = shape_floors()
+    n_pad = n_pad if n_pad is not None else pad_size(n + 1, n_floor)
+    m_pad = m_pad if m_pad is not None else pad_size(max(m, 1), m_floor)
     if n_pad < n + 1 or m_pad < m:
         raise ValueError("pad sizes too small")
 

@@ -186,9 +186,12 @@ def contract_clustering(
     cmap, c_n, c_node_w, cu_g, cv_g, w_g, group_valid, c_m = _contract_part1(
         graph, labels
     )
+    from ..graphs.csr import shape_floors
+
     c_n_i, c_m_i = int(c_n), int(c_m)
-    n_pad_c = pad_size(c_n_i + 1)
-    m_pad_c = pad_size(max(c_m_i, 1))
+    n_floor, m_floor = shape_floors()
+    n_pad_c = pad_size(c_n_i + 1, n_floor)
+    m_pad_c = pad_size(max(c_m_i, 1), m_floor)
     coarse, cmap_final = _contract_part2(
         n_pad_c, m_pad_c, cmap, c_n, c_node_w, cu_g, cv_g, w_g, group_valid, c_m
     )

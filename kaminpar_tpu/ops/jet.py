@@ -42,6 +42,7 @@ from .segments import (
     MAX_FUSED_EDGE_SLOTS,
     best_from_dense,
     dense_block_ratings,
+    packed_afterburner_gain,
 )
 
 
@@ -97,72 +98,12 @@ def _jet_iteration(
     next_part = jnp.where(candidate, best, part)
 
     # ---- filter: afterburner (jet_refiner.cc:133-170) ----
-    # neighbor ordering: v counts as moved iff v is a candidate and
-    # (gain_v, -v) orders strictly before (gain_u, -u).
-    #
-    # Irregular gathers are charged per index on TPU, and this filter
-    # needs gain/next/part for BOTH endpoints of every edge — six
-    # edge-wide gathers, the dominant cost of a Jet iteration.  Pack the
-    # three per-node values into ONE int32 so each endpoint costs a
-    # single gather.  The gain field is clipped to the remaining bits:
-    # it only drives the heuristic who-moves-first ordering (the cut
-    # accounting below uses exact weights), so lost precision at extreme
-    # gains merely reorders ties — acceptable for a filter.
-    u = graph.src
-    v = graph.dst
-    label_bits = max((k - 1).bit_length(), 1)
-    gain_bits = 31 - 2 * label_bits
-    if gain_bits >= 15:
-        half = jnp.int32(1 << (gain_bits - 1))
-        gain_clip = jnp.clip(gain, 1 - half, half - 1) + half  # >= 1
-        gain_field = jnp.where(candidate, gain_clip, 0)  # 0 = not a cand
-        meta = (
-            (gain_field << (2 * label_bits))
-            | (next_part << label_bits)
-            | part
-        )
-        mu = meta[u]
-        mv = meta[v]
-        lab_mask = jnp.int32((1 << label_bits) - 1)
-        gain_u = mu >> (2 * label_bits)
-        gain_v = mv >> (2 * label_bits)
-        v_is_cand = gain_v > 0
-        v_before_u = v_is_cand & (
-            (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
-        )
-        block_v = jnp.where(
-            v_before_u, (mv >> label_bits) & lab_mask, mv & lab_mask
-        )
-        to_u = (mu >> label_bits) & lab_mask
-        from_u = mu & lab_mask
-        u_is_cand = gain_u > 0
-    else:  # huge k: not enough bits, fall back to separate gathers
-        gain_full = jnp.where(candidate, gain, INT32_MIN)
-        gain_u = gain_full[u]
-        gain_v = gain_full[v]
-        v_is_cand = gain_v > INT32_MIN
-        v_before_u = v_is_cand & (
-            (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
-        )
-        block_v = jnp.where(v_before_u, next_part[v], part[v])
-        to_u = next_part[u]
-        from_u = part[u]
-        u_is_cand = gain_u > INT32_MIN
-    contrib = jnp.where(
-        to_u == block_v,
-        graph.edge_w,
-        jnp.where(from_u == block_v, -graph.edge_w, 0),
+    # packed metadata + streaming row sums; see
+    # segments.packed_afterburner_gain (shared with LP refinement)
+    adj_gain = packed_afterburner_gain(
+        graph.src, graph.dst, graph.edge_w, graph.row_ptr,
+        part, next_part, gain, candidate, k,
     )
-    # per-node sum of contrib: src is CSR-sorted, so a streaming cumsum
-    # + row-boundary diff replaces the edge-wide scatter (segment_sum),
-    # the costliest op left in the iteration.  Row spans come straight
-    # from row_ptr; rows beyond n are empty (row_ptr[i] = m there).
-    csum = jnp.cumsum(
-        jnp.where(u_is_cand, contrib, 0).astype(ACC_DTYPE)
-    )
-    csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
-    row_ptr = jnp.clip(graph.row_ptr, 0, contrib.shape[0])
-    adj_gain = csum0[row_ptr[1:]] - csum0[row_ptr[:-1]]
     accept = candidate & (adj_gain > 0)
 
     # ---- execute (jet_refiner.cc:172-183) ----
@@ -436,16 +377,19 @@ def jet_refine(
             ctx.initial_gain_temp_on_fine_level,
             ctx.final_gain_temp_on_fine_level,
         )
-    # auto iteration budget: coarse levels are cheap (small m) and set up
-    # the solution structure — give them the full budget; fine-level
-    # iterations each cost several edge-wide passes (~5s at 16M edges on
-    # v5e), and most of the cut gain arrives early: on the medium RMAT
-    # bench 8 fine iters matches 16 within ±0.1% cut at half the cost
-    # (and 32 was measurably worse than 16)
+    # auto iteration budget: an iteration costs ~105 ns per edge SLOT on
+    # v5e regardless of level (profiled at 0.26M..33M slots), and coarse
+    # RMAT levels keep millions of edges — a 64-iteration coarse budget
+    # was the single largest cost of the whole pipeline (~75 s per coarse
+    # level at 4M slots).  Most of the cut gain arrives early: on the
+    # medium RMAT bench 8 fine iters matches 16 within ±0.1% cut at half
+    # the cost (and 32 was measurably worse than 16); coarse levels get
+    # 16 — double the fine budget (they set up the solution structure)
+    # at a quarter of the old one.
     if ctx.num_iterations > 0:
         max_iterations = ctx.num_iterations
     else:
-        max_iterations = 64 if is_coarse else 8
+        max_iterations = 16 if is_coarse else 8
     max_fruitless = (
         ctx.num_fruitless_iterations
         if ctx.num_fruitless_iterations > 0

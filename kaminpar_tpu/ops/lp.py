@@ -62,8 +62,9 @@ from .segments import (
     connection_to_label,
     connection_to_own_label,
     dense_block_ratings,
-    afterburner_filter,
     hash_u32,
+    neighbor_any_true,
+    packed_afterburner_gain,
     hashed_rating_table,
     rating_top3_by_sort,
 )
@@ -273,13 +274,15 @@ def lp_round(
         # without it bulk-sync LP refinement can DOUBLE the cut.
         # `wants` is deliberately NOT masked: filtered/unsampled nodes
         # must stay in the convergence count and the active set.
-        gain_full = jnp.where(target >= 0, gain, INT32_MIN)
-        adj_gain = afterburner_filter(
-            graph.src, graph.dst, graph.edge_w,
-            labels[graph.src], labels[graph.dst],
-            gain_full, target, graph.src, n_pad,
+        # Packed metadata keeps this at TWO edge-wide gathers (the naive
+        # per-endpoint gathers were ~10x a Jet iteration at equal shape).
+        candidate = target >= 0
+        next_lab = jnp.where(candidate, target, labels)
+        adj_gain = packed_afterburner_gain(
+            graph.src, graph.dst, graph.edge_w, graph.row_ptr,
+            labels, next_lab, gain, candidate, C,
         )
-        target = jnp.where(adj_gain > 0, target, -1)
+        target = jnp.where(candidate & (adj_gain > 0), target, -1)
 
     # -- commit: never exceed the cap even under simultaneous joins ------
     headroom = jnp.maximum(cap - cluster_weights.astype(ACC_DTYPE), 0)
@@ -300,14 +303,13 @@ def lp_round(
     # the two most expensive TPU ops) — the fast engine keeps everyone
     # active and lets the num_wanting convergence test do its job
     if cfg.use_active_set and engine != "sort2":
-        moved_i32 = accept.astype(jnp.int32)
-        neigh_moved = jax.ops.segment_max(
-            moved_i32[graph.dst], graph.src, num_segments=n_pad
-        )
+        # one edge gather + streaming row sums (scatter-free; see
+        # segments.neighbor_any_true)
+        neigh_moved = neighbor_any_true(accept, graph.dst, graph.row_ptr)
         # wanting-but-unsampled (or capacity-rejected) nodes stay active;
         # otherwise the participation mask could deactivate a node that
         # still has an improving move
-        new_active = ((moved_i32 | neigh_moved) > 0) | (wants & ~accept)
+        new_active = accept | neigh_moved | (wants & ~accept)
     else:
         new_active = jnp.ones_like(active)
 

@@ -584,6 +584,109 @@ def rating_top3_by_sort(
     return tuple(out)
 
 
+def packed_afterburner_gain(
+    src: jax.Array,
+    dst: jax.Array,
+    edge_w: jax.Array,
+    row_ptr: jax.Array,
+    part: jax.Array,
+    next_part: jax.Array,
+    gain: jax.Array,
+    candidate: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Afterburner-adjusted gain per node, at TWO edge-wide gathers.
+
+    The afterburner (jet_refiner.cc:133-170) re-evaluates each move
+    candidate's gain assuming every neighbor ordering strictly before it —
+    by (gain, smaller id) — already sits at its target block.  A naive
+    implementation gathers gain/part/next_part for both endpoints of every
+    edge (six edge-wide gathers — irregular gathers are charged per index
+    on TPU and dominate the round).  Here the three per-node values are
+    packed into ONE int32 per node, so each endpoint costs a single
+    gather; the per-node contribution sum is a streaming cumsum + CSR
+    row-boundary diff (src must be CSR-sorted), not a scatter.
+
+    The gain field is clipped to `31 - 2*ceil(log2 k)` bits — it only
+    drives the heuristic who-moves-first ordering; callers account cuts
+    with exact weights.  For huge k (< 15 gain bits) the packed layout
+    runs out of room and the function falls back to separate gathers.
+
+    Returns adj_gain[n_pad]; entries for non-candidates are the plain
+    neighborhood sum with no candidate mask applied to themselves (mask
+    with `candidate` when accepting).  Shared by the Jet refiner and the
+    bulk-synchronous LP refinement round.
+    """
+    n_pad = part.shape[0]
+    u = src
+    v = dst
+    label_bits = max((k - 1).bit_length(), 1)
+    gain_bits = 31 - 2 * label_bits
+    if gain_bits >= 15:
+        half = jnp.int32(1 << (gain_bits - 1))
+        gain_clip = jnp.clip(gain, 1 - half, half - 1) + half  # >= 1
+        gain_field = jnp.where(candidate, gain_clip, 0)  # 0 = not a cand
+        meta = (
+            (gain_field << (2 * label_bits))
+            | (next_part << label_bits)
+            | part
+        )
+        mu = meta[u]
+        mv = meta[v]
+        lab_mask = jnp.int32((1 << label_bits) - 1)
+        gain_u = mu >> (2 * label_bits)
+        gain_v = mv >> (2 * label_bits)
+        v_is_cand = gain_v > 0
+        v_before_u = v_is_cand & (
+            (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
+        )
+        block_v = jnp.where(
+            v_before_u, (mv >> label_bits) & lab_mask, mv & lab_mask
+        )
+        to_u = (mu >> label_bits) & lab_mask
+        from_u = mu & lab_mask
+        u_is_cand = gain_u > 0
+    else:  # huge k: not enough bits, fall back to separate gathers
+        gain_full = jnp.where(candidate, gain, INT32_MIN)
+        gain_u = gain_full[u]
+        gain_v = gain_full[v]
+        v_is_cand = gain_v > INT32_MIN
+        v_before_u = v_is_cand & (
+            (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
+        )
+        block_v = jnp.where(v_before_u, next_part[v], part[v])
+        to_u = next_part[u]
+        from_u = part[u]
+        u_is_cand = gain_u > INT32_MIN
+    contrib = jnp.where(
+        to_u == block_v,
+        edge_w,
+        jnp.where(from_u == block_v, -edge_w, 0),
+    )
+    csum = jnp.cumsum(
+        jnp.where(u_is_cand, contrib, 0).astype(ACC_DTYPE)
+    )
+    csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
+    rp = jnp.clip(row_ptr, 0, contrib.shape[0])
+    return csum0[rp[1:]] - csum0[rp[:-1]]
+
+
+def neighbor_any_true(
+    flag: jax.Array,
+    dst: jax.Array,
+    row_ptr: jax.Array,
+) -> jax.Array:
+    """Per-node "any neighbor has `flag`", at one edge-wide gather plus
+    streaming passes (cumsum + CSR row-boundary diff) — the scatter-free
+    replacement for segment_max(flag[dst], src).  Requires the edge list
+    in CSR order (contiguous row spans), which DeviceGraph guarantees."""
+    f = flag[dst].astype(ACC_DTYPE)
+    csum = jnp.cumsum(f)
+    csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
+    rp = jnp.clip(row_ptr, 0, f.shape[0])
+    return (csum0[rp[1:]] - csum0[rp[:-1]]) > 0
+
+
 def afterburner_filter(
     src: jax.Array,
     dst: jax.Array,
