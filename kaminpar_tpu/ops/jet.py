@@ -42,8 +42,51 @@ from .segments import (
     MAX_FUSED_EDGE_SLOTS,
     best_from_dense,
     dense_block_ratings,
+    expand_active_rows,
     packed_afterburner_gain,
+    packed_afterburner_gain_rows,
 )
+
+# Below this many edge slots the incremental machinery is not worth the
+# extra programs (mirrors ops/lp.DELTA_MIN_EDGE_SLOTS).
+DELTA_MIN_EDGE_SLOTS = 1 << 22
+
+
+def _delta_slots(graph: DeviceGraph) -> int | None:
+    m_slots = graph.src.shape[0]
+    if m_slots < DELTA_MIN_EDGE_SLOTS:
+        return None
+    return m_slots // 4
+
+
+def _conn_update_rows(
+    graph: DeviceGraph,
+    conn: jax.Array,
+    part_before: jax.Array,
+    part_after: jax.Array,
+    k: int,
+    dslots: int,
+) -> jax.Array:
+    """Update the dense (n, k) connection table after a bulk move by
+    re-scattering ONLY the changed nodes' rows: for each edge (u, v) with
+    u moved a->b, conn[v, a] -= w and conn[v, b] += w.  Exact integer
+    arithmetic — the table stays bitwise equal to a full rebuild."""
+    n_pad = graph.n_pad
+    changed = part_before != part_after
+    owner_c, owner_key, edge_id, valid, start, end = expand_active_rows(
+        graph.row_ptr, graph.degrees, changed, dslots
+    )
+    eid = jnp.clip(edge_id, 0, graph.src.shape[0] - 1)
+    dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
+    w_b = jnp.where(valid, graph.edge_w[eid], 0).astype(ACC_DTYPE)
+    old_b = part_before[owner_c]
+    new_b = part_after[owner_c]
+    flat_old = dst_b * k + jnp.clip(old_b, 0, k - 1)
+    flat_new = dst_b * k + jnp.clip(new_b, 0, k - 1)
+    flat_conn = conn.reshape(-1)
+    flat_conn = flat_conn.at[flat_old].add(-w_b, mode="drop")
+    flat_conn = flat_conn.at[flat_new].add(w_b, mode="drop")
+    return flat_conn.reshape(n_pad, k)
 
 
 def _jet_iteration(
@@ -56,27 +99,36 @@ def _jet_iteration(
     salt: jax.Array,
     balancer_rounds: int,
     wdeg: jax.Array | None = None,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One Jet move round.  Returns (new_part, new_lock, ext_sum) where
-    ext_sum = sum over real nodes of (weighted degree - connection to own
-    block) in the INPUT partition — the rating table gives the input
-    partition's edge cut for free as ext_sum / 2, saving the driver a
-    separate edge-wide cut pass per iteration.  ext_sum = 2*cut stays in
-    int32 exactly when edge_cut itself would (unlike a total-edge-weight
-    sum, which overflows first on heavy graphs).  `wdeg` is the static
-    per-node weighted degree; when None, ext_sum is returned as 0 (the
-    caller does not use it)."""
+    conn: jax.Array | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One Jet move round.  Returns (new_part, new_lock, ext_sum,
+    new_conn) where ext_sum = sum over real nodes of (weighted degree -
+    connection to own block) in the INPUT partition — the rating table
+    gives the input partition's edge cut for free as ext_sum / 2, saving
+    the driver a separate edge-wide cut pass per iteration.  ext_sum =
+    2*cut stays in int32 exactly when edge_cut itself would (unlike a
+    total-edge-weight sum, which overflows first on heavy graphs).
+    `wdeg` is the static per-node weighted degree; when None, ext_sum is
+    returned as 0 (the caller does not use it).
+
+    `conn` is the incrementally-maintained dense (n, k) connection table
+    for the INPUT partition (the gain cache Jet's paper assumes).  When
+    None it is built from scratch; the returned new_conn matches the
+    OUTPUT partition bitwise either way (changed rows re-scattered, or a
+    full rebuild when too many nodes moved — lax.cond picks)."""
     n_pad = graph.n_pad
     node_ids = jnp.arange(n_pad, dtype=jnp.int32)
     is_real = node_ids < graph.n
+    dslots = _delta_slots(graph)
 
     # ---- find moves (jet_refiner.cc:104-131) ----
     # dense (n, k) rating table: one segment_sum, no edge-list sort (the
     # gain-cache strategy Jet's paper assumes; caps checked by the
     # balancer, so require_fit=False like the reference's candidate step)
-    conn = dense_block_ratings(
-        graph.src, graph.dst, graph.edge_w, part, n_pad, k
-    )
+    if conn is None:
+        conn = dense_block_ratings(
+            graph.src, graph.dst, graph.edge_w, part, n_pad, k
+        )
     best, best_conn, conn_own = best_from_dense(
         conn, part, jnp.zeros((k,), ACC_DTYPE), graph.node_w,
         jnp.zeros((k,), ACC_DTYPE), salt, require_fit=False,
@@ -99,11 +151,43 @@ def _jet_iteration(
 
     # ---- filter: afterburner (jet_refiner.cc:133-170) ----
     # packed metadata + streaming row sums; see
-    # segments.packed_afterburner_gain (shared with LP refinement)
-    adj_gain = packed_afterburner_gain(
-        graph.src, graph.dst, graph.edge_w, graph.row_ptr,
-        part, next_part, gain, candidate, k,
-    )
+    # segments.packed_afterburner_gain (shared with LP refinement).
+    # Only edges of CANDIDATE rows contribute to the filter, so when the
+    # candidate set is small its rows are compacted into the delta buffer
+    # and the filter's two edge-wide gathers shrink to buffer width.
+    def _ab_full(args):
+        part_, next_, gain_, cand_ = args
+        return packed_afterburner_gain(
+            graph.src, graph.dst, graph.edge_w, graph.row_ptr,
+            part_, next_, gain_, cand_, k,
+        )
+
+    if dslots is None:
+        adj_gain = _ab_full((part, next_part, gain, candidate))
+    else:
+
+        def _ab_rows(args):
+            part_, next_, gain_, cand_ = args
+            owner_c, _, edge_id, valid, start, end = expand_active_rows(
+                graph.row_ptr, graph.degrees, cand_, dslots
+            )
+            eid = jnp.clip(edge_id, 0, graph.src.shape[0] - 1)
+            dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
+            w_b = jnp.where(valid, graph.edge_w[eid], 0)
+            return packed_afterburner_gain_rows(
+                owner_c, dst_b, w_b, start, end,
+                part_, next_, gain_, cand_, k,
+            )
+
+        cand_edges = jnp.sum(
+            jnp.where(candidate, graph.degrees, 0).astype(jnp.int32)
+        )
+        adj_gain = lax.cond(
+            cand_edges <= dslots,
+            _ab_rows,
+            _ab_full,
+            (part, next_part, gain, candidate),
+        )
     accept = candidate & (adj_gain > 0)
 
     # ---- execute (jet_refiner.cc:172-183) ----
@@ -139,7 +223,27 @@ def _jet_iteration(
         bal_body,
         (jnp.int32(0), new_part, jnp.int32(1), _overload(new_part)),
     )
-    return new_part, new_lock, ext_sum
+
+    # ---- maintain the rating table for the next iteration ----
+    # moves AND balancer corrections are both captured by part vs
+    # new_part; when few nodes changed, re-scatter only their rows
+    if dslots is None:
+        new_conn = dense_block_ratings(
+            graph.src, graph.dst, graph.edge_w, new_part, n_pad, k
+        )
+    else:
+        changed_edges = jnp.sum(
+            jnp.where(part != new_part, graph.degrees, 0).astype(jnp.int32)
+        )
+        new_conn = lax.cond(
+            changed_edges <= dslots,
+            lambda args: _conn_update_rows(graph, *args, k, dslots),
+            lambda args: dense_block_ratings(
+                graph.src, graph.dst, graph.edge_w, args[2], n_pad, k
+            ),
+            (conn, part, new_part),
+        )
+    return new_part, new_lock, ext_sum, new_conn
 
 
 @partial(
@@ -153,6 +257,7 @@ def _jet_chunk(
     best: jax.Array,
     best_cut: jax.Array,
     fruitless: jax.Array,
+    conn: jax.Array,
     i0: jax.Array,
     k: int,
     max_block_weights: jax.Array,
@@ -180,18 +285,18 @@ def _jet_chunk(
         return jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
 
     def iter_cond(state):
-        j, fruitless, part, lock, best, best_cut = state
+        j, fruitless, part, lock, best, best_cut, conn = state
         # `limit` is traced, so a short remainder chunk reuses the same
         # compiled program instead of triggering a second trace
         return (j < limit) & (fruitless < max_fruitless)
 
     def iter_body(state):
-        j, fruitless, part, lock, best, best_cut = state
+        j, fruitless, part, lock, best, best_cut, conn = state
         i = i0 + j
         salt = (
             seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
         ) & 0x7FFFFFFF
-        new_part, lock, ext_sum = _jet_iteration(
+        new_part, lock, ext_sum, conn = _jet_iteration(
             graph,
             part,
             lock,
@@ -201,6 +306,7 @@ def _jet_chunk(
             salt,
             balancer_rounds,
             wdeg=wdeg,
+            conn=conn,
         )
         # snapshot the state ENTERING this iteration (its cut falls out
         # of the rating); the state leaving the round's final iteration
@@ -222,14 +328,14 @@ def _jet_chunk(
         is_best = (cut <= best_cut) & is_feasible(part)
         best = jnp.where(is_best, part, best)
         best_cut = jnp.where(is_best, cut, best_cut)
-        return (j + 1, fruitless, new_part, lock, best, best_cut)
+        return (j + 1, fruitless, new_part, lock, best, best_cut, conn)
 
-    _, fruitless, part, lock, best, best_cut = lax.while_loop(
+    _, fruitless, part, lock, best, best_cut, conn = lax.while_loop(
         iter_cond,
         iter_body,
-        (jnp.int32(0), fruitless, part, lock, best, best_cut),
+        (jnp.int32(0), fruitless, part, lock, best, best_cut, conn),
     )
-    return part, lock, best, best_cut, fruitless
+    return part, lock, best, best_cut, fruitless, conn
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -250,6 +356,16 @@ def _jet_round_close(
     return (
         jnp.where(is_best, part, best),
         jnp.where(is_best, cut, best_cut),
+    )
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _jet_build_conn(graph: DeviceGraph, part: jax.Array, k: int):
+    """Fresh dense rating table — run once per Jet round (the in-round
+    table is maintained incrementally; the round-end rollback to `best`
+    invalidates it)."""
+    return dense_block_ratings(
+        graph.src, graph.dst, graph.edge_w, part, graph.n_pad, k
     )
 
 
@@ -310,11 +426,12 @@ def _jet_refine_impl(
             gain_temp = initial_gain_temp
         lock = jnp.zeros(graph.n_pad, dtype=jnp.int32)
         fruitless = jnp.int32(0)
+        conn = _jet_build_conn(graph, part, k)
         i = 0
         closed = False
         while i < max_iterations:
-            part, lock, best, best_cut, fruitless = _jet_chunk(
-                graph, part, lock, best, best_cut, fruitless,
+            part, lock, best, best_cut, fruitless, conn = _jet_chunk(
+                graph, part, lock, best, best_cut, fruitless, conn,
                 jnp.int32(i), k, max_block_weights,
                 jnp.float32(gain_temp), jnp.float32(fruitless_threshold),
                 seed, jnp.int32(rnd),

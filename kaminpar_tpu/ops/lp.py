@@ -61,12 +61,16 @@ from .segments import (
     best_from_rating_table,
     connection_to_label,
     connection_to_own_label,
+    connection_to_own_rows,
     dense_block_ratings,
+    expand_active_rows,
     hash_u32,
+    hashed_rating_table,
     neighbor_any_true,
     packed_afterburner_gain,
-    hashed_rating_table,
+    packed_afterburner_gain_rows,
     rating_top3_by_sort,
+    rating_topk_rows,
 )
 
 
@@ -88,18 +92,13 @@ class LPConfig:
     # (LocalLPClusterer analog, kaminpar-dist/.../local_lp_clusterer.cc —
     # no cross-PE clusters, so contraction needs no label migration)
     dist_local_only: bool = False
-    # rating engine: "auto" picks dense (labels = k blocks) > hash (big
-    # edge lists, hashed slots, no sort) > sort (exact aggregate_by_key);
-    # see ops/segments.py "Sort-free rating engines"
+    # rating engine: "auto" picks dense (labels = k blocks, exact (n, k)
+    # table) or sort2 rows (everything else); "hash"/"sort" remain as
+    # forced options — see ops/segments.py "Sort-free rating engines"
     rating: str = "auto"
     num_slots: int = 32  # hashed engine slots per node
-    # m_pad at which "auto" switches sort -> sort2/hash
-    hash_threshold: int = 1 << 21
     # sort2: how many top clusters to read per node (n-sized reads, cheap)
     topk: int = 6
-    # sort2: below this m_pad, compute the own-cluster connection exactly
-    # with one edge-wide pass instead of the top-K bound
-    exact_wcur_threshold: int = 1 << 23
 
 
 def _select_engine(
@@ -108,30 +107,40 @@ def _select_engine(
     m_pad: int,
     has_communities: bool = False,
 ) -> str:
-    """Static (trace-time) rating engine choice.  sort2 (the fastest
-    clustering engine — one edge gather + two sorts, no scatters) does not
-    support the v-cycle community restriction, so community-constrained
-    clustering falls back to the hashed engine."""
+    """Static (trace-time) rating engine choice.
+
+    "auto" now always picks the row-based engines: dense (labels = k
+    blocks, exact (n, k) table) for refinement-sized label spaces, sort2
+    rows everywhere else.  Since sort2 gained the EXACT own-connection
+    (streaming masked cumsum over CSR row spans — no estimate, no extra
+    sort) and community filtering at the node-level select, the hashed
+    engine's old advantages on dense coarse levels are gone; hash/sort
+    remain as forced options for comparison runs."""
     if cfg.rating != "auto":
-        if cfg.rating == "sort2" and has_communities:
-            raise ValueError(
-                "rating='sort2' cannot enforce the community restriction; "
-                "use 'hash' or 'sort' (or rating='auto')"
-            )
         return cfg.rating
     if num_clusters <= 256:
         return "dense"
-    if m_pad >= cfg.hash_threshold:
-        if has_communities:
-            return "hash"
-        # sort2 sees only the top-K clusters per node: ideal on sparse
-        # fine levels (few adjacent clusters), blind on dense coarse
-        # levels where nodes border hundreds of clusters — there the
-        # hashed slot table (num_slots candidates + exact own-connection)
-        # keeps LP converging
-        avg_degree = m_pad / max(num_clusters, 1)
-        return "sort2" if avg_degree <= 32 else "hash"
-    return "sort"
+    return "sort2"
+
+
+# Below this many edge slots a graph's full round is cheap enough that the
+# delta machinery (extra programs, an n-wide scatter per round) is not
+# worth compiling; shape-bucket floors put small levels at 2^20 anyway.
+DELTA_MIN_EDGE_SLOTS = 1 << 22
+
+
+def _delta_slots(graph: DeviceGraph, cfg: LPConfig, engine: str) -> int | None:
+    """Static delta-round buffer size, or None when delta rounds are off.
+    m_pad/4 covers active-edge fractions up to 25% at ~40% of a full
+    round's cost (the crossover measured on v5e)."""
+    if not cfg.use_active_set:
+        return None
+    if engine not in ("sort2", "dense"):
+        return None
+    m_slots = graph.src.shape[0]
+    if m_slots < DELTA_MIN_EDGE_SLOTS:
+        return None
+    return m_slots // 4
 
 
 def lp_round(
@@ -143,6 +152,7 @@ def lp_round(
     salt: jax.Array,
     cfg: LPConfig,
     communities: jax.Array | None = None,
+    rows=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One bulk-synchronous LP round.
 
@@ -153,52 +163,73 @@ def lp_round(
       max_cluster_weight:i32 scalar or i32[C] per-cluster cap
       active:            bool[n_pad] active set
       salt:              i32 per-round randomness salt
+      rows:              optional expand_active_rows(...) result — a delta
+                         round: only the active nodes' rows are rated, and
+                         every edge-wide pass shrinks to the row buffer
+                         (sort2/dense engines only)
 
     Returns (new_labels, new_cluster_weights, new_active, num_moved).
     """
     n_pad = graph.n_pad
+    m_slots = graph.src.shape[0]
     C = cluster_weights.shape[0]
     cap = jnp.broadcast_to(max_cluster_weight, (C,))
     engine = _select_engine(cfg, C, graph.m_pad, communities is not None)
+    if rows is not None and engine not in ("sort2", "dense"):
+        raise ValueError(f"delta rounds are not supported by engine {engine}")
+
+    # -- shared row view: either the raw CSR edge arrays (full round; src
+    # is CSR-sorted so rows are contiguous spans) or the compacted active-
+    # row buffer (delta round)
+    if engine in ("sort2", "dense"):
+        if rows is not None:
+            owner_c, owner_key, edge_id, valid, start, end = rows
+            eid = jnp.clip(edge_id, 0, m_slots - 1)
+            dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
+            w_b = jnp.where(valid, graph.edge_w[eid], 0)
+            deg_eff = end - start
+        else:
+            owner_c = graph.src
+            owner_key = graph.src
+            dst_b = graph.dst
+            w_b = graph.edge_w
+            start = graph.row_ptr[:-1]
+            end = graph.row_ptr[1:]
+            deg_eff = graph.degrees
 
     # -- rate: per-node best non-own cluster under the weight cap, plus
-    # the exact connection to the own cluster.  Engines with one contract
-    # (see ops/segments.py "Sort-free rating engines").
-    neighbor_cluster = labels[graph.dst]
+    # the exact connection to the own cluster.
     if engine == "sort2":
-        # top-K clusters per node, then node-level own-exclusion +
-        # feasibility fallback chain
+        # top-K rated clusters per row (two buffer-wide sorts, no
+        # scatters), then node-level own-exclusion + feasibility +
+        # community fallback chain.  The own-cluster connection is EXACT:
+        # a streaming masked cumsum over the row spans (one extra gather
+        # for the owner's label), replacing the old top-K upper-bound
+        # estimate that silently under-moved on huge graphs.
         K = cfg.topk
-        topk = rating_top3_by_sort(
-            graph, neighbor_cluster, salt, k_best=K
+        nb = jnp.where(valid, labels[dst_b], -1) if rows is not None else (
+            labels[dst_b]
         )
+        own_slot = labels[owner_c]
+        topk = rating_topk_rows(owner_key, nb, w_b, end, deg_eff, salt, K)
         labs = topk[0::2]
         vals = topk[1::2]
         own = labels
-
-        # w_cur: exact when the own cluster ranks top-K or when the edge
-        # list is small enough that an exact edge-wide pass is cheap;
-        # otherwise bounded above by the K-th total (which UNDERestimates
-        # gains, i.e. errs toward fewer moves).  Dense coarse levels have
-        # small m, so they get the exact path and keep converging.
-        if graph.m_pad <= cfg.exact_wcur_threshold:
-            w_cur = connection_to_own_label(
-                graph.src, neighbor_cluster, graph.edge_w, labels, n_pad
-            )
-        else:
-            w_cur = jnp.where(
-                labs[-1] >= 0, jnp.maximum(vals[-1], 0), 0
-            )
-            for lab_j, val_j in zip(reversed(labs), reversed(vals)):
-                w_cur = jnp.where(lab_j == own, val_j, w_cur)
+        w_cur = connection_to_own_rows(nb, w_b, own_slot, start, end)
 
         def fits(lab):
             lab_c = jnp.clip(lab, 0, C - 1)
-            return (lab >= 0) & (
+            ok = (lab >= 0) & (
                 cluster_weights[lab_c].astype(ACC_DTYPE)
                 + graph.node_w.astype(ACC_DTYPE)
                 <= cap[lab_c]
             )
+            if communities is not None:
+                # v-cycle community restriction: a cluster label is a node
+                # id, so the cluster's community is the label node's
+                lab_n = jnp.clip(lab, 0, n_pad - 1)
+                ok = ok & (communities[lab_n] == communities)
+            return ok
 
         best = jnp.full(n_pad, -1, dtype=jnp.int32)
         best_w = jnp.full(n_pad, INT32_MIN, dtype=ACC_DTYPE)
@@ -207,14 +238,13 @@ def lp_round(
             best = jnp.where(ok, lab_j, best)
             best_w = jnp.where(ok, val_j, best_w)
     elif engine == "dense":
-        conn = dense_block_ratings(
-            graph.src, graph.dst, graph.edge_w, labels, n_pad, C
-        )
+        conn = dense_block_ratings(owner_c, dst_b, w_b, labels, n_pad, C)
         best, best_w, w_cur = best_from_dense(
             conn, labels, cluster_weights, graph.node_w, cap, salt,
             communities=communities,
         )
     elif engine == "hash":
+        neighbor_cluster = labels[graph.dst]
         slot_label, slot_w = hashed_rating_table(
             graph.src, neighbor_cluster, graph.edge_w, n_pad,
             cfg.num_slots, salt,
@@ -227,6 +257,7 @@ def lp_round(
             graph.src, neighbor_cluster, graph.edge_w, labels, n_pad
         )
     else:  # sort (exact enumeration of every adjacent cluster)
+        neighbor_cluster = labels[graph.dst]
         seg_g, key_g, w_g = aggregate_by_key(
             graph.src, neighbor_cluster, graph.edge_w
         )
@@ -278,10 +309,18 @@ def lp_round(
         # per-endpoint gathers were ~10x a Jet iteration at equal shape).
         candidate = target >= 0
         next_lab = jnp.where(candidate, target, labels)
-        adj_gain = packed_afterburner_gain(
-            graph.src, graph.dst, graph.edge_w, graph.row_ptr,
-            labels, next_lab, gain, candidate, C,
-        )
+        if rows is not None:
+            # candidates are active, so every candidate's full row is in
+            # the buffer — the filter shrinks to buffer width
+            adj_gain = packed_afterburner_gain_rows(
+                owner_c, dst_b, w_b, start, end,
+                labels, next_lab, gain, candidate, C,
+            )
+        else:
+            adj_gain = packed_afterburner_gain(
+                graph.src, graph.dst, graph.edge_w, graph.row_ptr,
+                labels, next_lab, gain, candidate, C,
+            )
         target = jnp.where(candidate & (adj_gain > 0), target, -1)
 
     # -- commit: never exceed the cap even under simultaneous joins ------
@@ -296,20 +335,46 @@ def lp_round(
     )
 
     # -- active set refresh (label_propagation.h:507-513): a node is active
-    # next round iff it or one of its neighbors moved this round.  In the
-    # async reference this SAVES work (inactive nodes are skipped); in a
-    # bulk-synchronous round every node is computed regardless, so the
-    # neighbor propagation is pure overhead (an edge-wide gather+scatter,
-    # the two most expensive TPU ops) — the fast engine keeps everyone
-    # active and lets the num_wanting convergence test do its job
-    if cfg.use_active_set and engine != "sort2":
-        # one edge gather + streaming row sums (scatter-free; see
-        # segments.neighbor_any_true)
-        neigh_moved = neighbor_any_true(accept, graph.dst, graph.row_ptr)
-        # wanting-but-unsampled (or capacity-rejected) nodes stay active;
-        # otherwise the participation mask could deactivate a node that
-        # still has an improving move
-        new_active = accept | neigh_moved | (wants & ~accept)
+    # next round iff it or one of its neighbors moved this round, or it
+    # wanted a move but was unsampled/capacity-rejected.  This both
+    # mirrors the reference's semantics AND feeds the delta rounds: the
+    # next round's row buffer holds exactly these nodes' rows.
+    if cfg.use_active_set:
+        if rows is not None:
+            # movers' rows are in the buffer; flag their endpoints with
+            # one buffer-wide scatter
+            moved_slot = accept[owner_c] & valid
+            neigh_moved = (
+                jnp.zeros(n_pad, dtype=jnp.int32)
+                .at[dst_b]
+                .max(moved_slot.astype(jnp.int32), mode="drop")
+                > 0
+            )
+        else:
+            # one edge gather + streaming row sums (scatter-free; see
+            # segments.neighbor_any_true)
+            neigh_moved = neighbor_any_true(accept, graph.dst, graph.row_ptr)
+        # retention: a node stays active while it still has a USABLE
+        # candidate — improving, or a positive-weight tie (clustering).
+        # Gating retention on `wants` deactivated tie-blocked nodes
+        # forever even though the hashed tie direction re-rolls every
+        # round (the salt changes), which froze coarsening into ~20
+        # limping levels on dense coarse graphs; unsampled
+        # (participation) and capacity-rejected nodes are likewise kept.
+        # `& active` keeps full and delta rounds bitwise-identical: a
+        # deactivated node is reactivated ONLY by a neighbor's move in
+        # both (a delta round never rates inactive rows, so a full round
+        # must not resurrect them from its all-rows rating either)
+        may_move_later = active & (best >= 0) & (best != labels) & (
+            (gain > 0)
+            | (
+                (not cfg.refinement)
+                & cfg.allow_tie_moves
+                & (gain == 0)
+                & (best_w > 0)
+            )
+        )
+        new_active = accept | neigh_moved | (may_move_later & ~accept)
     else:
         new_active = jnp.ones_like(active)
 
@@ -318,6 +383,54 @@ def lp_round(
     # the loop while unsampled nodes still have improving moves
     num_wanting = jnp.sum(wants.astype(jnp.int32))
     return new_labels, new_cluster_weights, new_active, num_wanting
+
+
+def _round_with_delta(
+    graph: DeviceGraph,
+    labels: jax.Array,
+    weights: jax.Array,
+    max_cluster_weight: jax.Array,
+    active: jax.Array,
+    salt: jax.Array,
+    cfg: LPConfig,
+    communities: jax.Array | None,
+    i: jax.Array,
+):
+    """One LP round, delta-dispatched: after the first round, when the
+    active nodes' rows fit the m_pad/4 buffer, run the round on the
+    compacted buffer instead of the full edge list (lax.cond — only the
+    taken branch executes).  The active set collapses to movers + their
+    neighbors after round 1, so later rounds cost O(active rows), not m —
+    the bulk-synchronous answer to the async reference's active-set
+    work-skipping (label_propagation.h:507-513)."""
+    C = weights.shape[0]
+    engine = _select_engine(cfg, C, graph.m_pad, communities is not None)
+    dslots = _delta_slots(graph, cfg, engine)
+    if dslots is None:
+        return lp_round(
+            graph, labels, weights, max_cluster_weight, active, salt, cfg,
+            communities=communities,
+        )
+    deg = graph.degrees
+
+    def delta_fn(op):
+        labels, weights, active = op
+        rows = expand_active_rows(graph.row_ptr, deg, active, dslots)
+        return lp_round(
+            graph, labels, weights, max_cluster_weight, active, salt, cfg,
+            communities=communities, rows=rows,
+        )
+
+    def full_fn(op):
+        labels, weights, active = op
+        return lp_round(
+            graph, labels, weights, max_cluster_weight, active, salt, cfg,
+            communities=communities,
+        )
+
+    total = jnp.sum(jnp.where(active & (deg > 0), deg, 0).astype(jnp.int32))
+    pred = (i > 0) & (total <= dslots)
+    return lax.cond(pred, delta_fn, full_fn, (labels, weights, active))
 
 
 @partial(jax.jit, static_argnames=("cfg", "num_iterations", "has_communities"))
@@ -344,7 +457,7 @@ def _lp_cluster_impl(
     def body(state):
         i, labels, weights, active, _ = state
         salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
-        labels, weights, active, moved = lp_round(
+        labels, weights, active, moved = _round_with_delta(
             graph,
             labels,
             weights,
@@ -352,7 +465,8 @@ def _lp_cluster_impl(
             active,
             salt,
             cfg,
-            communities=comm,
+            comm,
+            i,
         )
         return (i + 1, labels, weights, active, moved)
 
@@ -408,8 +522,10 @@ def lp_cluster(
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _lp_refine_round_launch(graph, part, bw, max_block_weights, active,
-                            salt, cfg: LPConfig):
-    return lp_round(graph, part, bw, max_block_weights, active, salt, cfg)
+                            salt, i, cfg: LPConfig):
+    return _round_with_delta(
+        graph, part, bw, max_block_weights, active, salt, cfg, None, i
+    )
 
 
 def lp_refine(
@@ -448,7 +564,8 @@ def lp_refine(
             off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
             salt = (jnp.asarray(seed, jnp.int32) * 92821 + off) & 0x7FFFFFFF
             part, bw, active, moved = _lp_refine_round_launch(
-                graph, part, bw, max_block_weights, active, salt, cfg
+                graph, part, bw, max_block_weights, active, salt,
+                jnp.int32(i), cfg
             )
             if int(moved) == 0:
                 break
@@ -489,8 +606,8 @@ def _lp_refine_fused(
     def body(state):
         i, part, bw, active, _ = state
         salt = (seed.astype(jnp.int32) * 92821 + i * 1566083941) & 0x7FFFFFFF
-        part, bw, active, moved = lp_round(
-            graph, part, bw, max_block_weights, active, salt, cfg
+        part, bw, active, moved = _round_with_delta(
+            graph, part, bw, max_block_weights, active, salt, cfg, None, i
         )
         return (i + 1, part, bw, active, moved)
 

@@ -584,6 +584,117 @@ def rating_top3_by_sort(
     return tuple(out)
 
 
+def expand_active_rows(
+    row_ptr: jax.Array,
+    degrees: jax.Array,
+    active: jax.Array,
+    num_slots: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Compact the CSR rows of active nodes into a `num_slots` buffer.
+
+    The delta-round primitive: after the first LP/Jet round only a small
+    fraction of nodes (movers + their neighbors) need re-rating, yet every
+    edge-wide op costs ~10-15 ns per SLOT regardless of how many slots
+    matter.  This lays the active nodes' rows head-to-tail into a fixed
+    small buffer so every downstream pass scales with the active-edge
+    count, not m.  O(n) streaming + one n-wide scatter + two buffer-wide
+    gathers; no edge-wide ops.
+
+    Returns (owner, owner_key, edge_id, valid, start, end):
+      owner    i32[num_slots]  owning node of each slot (undefined before
+                               the first active row — mask with `valid`)
+      owner_key i32[num_slots] owner for valid slots, n_pad for pad slots
+                               (sorts pad slots to the end, keeps spans)
+      edge_id  i32[num_slots]  index into the edge arrays (clip before use)
+      valid    bool[num_slots]
+      start/end i32[n_pad]     each ACTIVE node's row span in the buffer
+    The caller must check `total = end[-1] <= num_slots` BEFORE using the
+    result (overflowing rows are truncated, so an overflowed buffer is
+    unusable — fall back to a full round).
+    """
+    n_pad = degrees.shape[0]
+    act = active & (degrees > 0)
+    act_deg = jnp.where(act, degrees, 0).astype(jnp.int32)
+    end = jnp.cumsum(act_deg)
+    start = end - act_deg
+    node_ids = jnp.arange(n_pad, dtype=jnp.int32)
+    do = act & (start < num_slots)
+    pos = jnp.where(do, start, num_slots)
+    owner0 = (
+        jnp.full(num_slots, -1, dtype=jnp.int32)
+        .at[pos]
+        .max(jnp.where(do, node_ids, -1), mode="drop")
+    )
+    # start offsets are monotone in node id, so a running max forward-
+    # fills each row's owner into all of its slots
+    owner = lax.cummax(owner0)
+    slot = jnp.arange(num_slots, dtype=jnp.int32)
+    owner_c = jnp.clip(owner, 0, n_pad - 1)
+    edge_id = row_ptr[owner_c] + (slot - start[owner_c])
+    valid = (owner >= 0) & (slot < end[n_pad - 1])
+    owner_key = jnp.where(valid, owner_c, n_pad)
+    return owner_c, owner_key, edge_id, valid, start, end
+
+
+def rating_topk_rows(
+    owner_key: jax.Array,
+    nb: jax.Array,
+    w: jax.Array,
+    end: jax.Array,
+    deg: jax.Array,
+    salt,
+    k_best: int,
+) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+    """Top-k_best rated clusters per row plus each row's per-slot group
+    totals, from row-grouped (owner, neighbor-label, weight) triples.
+
+    The row-buffer twin of rating_top3_by_sort: slots must already be
+    grouped by owner (ascending, pad slots keyed n_pad); two buffer-wide
+    sorts + streaming passes, no scatters.  Returns the flat tuple
+    (lab1, w1, ..., lab_k, w_k), each [n_pad], read at row ends
+    (end[i]-1-j); absent entries are (-1, INT32_MIN).
+    """
+    o_s, nb_s, w_s = sort_by_two_keys(owner_key, nb, w.astype(ACC_DTYPE))
+    prev_o = jnp.concatenate([jnp.array([-1], o_s.dtype), o_s[:-1]])
+    prev_nb = jnp.concatenate([jnp.array([-1], nb_s.dtype), nb_s[:-1]])
+    new_grp = (o_s != prev_o) | (nb_s != prev_nb)
+    cum = jnp.cumsum(w_s)
+    base = lax.cummax(jnp.where(new_grp, cum - w_s, 0))
+    total = cum - base
+    is_last = jnp.concatenate([new_grp[1:], jnp.array([True])])
+    tb = hash_u32(nb_s, salt)
+    prio = jnp.where(is_last, total, -1)
+    _, prio2, _, lab2 = lax.sort((o_s, prio, tb, nb_s), num_keys=3)
+    D = prio2.shape[0]
+    out = []
+    for j in range(k_best):
+        posj = jnp.clip(end - 1 - j, 0, D - 1)
+        validj = (deg > j) & (prio2[posj] >= 0)
+        out.append(jnp.where(validj, lab2[posj], -1))
+        out.append(jnp.where(validj, prio2[posj], INT32_MIN))
+    return tuple(out)
+
+
+def connection_to_own_rows(
+    nb: jax.Array,
+    w: jax.Array,
+    own_of_slot: jax.Array,
+    start: jax.Array,
+    end: jax.Array,
+) -> jax.Array:
+    """Exact per-row connection weight to the row node's own label, via a
+    streaming masked cumsum over row spans — no scatter, no sort.  `nb`
+    and `w` are in buffer order, `own_of_slot` is the owner's label per
+    slot, `start`/`end` the row spans."""
+    D = nb.shape[0]
+    match = nb == own_of_slot
+    csum = jnp.cumsum(jnp.where(match, w, 0).astype(ACC_DTYPE))
+    csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
+    s = jnp.clip(start, 0, D)
+    e = jnp.clip(end, 0, D)
+    return csum0[e] - csum0[s]
+
+
 def packed_afterburner_gain(
     src: jax.Array,
     dst: jax.Array,
@@ -615,30 +726,52 @@ def packed_afterburner_gain(
     Returns adj_gain[n_pad]; entries for non-candidates are the plain
     neighborhood sum with no candidate mask applied to themselves (mask
     with `candidate` when accepting).  Shared by the Jet refiner and the
-    bulk-synchronous LP refinement round.
+    bulk-synchronous LP refinement round.  A thin wrapper over the spans
+    variant: a CSR edge list is a row buffer with owner=src and spans
+    [row_ptr[i], row_ptr[i+1]).
     """
+    return packed_afterburner_gain_rows(
+        src, dst, edge_w, row_ptr[:-1], row_ptr[1:],
+        part, next_part, gain, candidate, k,
+    )
+
+
+def packed_afterburner_gain_rows(
+    owner: jax.Array,
+    dst: jax.Array,
+    edge_w: jax.Array,
+    start: jax.Array,
+    end: jax.Array,
+    part: jax.Array,
+    next_part: jax.Array,
+    gain: jax.Array,
+    candidate: jax.Array,
+    k: int,
+) -> jax.Array:
+    """packed_afterburner_gain over a row buffer: slots grouped by owner
+    with spans [start, end) per node (see expand_active_rows).  Kept
+    separate from the row_ptr variant so the Jet refiner's compiled
+    executables stay byte-identical."""
     n_pad = part.shape[0]
-    u = src
-    v = dst
     label_bits = max((k - 1).bit_length(), 1)
     gain_bits = 31 - 2 * label_bits
     if gain_bits >= 15:
         half = jnp.int32(1 << (gain_bits - 1))
-        gain_clip = jnp.clip(gain, 1 - half, half - 1) + half  # >= 1
-        gain_field = jnp.where(candidate, gain_clip, 0)  # 0 = not a cand
+        gain_clip = jnp.clip(gain, 1 - half, half - 1) + half
+        gain_field = jnp.where(candidate, gain_clip, 0)
         meta = (
             (gain_field << (2 * label_bits))
             | (next_part << label_bits)
             | part
         )
-        mu = meta[u]
-        mv = meta[v]
+        mu = meta[owner]
+        mv = meta[dst]
         lab_mask = jnp.int32((1 << label_bits) - 1)
         gain_u = mu >> (2 * label_bits)
         gain_v = mv >> (2 * label_bits)
         v_is_cand = gain_v > 0
         v_before_u = v_is_cand & (
-            (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
+            (gain_v > gain_u) | ((gain_v == gain_u) & (dst < owner))
         )
         block_v = jnp.where(
             v_before_u, (mv >> label_bits) & lab_mask, mv & lab_mask
@@ -646,29 +779,27 @@ def packed_afterburner_gain(
         to_u = (mu >> label_bits) & lab_mask
         from_u = mu & lab_mask
         u_is_cand = gain_u > 0
-    else:  # huge k: not enough bits, fall back to separate gathers
+    else:
         gain_full = jnp.where(candidate, gain, INT32_MIN)
-        gain_u = gain_full[u]
-        gain_v = gain_full[v]
+        gain_u = gain_full[owner]
+        gain_v = gain_full[dst]
         v_is_cand = gain_v > INT32_MIN
         v_before_u = v_is_cand & (
-            (gain_v > gain_u) | ((gain_v == gain_u) & (v < u))
+            (gain_v > gain_u) | ((gain_v == gain_u) & (dst < owner))
         )
-        block_v = jnp.where(v_before_u, next_part[v], part[v])
-        to_u = next_part[u]
-        from_u = part[u]
+        block_v = jnp.where(v_before_u, next_part[dst], part[dst])
+        to_u = next_part[owner]
+        from_u = part[owner]
         u_is_cand = gain_u > INT32_MIN
     contrib = jnp.where(
         to_u == block_v,
         edge_w,
         jnp.where(from_u == block_v, -edge_w, 0),
     )
-    csum = jnp.cumsum(
-        jnp.where(u_is_cand, contrib, 0).astype(ACC_DTYPE)
-    )
+    csum = jnp.cumsum(jnp.where(u_is_cand, contrib, 0).astype(ACC_DTYPE))
     csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
-    rp = jnp.clip(row_ptr, 0, contrib.shape[0])
-    return csum0[rp[1:]] - csum0[rp[:-1]]
+    D = contrib.shape[0]
+    return csum0[jnp.clip(end, 0, D)] - csum0[jnp.clip(start, 0, D)]
 
 
 def neighbor_any_true(

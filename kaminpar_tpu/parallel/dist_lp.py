@@ -107,9 +107,10 @@ def _dist_lp_round(
         )
     engine = _select_engine(cfg, C, src_l.shape[0])
     if engine == "sort2":
-        # auto selection: the hashed engine is the fast path for large
-        # local shards here
-        engine = "hash"
+        # auto selection: sort2 needs CSR row spans, which the sharded COO
+        # layout does not carry.  Small shards keep the exact 'sort'
+        # engine; large ones take the hashed table (the fast path here).
+        engine = "sort" if src_l.shape[0] < (1 << 21) else "hash"
     if engine == "dense":
         conn = dense_block_ratings(seg, dst_l, ew_l, labels, n_loc, C)
         allowed = None

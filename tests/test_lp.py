@@ -221,15 +221,20 @@ def test_lp_cluster_sort2_engine_quality_and_caps():
     assert len(np.unique(lab)) < g.n // 2  # actually coarsens
 
 
-def test_sort2_engine_rejects_communities():
+def test_sort2_engine_enforces_communities():
+    """sort2 gained the v-cycle community restriction (a node-level check
+    on the top-K candidates): no cluster may span two communities."""
     g = factories.make_grid_graph(8, 8)
     dg = device_graph_from_host(g)
-    comm = jnp.zeros(dg.n_pad, jnp.int32)
-    with pytest.raises(ValueError):
+    comm_np = (np.arange(dg.n_pad) % 2).astype(np.int32)
+    labels = np.asarray(
         lp_cluster(
             dg, jnp.int32(16), jnp.int32(0), LPConfig(rating="sort2"),
-            communities=comm,
+            communities=jnp.asarray(comm_np),
         )
+    )[: g.n]
+    # every node's cluster leader shares its community
+    assert (comm_np[labels] == comm_np[: g.n]).all()
 
 
 def test_lp_refine_never_increases_cut():
@@ -252,3 +257,42 @@ def test_lp_refine_never_increases_cut():
         out = lp_refine(dg, part_j, k, caps, jnp.int32(seed + 7))
         cut1 = int(metrics.edge_cut(dg, out))
         assert cut1 <= cut0, (seed, cut0, cut1)
+
+
+def test_delta_rounds_match_full_rounds(monkeypatch):
+    """Delta rounds (active rows compacted into the m_pad/4 buffer) must
+    make bitwise-identical decisions to full rounds: per-row rating sees
+    the same groups/totals/tie-hashes, and inactive nodes cannot move
+    either way.  Force the delta threshold down and compare end-to-end
+    clustering and refinement outputs against the unpatched paths."""
+    import kaminpar_tpu.ops.lp as lp_mod
+
+    g = factories.make_rmat(1 << 11, 20_000, seed=13)
+    dg = device_graph_from_host(g)
+    mcw = jnp.int32(max(1, int(g.node_weight_array().sum() // 16)))
+
+    full_labels = np.asarray(lp_cluster(dg, mcw, jnp.int32(5)))
+
+    k = 8
+    rng = np.random.default_rng(3)
+    part = np.zeros(dg.n_pad, np.int32)
+    part[: g.n] = rng.integers(0, k, g.n)
+    caps = jnp.full(
+        (k,), int(np.ceil(g.node_weight_array().sum() / k * 1.1)), jnp.int32
+    )
+    full_part = np.asarray(lp_refine(dg, jnp.asarray(part), k, caps, jnp.int32(2)))
+
+    monkeypatch.setattr(lp_mod, "DELTA_MIN_EDGE_SLOTS", 1)
+    lp_mod._lp_cluster_impl.clear_cache()
+    lp_mod._lp_refine_fused.clear_cache()
+    try:
+        delta_labels = np.asarray(lp_cluster(dg, mcw, jnp.int32(5)))
+        delta_part = np.asarray(
+            lp_refine(dg, jnp.asarray(part), k, caps, jnp.int32(2))
+        )
+    finally:
+        lp_mod._lp_cluster_impl.clear_cache()
+        lp_mod._lp_refine_fused.clear_cache()
+
+    np.testing.assert_array_equal(delta_labels, full_labels)
+    np.testing.assert_array_equal(delta_part, full_part)

@@ -154,3 +154,36 @@ def test_chunked_launch_paths_match_fused(monkeypatch):
     )
     assert int(metrics.edge_cut(g, chunked_lp)) < cut0
     assert int(metrics.edge_cut(g, fused_lp)) < cut0
+
+
+def test_jet_incremental_table_matches_full_rebuild(monkeypatch):
+    """The incrementally-maintained (n, k) rating table and the
+    candidate-row afterburner must be bitwise-equivalent to full
+    rebuilds: integer re-scatter of changed rows is exact, and candidate
+    rows contain every edge the filter sums.  Force the delta threshold
+    down and compare whole refinements."""
+    import kaminpar_tpu.ops.jet as jet_mod
+    from kaminpar_tpu.ops.jet import jet_refine
+    from kaminpar_tpu.context import JetRefinementContext
+
+    g = device_graph_from_host(factories.make_rmat(1 << 11, 24_000, seed=21))
+    k = 8
+    nw = np.asarray(g.node_w)[: int(g.n)]
+    cap = jnp.full(k, int(1.1 * np.ceil(nw.sum() / k)), dtype=jnp.int32)
+    rng = np.random.default_rng(5)
+    p0 = np.zeros(g.n_pad, np.int32)
+    p0[: int(g.n)] = rng.integers(0, k, int(g.n))
+    p0 = jnp.asarray(p0)
+
+    full = np.asarray(
+        jet_refine(g, p0, k, cap, jnp.int32(4), JetRefinementContext(), 0, 2)
+    )
+    monkeypatch.setattr(jet_mod, "DELTA_MIN_EDGE_SLOTS", 1)
+    jet_mod._jet_chunk.clear_cache()
+    try:
+        delta = np.asarray(
+            jet_refine(g, p0, k, cap, jnp.int32(4), JetRefinementContext(), 0, 2)
+        )
+    finally:
+        jet_mod._jet_chunk.clear_cache()
+    np.testing.assert_array_equal(delta, full)
