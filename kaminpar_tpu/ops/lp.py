@@ -206,7 +206,11 @@ def lp_round(
         # a streaming masked cumsum over the row spans (one extra gather
         # for the owner's label), replacing the old top-K upper-bound
         # estimate that silently under-moved on huge graphs.
-        K = cfg.topk
+        # On dense coarse levels (hundreds of adjacent clusters, most
+        # near the weight cap) a deeper candidate list keeps merges
+        # flowing — the reads are n-wide gathers, essentially free.
+        avg_degree = graph.m_pad / max(C, 1)
+        K = cfg.topk if avg_degree <= 32 else max(cfg.topk, 16)
         nb = jnp.where(valid, labels[dst_b], -1) if rows is not None else (
             labels[dst_b]
         )

@@ -644,9 +644,9 @@ def rating_topk_rows(
     deg: jax.Array,
     salt,
     k_best: int,
-) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
-    """Top-k_best rated clusters per row plus each row's per-slot group
-    totals, from row-grouped (owner, neighbor-label, weight) triples.
+) -> Tuple[jax.Array, ...]:
+    """Top-k_best rated clusters per row, from row-grouped
+    (owner, neighbor-label, weight) triples.
 
     The row-buffer twin of rating_top3_by_sort: slots must already be
     grouped by owner (ascending, pad slots keyed n_pad); two buffer-wide
@@ -828,6 +828,8 @@ def afterburner_filter(
     target_by_node: jax.Array,
     seg: jax.Array,
     num_segments: int,
+    src_order: jax.Array | None = None,
+    dst_order: jax.Array | None = None,
 ) -> jax.Array:
     """Jet's afterburner (jet_refiner.cc:133-170) as a reusable filter:
     re-evaluate each move candidate's gain assuming every neighbor that
@@ -838,13 +840,21 @@ def afterburner_filter(
     gain is positive.
 
     `gain_by_node` must be INT32_MIN for non-candidates; `labels_of_*`
-    and `target_by_node` are indexed by global node id; `seg` maps each
-    edge to its output segment (local node id on sharded layouts).
+    and `target_by_node` are indexed by the same space as `src`/`dst`;
+    `seg` maps each edge to its output segment (local node id on sharded
+    layouts).  `src_order`/`dst_order` override the ids used for the
+    who-moves-first tie ordering — on ghost-halo layouts `src`/`dst` are
+    LOCAL indices (not globally consistent), so callers pass the GLOBAL
+    ids there to keep the order a total order across devices.
     """
+    if src_order is None:
+        src_order = src
+    if dst_order is None:
+        dst_order = dst
     gain_u = gain_by_node[src]
     gain_v = gain_by_node[dst]
     v_before_u = (gain_v > INT32_MIN) & (
-        (gain_v > gain_u) | ((gain_v == gain_u) & (dst < src))
+        (gain_v > gain_u) | ((gain_v == gain_u) & (dst_order < src_order))
     )
     block_v = jnp.where(v_before_u, target_by_node[dst], labels_of_dst)
     to_u = target_by_node[src]

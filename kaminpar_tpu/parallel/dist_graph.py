@@ -41,13 +41,28 @@ from .mesh import NODE_AXIS
 @jax.tree_util.register_dataclass
 @dataclass
 class DistGraph:
-    """Sharded COO graph over a 1D mesh.
+    """Sharded COO graph over a 1D mesh, with a ghost-halo table.
 
     Fields:
       src, dst, edge_w : i32[m_tot]  edge arrays, sharded over the mesh axis
                          (device d holds slots [d*m_loc, (d+1)*m_loc))
       node_w           : i32[n_pad]  node weights, sharded over the mesh axis
       n, m             : i32 scalars (replicated true counts)
+
+    Ghost-halo model (distributed_csr_graph.h:44-92, ghost_node_mapper.h):
+      dst_local : i32[m_tot]    each edge's endpoint in LOCAL index space —
+                                [0, n_loc) for owned nodes, n_loc + g for
+                                ghost slot g; sharded like dst
+      ghost_gid : i32[D*g_loc]  global node id of each ghost slot (device-
+                                local table; pad slots: n_pad - 1)
+      send_idx  : i32[D*D, s_max] per device d (dim0 block d): row p holds
+                                the LOCAL indices of d-owned interface
+                                nodes whose values peer p needs (pad: -1)
+      recv_map  : i32[D*D, s_max] per device d: row p maps peer p's j-th
+                                sent value to a local ghost slot (pad:
+                                g_loc, dropped by the scatter)
+    Per-round label exchange then costs O(interface) collective volume
+    (see mesh.halo_exchange) instead of an O(n) all_gather.
     """
 
     src: jax.Array
@@ -56,6 +71,10 @@ class DistGraph:
     node_w: jax.Array
     n: jax.Array
     m: jax.Array
+    dst_local: jax.Array
+    ghost_gid: jax.Array
+    send_idx: jax.Array
+    recv_map: jax.Array
 
     @property
     def n_pad(self) -> int:
@@ -64,6 +83,18 @@ class DistGraph:
     @property
     def m_tot(self) -> int:
         return self.src.shape[0]
+
+    @property
+    def g_loc(self) -> int:
+        """Ghost slots per device."""
+        D = self.send_idx.shape[0] and int(
+            round(self.send_idx.shape[0] ** 0.5)
+        )
+        return self.ghost_gid.shape[0] // max(D, 1)
+
+    @property
+    def s_max(self) -> int:
+        return self.send_idx.shape[1]
 
 
 def dist_graph_from_host(
@@ -99,6 +130,7 @@ def dist_graph_from_host(
     src_t = np.empty((D, m_loc), dtype=np.int32)
     dst_t = np.full((D, m_loc), pad_node, dtype=np.int32)
     ew_t = np.zeros((D, m_loc), dtype=np.int32)
+    ghosts_per_dev = []
     for d in range(D):
         src_t[d, :] = d * n_loc  # pad fill: first owned node, weight 0
         sel = owner == d
@@ -106,6 +138,44 @@ def dist_graph_from_host(
         src_t[d, :c] = src[sel]
         dst_t[d, :c] = dst[sel]
         ew_t[d, :c] = ew[sel]
+        # ghost universe of d: remote endpoints of its edges (the pad
+        # node included — its label never matters, weight-0 edges only)
+        dst_d = dst_t[d]
+        remote = dst_d[(dst_d < d * n_loc) | (dst_d >= (d + 1) * n_loc)]
+        ghosts_per_dev.append(np.unique(remote))
+
+    g_loc = max(1, pad_size(max((len(g) for g in ghosts_per_dev), default=1), 1))
+    # interface lists: send_cnt[p][d] = p-owned nodes that are ghosts on d
+    s_needed = 1
+    for d in range(D):
+        gh = ghosts_per_dev[d]
+        own = np.clip(gh // n_loc, 0, D - 1)
+        if len(gh):
+            s_needed = max(s_needed, int(np.bincount(own, minlength=D).max()))
+    s_max = pad_size(s_needed, 1)
+
+    dstloc_t = np.full((D, m_loc), 0, dtype=np.int32)
+    ghost_gid_t = np.full((D, g_loc), pad_node, dtype=np.int32)
+    send_idx_t = np.full((D, D, s_max), -1, dtype=np.int32)
+    recv_map_t = np.full((D, D, s_max), g_loc, dtype=np.int32)
+    for d in range(D):
+        gh = ghosts_per_dev[d]
+        ghost_gid_t[d, : len(gh)] = gh
+        dst_d = dst_t[d]
+        is_owned = (dst_d >= d * n_loc) & (dst_d < (d + 1) * n_loc)
+        loc = np.where(
+            is_owned,
+            dst_d - d * n_loc,
+            n_loc + np.searchsorted(gh, dst_d) if len(gh) else 0,
+        )
+        dstloc_t[d] = loc.astype(np.int32)
+        own = np.clip(gh // n_loc, 0, D - 1) if len(gh) else np.zeros(0, int)
+        for p in range(D):
+            mine = np.where(own == p)[0]  # ghost slots on d owned by p
+            send_idx_t[p, d, : len(mine)] = (gh[mine] - p * n_loc).astype(
+                np.int32
+            )
+            recv_map_t[d, p, : len(mine)] = mine.astype(np.int32)
 
     node_w = np.zeros(n_pad, dtype=np.int32)
     node_w[:n] = graph.node_weight_array().astype(np.int32)
@@ -119,4 +189,8 @@ def dist_graph_from_host(
         node_w=jax.device_put(node_w, shard),
         n=jax.device_put(jnp.int32(n), repl),
         m=jax.device_put(jnp.int32(m), repl),
+        dst_local=jax.device_put(dstloc_t.reshape(-1), shard),
+        ghost_gid=jax.device_put(ghost_gid_t.reshape(-1), shard),
+        send_idx=jax.device_put(send_idx_t.reshape(D * D, s_max), shard),
+        recv_map=jax.device_put(recv_map_t.reshape(D * D, s_max), shard),
     )

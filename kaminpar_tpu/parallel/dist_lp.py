@@ -58,36 +58,49 @@ from ..ops.segments import (
     move_weight_delta,
 )
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS, throttled_local_capacity
+from .mesh import NODE_AXIS, halo_exchange, throttled_local_capacity
 
 
 def _dist_lp_round(
     src_l: jax.Array,
     dst_l: jax.Array,
+    dstloc_l: jax.Array,
     ew_l: jax.Array,
     nw_l: jax.Array,
     n: jax.Array,
-    labels: jax.Array,
+    labels_l: jax.Array,
+    ghost_lab: jax.Array,
+    send_idx_l: jax.Array,
+    recv_map_l: jax.Array,
     weights: jax.Array,
     cap: jax.Array,
     active_l: jax.Array,
     movable_l: jax.Array,
     salt: jax.Array,
     cfg: LPConfig,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """One round, executed per device inside shard_map.
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One round, executed per device inside shard_map — ghost-halo model.
 
-    labels  i32[n_pad] replicated; weights/cap i32[C] replicated;
-    *_l are the local shards.  Returns (labels, weights, active_l,
-    num_wanting) with labels/weights again replicated-consistent.
+    Labels are OWNER-SHARDED: labels_l i32[n_loc] holds the owned nodes'
+    labels, ghost_lab i32[g_loc] the (synchronized) labels of this
+    device's ghost nodes, and the local label table concat(labels_l,
+    ghost_lab) is indexed by dstloc_l.  Label values stay GLOBAL cluster
+    ids.  The per-round collectives are the O(interface) halo exchanges
+    (mesh.halo_exchange — the synchronize_ghost_node_clusters analog) and
+    one dense psum of per-cluster weight deltas; nothing is all_gather'd.
+    weights/cap i32[C] stay replicated (the dense-reduce weight-control
+    tradeoff: a psum rides ICI at reduction bandwidth, while the
+    reference's sparse owner messages have no static-shape XLA form).
+
+    Returns (labels_l, ghost_lab, weights, active_l, num_wanting).
     """
     n_loc = nw_l.shape[0]
-    n_pad = labels.shape[0]
+    g_loc = ghost_lab.shape[0]
     C = weights.shape[0]
     d = lax.axis_index(NODE_AXIS)
     offset = (d * n_loc).astype(jnp.int32)
-    labels_l = lax.dynamic_slice(labels, (offset,), (n_loc,))
     node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
+    lab_tab = jnp.concatenate([labels_l, ghost_lab])
 
     # -- rate: per-owned-node best cluster over the local edge shard,
     # same engine dispatch as the single-chip lp_round (ops/lp.py): the
@@ -95,7 +108,7 @@ def _dist_lp_round(
     # and dense tables are exact locally
     from ..ops.lp import _select_engine
 
-    neighbor_cluster = labels[dst_l]
+    neighbor_cluster = lab_tab[jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)]
     seg = src_l - offset
     if cfg.rating == "sort2":
         # sort2 needs CSR row spans, which the sharded COO layout does not
@@ -112,7 +125,10 @@ def _dist_lp_round(
         # engine; large ones take the hashed table (the fast path here).
         engine = "sort" if src_l.shape[0] < (1 << 21) else "hash"
     if engine == "dense":
-        conn = dense_block_ratings(seg, dst_l, ew_l, labels, n_loc, C)
+        conn = dense_block_ratings(
+            seg, jnp.clip(dstloc_l, 0, n_loc + g_loc - 1), ew_l, lab_tab,
+            n_loc, C,
+        )
         allowed = None
         if cfg.dist_local_only:
             # LocalLPClusterer: only clusters led by owned nodes
@@ -176,19 +192,29 @@ def _dist_lp_round(
     )
     target_l = jnp.where(wants & participate, best, -1)
 
+    dstloc_c = jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)
     if cfg.refinement:
         # afterburner (shared with ops/lp.py lp_round): bulk-synchronous
-        # adjacent moves can jointly increase the cut; costs one extra
-        # all_gather pair per round.  `wants` stays unmasked so filtered
-        # or unsampled nodes remain in the convergence count/active set.
+        # adjacent moves can jointly increase the cut; costs one halo-
+        # exchange pair per round (gain + target of interface nodes).
+        # `wants` stays unmasked so filtered or unsampled nodes remain in
+        # the convergence count/active set.
         from ..ops.segments import INT32_MIN, afterburner_filter
 
         gain_cand_l = jnp.where(target_l >= 0, gain, INT32_MIN)
-        gain_g = lax.all_gather(gain_cand_l, NODE_AXIS, tiled=True)
-        target_g = lax.all_gather(target_l, NODE_AXIS, tiled=True)
+        # exchanged ghost slots all receive real values (send lists are
+        # complete); slots never referenced by any edge keep the scatter
+        # fill, which no contribution reads.  One stacked launch for both.
+        ghost_gain, ghost_target = halo_exchange(
+            jnp.stack([gain_cand_l, target_l]), send_idx_l, recv_map_l, g_loc
+        )
+        gain_tab = jnp.concatenate([gain_cand_l, ghost_gain])
+        target_tab = jnp.concatenate([target_l, ghost_target])
         adj_gain = afterburner_filter(
-            src_l, dst_l, ew_l, labels[src_l], labels[dst_l],
-            gain_g, target_g, seg, n_loc,
+            seg, dstloc_c, ew_l, labels_l[jnp.clip(seg, 0, n_loc - 1)],
+            neighbor_cluster, gain_tab, target_tab, seg, n_loc,
+            # ordering must be a TOTAL order across devices: use global ids
+            src_order=src_l, dst_order=dst_l,
         )
         target_l = jnp.where(adj_gain > 0, target_l, -1)
 
@@ -198,9 +224,19 @@ def _dist_lp_round(
     prio_l = hash_u32(node_ids_l, salt ^ 0x165667B1)
     accept_l = accept_prefix_by_capacity(target_l, prio_l, nw_l, local_cap)
 
-    # -- apply + the two collectives (ghost sync / weight control) -------
+    # -- apply + the collectives (halo sync / weight control) ------------
     new_labels_l = jnp.where(accept_l, target_l, labels_l)
-    new_labels = lax.all_gather(new_labels_l, NODE_AXIS, tiled=True)
+    moved_l = accept_l.astype(jnp.int32)
+    if cfg.use_active_set:
+        # labels + moved flags share one stacked exchange
+        new_ghost_lab, ghost_moved = halo_exchange(
+            jnp.stack([new_labels_l, moved_l]), send_idx_l, recv_map_l, g_loc
+        )
+    else:
+        new_ghost_lab = halo_exchange(
+            new_labels_l, send_idx_l, recv_map_l, g_loc
+        )
+        ghost_moved = None
 
     delta = lax.psum(
         move_weight_delta(labels_l, target_l, accept_l, nw_l, C), NODE_AXIS
@@ -209,19 +245,16 @@ def _dist_lp_round(
 
     # -- active set (label_propagation.h:507-513 analog) -----------------
     if cfg.use_active_set:
-        moved_l = accept_l.astype(jnp.int32)
-        moved = lax.all_gather(moved_l, NODE_AXIS, tiled=True)
+        moved_tab = jnp.concatenate([moved_l, ghost_moved])
         neigh_moved = jax.ops.segment_max(
-            moved[jnp.clip(dst_l, 0, n_pad - 1)],
-            seg,
-            num_segments=n_loc,
+            moved_tab[dstloc_c], seg, num_segments=n_loc
         )
         new_active_l = ((moved_l | neigh_moved) > 0) | (wants & ~accept_l)
     else:
         new_active_l = jnp.ones_like(active_l)
 
     num_wanting = lax.psum(jnp.sum(wants.astype(jnp.int32)), NODE_AXIS)
-    return new_labels, new_weights, new_active_l, num_wanting
+    return new_labels_l, new_ghost_lab, new_weights, new_active_l, num_wanting
 
 
 def _dist_lp_loop(
@@ -241,44 +274,58 @@ def _dist_lp_loop(
     — used by the HEM+LP hybrid to pin matched pairs."""
     if movable is None:
         movable = jnp.ones(graph.n_pad, dtype=bool)
+    g_loc = graph.g_loc
 
-    def per_device(src_l, dst_l, ew_l, nw_l, n, labels0, weights0, cap,
-                   seed, movable):
+    def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
+                   send_idx_l, recv_map_l, labels0, weights0, cap, seed,
+                   movable):
         n_loc = nw_l.shape[0]
         d = lax.axis_index(NODE_AXIS)
         offset = (d * n_loc).astype(jnp.int32)
         movable_l = lax.dynamic_slice(movable, (offset,), (n_loc,))
+        # owner-sharded label state: owned slice + initial halo pull of
+        # the ghosts' labels (labels0 is replicated only HERE, at entry)
+        labels_l0 = lax.dynamic_slice(labels0, (offset,), (n_loc,))
+        ghost_lab0 = labels0[jnp.clip(ghost_gid_l, 0, labels0.shape[0] - 1)]
 
         def cond(state):
-            i, _, _, _, moved = state
+            i, _, _, _, _, moved = state
             return (i < iters) & (moved != 0)
 
         def body(state):
-            i, labels, weights, active_l, _ = state
+            i, labels_l, ghost_lab, weights, active_l, _ = state
             salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
-            labels, weights, active_l, moved = _dist_lp_round(
-                src_l, dst_l, ew_l, nw_l, n, labels, weights, cap,
-                active_l, movable_l, salt, cfg,
+            labels_l, ghost_lab, weights, active_l, moved = _dist_lp_round(
+                src_l, dst_l, dstloc_l, ew_l, nw_l, n, labels_l, ghost_lab,
+                send_idx_l, recv_map_l, weights, cap, active_l, movable_l,
+                salt, cfg,
             )
-            return (i + 1, labels, weights, active_l, moved)
+            return (i + 1, labels_l, ghost_lab, weights, active_l, moved)
 
         active0 = jnp.ones(n_loc, dtype=bool)
-        init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1))
-        _, labels, _, _, _ = lax.while_loop(cond, body, init)
-        return labels
+        init = (
+            jnp.int32(0), labels_l0, ghost_lab0, weights0, active0,
+            jnp.int32(1),
+        )
+        _, labels_l, _, _, _, _ = lax.while_loop(cond, body, init)
+        # ONE O(n) gather at loop exit — the per-round collectives above
+        # are all O(interface)
+        return lax.all_gather(labels_l, NODE_AXIS, tiled=True)
 
     mapped = _shard_map(
         per_device,
         mesh=mesh,
         in_specs=(
             P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
-            P(), P(), P(), P(), P(), P(),
+            P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(), P(), P(), P(),
         ),
         out_specs=P(),
         check_vma=False,
     )
     return mapped(
-        graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
+        graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
+        graph.n, graph.ghost_gid, graph.send_idx, graph.recv_map,
         labels0, weights0, cap, seed, movable,
     )
 

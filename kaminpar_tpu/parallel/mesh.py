@@ -59,6 +59,41 @@ def throttled_local_capacity(
     return jnp.minimum(local_cap, headroom)
 
 
+def halo_exchange(
+    vals_l: jax.Array,
+    send_idx_l: jax.Array,
+    recv_map_l: jax.Array,
+    g_loc: int,
+    axis_name: str = NODE_AXIS,
+) -> jax.Array:
+    """Interface→ghost value exchange (the synchronize_ghost_node_* sparse
+    alltoall of the reference, kaminpar-dist/graphutils/communication.h:242)
+    as one static-shape XLA all_to_all.
+
+    Per device inside shard_map: gather the owned values each peer needs
+    (send_idx_l[p] = local indices destined to peer p, pad -1), all_to_all
+    the [D, s_max] buffer, scatter received values into ghost slots
+    (recv_map_l[p][j] = ghost slot of peer p's j-th value; pad g_loc is
+    dropped).  Collective volume O(interface), not O(n).
+
+    `vals_l` may be [n_loc] (one value per node) or stacked [C, n_loc] —
+    several per-node quantities share one collective launch (per-launch
+    latency dominates on small interfaces).  Returns [g_loc] or
+    [C, g_loc] accordingly.
+    """
+    stacked = vals_l.ndim == 2
+    v = vals_l if stacked else vals_l[None]
+    n_loc = v.shape[1]
+    sendbuf = v[:, jnp.clip(send_idx_l, 0, n_loc - 1)]  # [C, D, s_max]
+    recvbuf = lax.all_to_all(sendbuf, axis_name, 1, 1, tiled=True)
+    out = (
+        jnp.zeros((v.shape[0], g_loc), v.dtype)
+        .at[:, recv_map_l.reshape(-1)]
+        .set(recvbuf.reshape(v.shape[0], -1), mode="drop")
+    )
+    return out if stacked else out[0]
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,

@@ -152,6 +152,27 @@ class Coarsener:
             with timer.scoped_timer("contraction"):
                 coarse, c_n, c_m = contract_clustering(self.current, labels)
 
+        if (
+            c_n >= (1.0 - c_ctx.convergence_threshold) * self.current_n
+            and self.current_n > 4 * c_ctx.contraction_limit
+        ):
+            # last resort before declaring convergence: the hashed-slot
+            # engine sees 32 candidate clusters per node where sort2's
+            # top-K sees K — on dense near-cap coarse graphs that extra
+            # visibility often finds the feasible merges that unstick a
+            # limping hierarchy (each extra level costs a full refine
+            # pass downstream)
+            import dataclasses
+
+            hash_cfg = dataclasses.replace(self._lp_cfg, rating="hash")
+            with timer.scoped_timer("lp-clustering"):
+                labels = lp_cluster(
+                    cluster_input, mcw, seed + jnp.int32(3989), hash_cfg
+                )
+                drain(labels)
+            with timer.scoped_timer("contraction"):
+                coarse, c_n, c_m = contract_clustering(self.current, labels)
+
         if c_n >= (1.0 - c_ctx.convergence_threshold) * self.current_n:
             # converged: drop this level (not enough shrinkage)
             return False
