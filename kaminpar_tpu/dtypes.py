@@ -1,0 +1,36 @@
+"""Device dtype policy — the 32/64-bit weight build switch.
+
+The analog of the reference's KAMINPAR_64BIT_[NODE|EDGE]WEIGHTS CMake
+options (CMakeLists.txt:67-75): KAMINPAR_TPU_64BIT=1 in the environment
+(before first import) switches every device weight and accumulator to
+int64 and enables jax x64.  Node/edge IDS stay int32 either way — an id
+count above 2^31 is a separate limit, as in the reference's 64-bit ID
+build.  TPU int64 is emulated (~2x per irregular op); the flag exists for
+graphs whose total edge weight overflows int32, not as a default.
+
+A leaf module so both graphs.csr and ops.segments can import it without
+package-init cycles.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+X64_WEIGHTS = os.environ.get("KAMINPAR_TPU_64BIT", "0") not in (
+    "", "0", "false", "off",
+)
+if X64_WEIGHTS:
+    jax.config.update("jax_enable_x64", True)
+
+# Weight accumulator dtype.  int32 matches the reference's default 32-bit
+# weight build and is TPU-native; the 64-bit build flips it.
+ACC_DTYPE = jnp.int64 if X64_WEIGHTS else jnp.int32
+# Device weight storage matches the accumulator.
+WEIGHT_DTYPE = ACC_DTYPE
+# Gain/weight sentinel: the minimum of the accumulator dtype.  (Named for
+# the default build; under KAMINPAR_TPU_64BIT it is int64's minimum — a
+# 32-bit sentinel would collide with real 64-bit gains.)
+INT32_MIN = int(jnp.iinfo(ACC_DTYPE).min)

@@ -33,10 +33,11 @@ import numpy as np
 from ..utils.math import pad_size
 from .host import HostGraph
 
+from ..dtypes import ACC_DTYPE, WEIGHT_DTYPE  # int64 under
+# KAMINPAR_TPU_64BIT — see kaminpar_tpu/dtypes.py; ids stay int32 like
+# the reference's default 32-bit ID build, CMakeLists.txt:67-75
+
 NODE_DTYPE = jnp.int32
-WEIGHT_DTYPE = jnp.int32  # device weights; host keeps int64 (csr_graph.h uses
-# 32-bit IDs by default, CMakeLists.txt:67-75)
-ACC_DTYPE = jnp.int32  # weight accumulator dtype (see ops/segments.py)
 
 
 @jax.tree_util.register_dataclass
@@ -128,13 +129,13 @@ def device_graph_from_host(
     pad_node = n_pad - 1
     src = np.full(m_pad, pad_node, dtype=np.int32)
     dst = np.full(m_pad, pad_node, dtype=np.int32)
-    edge_w = np.zeros(m_pad, dtype=np.int32)
+    edge_w = np.zeros(m_pad, dtype=np.dtype(WEIGHT_DTYPE))
     src[:m] = graph.edge_sources()
     dst[:m] = graph.adjncy
-    edge_w[:m] = graph.edge_weight_array().astype(np.int32)
+    edge_w[:m] = graph.edge_weight_array().astype(np.dtype(WEIGHT_DTYPE))
 
-    node_w = np.zeros(n_pad, dtype=np.int32)
-    node_w[:n] = graph.node_weight_array().astype(np.int32)
+    node_w = np.zeros(n_pad, dtype=np.dtype(WEIGHT_DTYPE))
+    node_w[:n] = graph.node_weight_array().astype(np.dtype(WEIGHT_DTYPE))
 
     put = partial(jax.device_put, device=device)
     return DeviceGraph(

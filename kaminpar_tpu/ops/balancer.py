@@ -112,7 +112,7 @@ def overload_balance_round(
     accept = accept_out & accept_in
 
     new_part = jnp.where(accept, target, part)
-    return new_part, jnp.sum(accept.astype(jnp.int32))
+    return new_part, jnp.sum(accept, dtype=jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("k", "max_rounds"))
@@ -204,7 +204,7 @@ def underload_balance(
         )
         accept = accept_out & accept_in
         new_part = jnp.where(accept, target, part)
-        return (i + 1, new_part, jnp.sum(accept.astype(jnp.int32)))
+        return (i + 1, new_part, jnp.sum(accept, dtype=jnp.int32))
 
     def cond(state):
         i, part, moved = state

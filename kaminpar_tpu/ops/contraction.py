@@ -40,6 +40,7 @@ import jax.numpy as jnp
 
 from ..graphs.csr import DeviceGraph
 from ..utils.math import pad_size
+from ..graphs.csr import WEIGHT_DTYPE
 from .segments import ACC_DTYPE, aggregate_by_key
 
 
@@ -83,14 +84,14 @@ def _contract_part1(graph: DeviceGraph, labels: jax.Array):
     )
     rank = jnp.cumsum(used) - used
     cmap = jnp.where(is_real, rank[lab], -1).astype(jnp.int32)
-    c_n = jnp.sum(used)
+    c_n = jnp.sum(used, dtype=jnp.int32)
 
     # coarse node weights over fine slots
     c_node_w = jax.ops.segment_sum(
         jnp.where(is_real, graph.node_w, 0).astype(ACC_DTYPE),
         jnp.clip(cmap, 0, n_pad - 1),
         num_segments=n_pad,
-    ).astype(jnp.int32)
+    ).astype(WEIGHT_DTYPE)
 
     # coarse edges: route self-loops and pad edges to a trailing sentinel
     sentinel = jnp.int32(n_pad)
@@ -103,7 +104,7 @@ def _contract_part1(graph: DeviceGraph, labels: jax.Array):
 
     cu_g, cv_g, w_g = aggregate_by_key(cu, cv, w)
     group_valid = (cu_g >= 0) & (cu_g < sentinel)
-    c_m = jnp.sum(group_valid.astype(jnp.int32))
+    c_m = jnp.sum(group_valid, dtype=jnp.int32)
     return cmap, c_n, c_node_w, cu_g, cv_g, w_g, group_valid, c_m
 
 
@@ -134,7 +135,7 @@ def _contract_part2(
     in_range = slot < c_m
     src_c = jnp.where(in_range, fit_edges(cu_g, 0), pad_node).astype(jnp.int32)
     dst_c = jnp.where(in_range, fit_edges(cv_g, 0), pad_node).astype(jnp.int32)
-    w_c = jnp.where(in_range, fit_edges(w_g, 0), 0).astype(jnp.int32)
+    w_c = jnp.where(in_range, fit_edges(w_g, 0), 0).astype(WEIGHT_DTYPE)
 
     counts = jax.ops.segment_sum(
         in_range.astype(jnp.int32),
@@ -159,7 +160,7 @@ def _contract_part2(
 
     node_w_c = jnp.where(
         jnp.arange(n_pad_c) < c_n, fit_nodes(c_node_w, 0), 0
-    ).astype(jnp.int32)
+    ).astype(WEIGHT_DTYPE)
     cmap_final = jnp.where(cmap >= 0, cmap, pad_node).astype(jnp.int32)
 
     coarse = DeviceGraph(

@@ -180,7 +180,7 @@ def _jet_iteration(
             )
 
         cand_edges = jnp.sum(
-            jnp.where(candidate, graph.degrees, 0).astype(jnp.int32)
+            jnp.where(candidate, graph.degrees, 0), dtype=jnp.int32
         )
         adj_gain = lax.cond(
             cand_edges <= dslots,
@@ -233,7 +233,7 @@ def _jet_iteration(
         )
     else:
         changed_edges = jnp.sum(
-            jnp.where(part != new_part, graph.degrees, 0).astype(jnp.int32)
+            jnp.where(part != new_part, graph.degrees, 0), dtype=jnp.int32
         )
         new_conn = lax.cond(
             changed_edges <= dslots,
@@ -316,7 +316,7 @@ def _jet_chunk(
         # "improvement" means finding the first feasible partition —
         # comparing against the sentinel would defeat the fruitless
         # early-exit entirely
-        has_best = best_cut < jnp.iinfo(jnp.int32).max
+        has_best = best_cut < jnp.iinfo(ACC_DTYPE).max
         improved_enough = jnp.where(
             has_best,
             (best_cut - cut).astype(jnp.float32)
@@ -380,7 +380,7 @@ def _jet_init(graph: DeviceGraph, partition: jax.Array, k: int,
     # snapshots track the best FEASIBLE cut; an infeasible input (e.g.
     # everything in one block, cut 0) must not pin the snapshot
     best_cut0 = jnp.where(
-        feasible, edge_cut(graph, part0), jnp.iinfo(jnp.int32).max
+        feasible, edge_cut(graph, part0), jnp.iinfo(ACC_DTYPE).max
     )
     return part0, best_cut0
 

@@ -385,7 +385,7 @@ def lp_round(
     # convergence is judged on *wanting* nodes, not sampled movers: a round
     # where the participation sample happens to move nobody must not stop
     # the loop while unsampled nodes still have improving moves
-    num_wanting = jnp.sum(wants.astype(jnp.int32))
+    num_wanting = jnp.sum(wants, dtype=jnp.int32)
     return new_labels, new_cluster_weights, new_active, num_wanting
 
 
@@ -432,7 +432,7 @@ def _round_with_delta(
             communities=communities,
         )
 
-    total = jnp.sum(jnp.where(active & (deg > 0), deg, 0).astype(jnp.int32))
+    total = jnp.sum(jnp.where(active & (deg > 0), deg, 0), dtype=jnp.int32)
     pred = (i > 0) & (total <= dslots)
     return lax.cond(pred, delta_fn, full_fn, (labels, weights, active))
 
@@ -450,7 +450,7 @@ def _lp_cluster_impl(
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     n_pad = graph.n_pad
     labels0 = jnp.arange(n_pad, dtype=jnp.int32)
-    weights0 = graph.node_w.astype(jnp.int32)
+    weights0 = graph.node_w.astype(ACC_DTYPE)
     active0 = jnp.ones(n_pad, dtype=bool)
     comm = communities if has_communities else None
 
@@ -558,7 +558,7 @@ def lp_refine(
         part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
         bw = jax.ops.segment_sum(
             graph.node_w.astype(ACC_DTYPE), part, num_segments=k
-        ).astype(jnp.int32)
+        )
         active = jnp.ones(graph.n_pad, dtype=bool)
         for i in range(iters):
             # equivalent to the fused while_loop's traced int32-wraparound
@@ -600,7 +600,7 @@ def _lp_refine_fused(
     part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
     bw0 = jax.ops.segment_sum(
         graph.node_w.astype(ACC_DTYPE), part0, num_segments=k
-    ).astype(jnp.int32)
+    )
     active0 = jnp.ones(n_pad, dtype=bool)
 
     def cond(state):

@@ -29,11 +29,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-INT32_MIN = jnp.iinfo(jnp.int32).min
-# Weight accumulator dtype.  int32 matches the reference's default 32-bit
-# weight build (CMakeLists.txt:67-75) and is TPU-native; callers partitioning
-# graphs whose total edge weight exceeds 2^31 need the (future) 64-bit build.
-ACC_DTYPE = jnp.int32
+# Weight/accumulator dtypes and the 64-bit build switch live in the leaf
+# module kaminpar_tpu.dtypes (KAMINPAR_TPU_64BIT=1); re-exported here for
+# every kernel module.
+from ..dtypes import ACC_DTYPE, INT32_MIN, X64_WEIGHTS  # noqa: F401
 
 # A single fused device launch that runs for many minutes reproducibly
 # kills the TPU worker (observed at 33M edges with a fully fused Jet
@@ -56,13 +55,13 @@ def pad_k_bucket(k, max_block_weights, min_block_weights=None):
     """
     k_pad = max(2, 1 << (int(k) - 1).bit_length())
     if k_pad != k:
-        pad = jnp.zeros(k_pad - int(k), dtype=jnp.int32)
+        pad = jnp.zeros(k_pad - int(k), dtype=ACC_DTYPE)
         max_block_weights = jnp.concatenate(
-            [jnp.asarray(max_block_weights, dtype=jnp.int32), pad]
+            [jnp.asarray(max_block_weights, dtype=ACC_DTYPE), pad]
         )
         if min_block_weights is not None:
             min_block_weights = jnp.concatenate(
-                [jnp.asarray(min_block_weights, dtype=jnp.int32), pad]
+                [jnp.asarray(min_block_weights, dtype=ACC_DTYPE), pad]
             )
     return k_pad, max_block_weights, min_block_weights
 
@@ -153,12 +152,15 @@ def argmax_per_segment(
     )
     tb = hash_u32(key, tie_salt)
     tb_m = jnp.where(is_best, tb, -1)
+    # hashes and keys are int32 regardless of the weight build — their
+    # sentinel must stay in the int32 domain
+    i32_min = jnp.iinfo(jnp.int32).min
     best_tb = jax.ops.segment_max(
-        jnp.where(is_best, tb_m, INT32_MIN), seg_c, num_segments=num_segments + 1
+        jnp.where(is_best, tb_m, i32_min), seg_c, num_segments=num_segments + 1
     )[:num_segments]
     winner = is_best & (tb == best_tb[jnp.clip(seg_c, 0, num_segments - 1)])
     best_key = jax.ops.segment_max(
-        jnp.where(winner, key, INT32_MIN), seg_c, num_segments=num_segments + 1
+        jnp.where(winner, key, i32_min), seg_c, num_segments=num_segments + 1
     )[:num_segments]
     best_key = jnp.where(has, best_key, -1)
     best_score = jnp.where(has, best, INT32_MIN)

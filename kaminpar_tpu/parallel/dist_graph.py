@@ -4,11 +4,11 @@ The reference distributes nodes in contiguous global ranges per PE with
 local ghost copies of remote endpoints (kaminpar-dist/datastructures/
 distributed_csr_graph.h:25-92, ghost_node_mapper.h:311).  On a device mesh
 the same 1D distribution becomes array sharding: node arrays are sharded
-over the mesh axis, and each device holds the (padded) edge list of its own
-node range.  There is no explicit ghost table — remote label lookups are
-gathers into a replicated label vector that is rebuilt with `all_gather`
-after every bulk-synchronous round, which is the collective form of the
-reference's `synchronize_ghost_node_clusters` halo exchange
+over the mesh axis, each device holds the (padded) edge list of its own
+node range, and an explicit ghost table (built here) lets per-round label
+synchronization exchange ONLY interface values — mesh.halo_exchange is
+the static-shape XLA form of the reference's
+`synchronize_ghost_node_clusters` sparse alltoall
 (kaminpar-dist/coarsening/clustering/lp/global_lp_clusterer.cc:585-594).
 
 Layout invariants (device d of D, n_loc = n_pad / D, m_loc = m_tot / D):
@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..dtypes import WEIGHT_DTYPE
 from ..graphs.host import HostGraph
 from ..utils.math import pad_size, round_up
 from .mesh import NODE_AXIS
@@ -129,7 +130,7 @@ def dist_graph_from_host(
 
     src_t = np.empty((D, m_loc), dtype=np.int32)
     dst_t = np.full((D, m_loc), pad_node, dtype=np.int32)
-    ew_t = np.zeros((D, m_loc), dtype=np.int32)
+    ew_t = np.zeros((D, m_loc), dtype=np.dtype(WEIGHT_DTYPE))
     ghosts_per_dev = []
     for d in range(D):
         src_t[d, :] = d * n_loc  # pad fill: first owned node, weight 0
@@ -177,8 +178,8 @@ def dist_graph_from_host(
             )
             recv_map_t[d, p, : len(mine)] = mine.astype(np.int32)
 
-    node_w = np.zeros(n_pad, dtype=np.int32)
-    node_w[:n] = graph.node_weight_array().astype(np.int32)
+    node_w = np.zeros(n_pad, dtype=np.dtype(WEIGHT_DTYPE))
+    node_w[:n] = graph.node_weight_array().astype(np.dtype(WEIGHT_DTYPE))
 
     shard = NamedSharding(mesh, P(NODE_AXIS))
     repl = NamedSharding(mesh, P())

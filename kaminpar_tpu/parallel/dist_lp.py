@@ -14,7 +14,9 @@ chunks with two communication steps per chunk —
 where those two exchanges become exactly two XLA collectives per round:
 
   * a `psum` of per-cluster join demand + weight deltas (weight control),
-  * an `all_gather` of the owned label slices (ghost sync).
+  * an O(interface) halo exchange of the interface nodes' labels
+    (mesh.halo_exchange — ghost sync; labels are owner-sharded, one
+    all_gather runs at loop exit only).
 
 Cluster-weight safety across devices uses demand throttling instead of the
 reference's overshoot-and-rollback: each round every device computes its
@@ -335,7 +337,7 @@ def _dist_lp_cluster_impl(mesh, graph, max_cluster_weight, seed, cfg,
                           num_iterations):
     n_pad = graph.n_pad
     labels0 = jnp.arange(n_pad, dtype=jnp.int32)
-    weights0 = graph.node_w.astype(jnp.int32)  # cluster c starts = node c
+    weights0 = graph.node_w.astype(ACC_DTYPE)  # cluster c starts = node c
     cap = jnp.broadcast_to(
         jnp.asarray(max_cluster_weight, ACC_DTYPE), (n_pad,)
     )
@@ -370,7 +372,7 @@ def _dist_lp_cluster_from_impl(mesh, graph, labels0, movable,
         graph.node_w.astype(ACC_DTYPE),
         jnp.clip(labels0, 0, n_pad - 1),
         num_segments=n_pad,
-    ).astype(jnp.int32)
+    )
     cap = jnp.broadcast_to(
         jnp.asarray(max_cluster_weight, ACC_DTYPE), (n_pad,)
     )
@@ -420,7 +422,7 @@ def _dist_lp_refine_impl(mesh, graph, partition, k, max_block_weights, seed,
         in_specs=(P(NODE_AXIS), P()),
         out_specs=P(),
         check_vma=False,
-    )(graph.node_w, part0).astype(jnp.int32)
+    )(graph.node_w, part0)
     cap = jnp.asarray(max_block_weights, ACC_DTYPE)
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     return _dist_lp_loop(mesh, graph, part0, bw0, cap, seed, cfg, iters)

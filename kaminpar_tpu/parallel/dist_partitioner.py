@@ -7,11 +7,17 @@ compute_partition + partitioning/deep_multilevel.cc):
                (parallel/dist_lp.dist_lp_cluster — the GlobalLPClusteringImpl
                analog), followed by contraction.  The reference migrates
                coarse nodes/edges between PEs with sparse alltoalls
-               (global_cluster_contraction.cc); here the coarse graph is
-               rebuilt host-side from the replicated labels and re-sharded
-               onto the mesh — the coarse levels are geometrically smaller,
-               so the host rebuild is off the critical path, and the fine-
-               level LP rounds (the dominant cost) stay fully on-device.
+               (global_cluster_contraction.cc); here graphs that fit one
+               device are contracted by the DEVICE kernel (the sort-based
+               dedup in ops/contraction — labels are consistent across
+               devices, so a single device-resident contraction replaces
+               per-PE rating maps), and only the coarse CSR is pulled back
+               to re-shard onto the mesh for the next level.  Graphs above
+               the single-device budget fall back to the host rebuild —
+               a stopgap until a sharded contraction with a coarse-edge
+               alltoall exists; either way coarse levels are geometrically
+               smaller and the fine-level LP rounds (the dominant cost)
+               stay fully on-device.
 
   initial      the coarsest graph is partitioned by the shared-memory
   partitioning KaMinPar pipeline — exactly the reference's scheme of
@@ -34,7 +40,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 from ..context import Context
+from ..graphs.csr import device_graph_from_host, host_graph_from_device
 from ..graphs.host import HostGraph, contract_clustering_host
+from ..ops.contraction import contract_clustering
+from ..ops.segments import MAX_FUSED_EDGE_SLOTS
 from ..utils import timer
 from ..utils.logger import log
 from .dist_context import (
@@ -157,12 +166,39 @@ class dKaMinPar:
                     ),
                 )
                 lvl_seed = (ctx.seed * 7919 + len(levels) * 31337) & 0x7FFFFFFF
-                labels = np.asarray(
-                    clusterer(dg, min(mcw, 2**31 - 1), jnp.int32(lvl_seed))
-                )
-                coarse, cmap = contract_clustering_host(current, labels)
-                if coarse.n >= (1.0 - c_ctx.convergence_threshold) * current.n:
-                    break
+                labels = clusterer(dg, min(mcw, 2**31 - 1), jnp.int32(lvl_seed))
+                if current.m <= MAX_FUSED_EDGE_SLOTS:
+                    # contraction on DEVICE (sort-based dedup kernel; see
+                    # module docstring): only the coarse CSR is pulled
+                    # back, to re-shard it for the next level's 1D node
+                    # distribution (the reference's migrate step,
+                    # global_cluster_contraction.cc:1100+)
+                    fine_dev = device_graph_from_host(current)
+                    lab_dev = jnp.asarray(labels)[: fine_dev.n_pad]
+                    if lab_dev.shape[0] < fine_dev.n_pad:
+                        lab_dev = jnp.concatenate([
+                            lab_dev,
+                            jnp.arange(lab_dev.shape[0], fine_dev.n_pad,
+                                       dtype=jnp.int32),
+                        ])
+                    coarse_dev, c_n, _c_m = contract_clustering(
+                        fine_dev, lab_dev
+                    )
+                    if c_n >= (1.0 - c_ctx.convergence_threshold) * current.n:
+                        break
+                    cmap = np.asarray(coarse_dev.cmap)[: current.n]
+                    coarse = host_graph_from_device(coarse_dev.graph)
+                else:
+                    # beyond the single-device budget: host rebuild (the
+                    # graph is sharded precisely because one device cannot
+                    # hold it — do not materialize an unsharded copy)
+                    coarse, cmap = contract_clustering_host(
+                        current, np.asarray(labels)
+                    )
+                    if coarse.n >= (
+                        1.0 - c_ctx.convergence_threshold
+                    ) * current.n:
+                        break
                 levels.append((dg, cmap, current))
                 current = coarse
 

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..context import Context
-from ..graphs.csr import DeviceGraph
+from ..graphs.csr import DeviceGraph, WEIGHT_DTYPE
 from ..ops.contraction import CoarseGraph, contract_clustering
 from ..ops.lp import LPConfig, lp_cluster
 from ..utils import timer
@@ -93,7 +93,10 @@ class Coarsener:
                     jnp.float32(c_ctx.sparsification_keep_ratio),
                     seed ^ jnp.int32(0x51A5),
                 )
-        mcw = jnp.int32(min(max_cluster_weight, 2**31 - 1))
+        mcw = jnp.asarray(
+            min(max_cluster_weight, int(jnp.iinfo(WEIGHT_DTYPE).max)),
+            dtype=WEIGHT_DTYPE,
+        )
 
         def cluster_once(cap, salt_off):
             if c_ctx.algorithm == CoarseningAlgorithm.OVERLAY_CLUSTERING:
@@ -145,7 +148,10 @@ class Coarsener:
             and retries < 3
         ):
             retries += 1
-            mcw = jnp.int32(min(int(mcw) * 2, 2**31 - 1))
+            mcw = jnp.asarray(
+                min(int(mcw) * 2, int(jnp.iinfo(WEIGHT_DTYPE).max)),
+                dtype=WEIGHT_DTYPE,
+            )
             with timer.scoped_timer("lp-clustering"):
                 labels = cluster_once(mcw, retries * 977)
                 drain(labels)

@@ -27,6 +27,7 @@ import numpy as np
 from ..context import Context
 from ..graphs.csr import (
     DeviceGraph,
+    WEIGHT_DTYPE,
     device_graph_from_host,
     host_graph_from_device,
 )
@@ -38,6 +39,8 @@ from ..utils.logger import log_progress
 from .coarsener import Coarsener
 from .refiner import RefinerPipeline
 from .rb import bipartition_max_block_weights, split_k
+
+WMAX = int(jnp.iinfo(WEIGHT_DTYPE).max)
 
 
 @dataclass
@@ -240,7 +243,7 @@ class DeepMultilevelPartitioner:
         while cur_n > stop_n:
             labels = lp_cluster(
                 current,
-                jnp.int32(min(mcw, 2**31 - 1)),
+                jnp.asarray(min(mcw, WMAX), dtype=WEIGHT_DTYPE),
                 jnp.int32((seed + 31 * len(levels)) & 0x7FFFFFFF),
             )
             coarse, c_n, _ = contract_clustering(current, labels)
@@ -259,7 +262,7 @@ class DeepMultilevelPartitioner:
         part = np.zeros(current.n_pad, dtype=np.int32)
         part[: coarsest_host.n] = bp
         part = jnp.asarray(part)
-        caps = jnp.asarray(np.minimum(max_w, 2**31 - 1), dtype=jnp.int32)
+        caps = jnp.asarray(np.minimum(max_w, WMAX), dtype=WEIGHT_DTYPE)
         for lvl, (fine_graph, coarse) in enumerate(reversed(levels)):
             part = coarse.project_up(part)
             part = lp_refine(
@@ -289,7 +292,7 @@ class DeepMultilevelPartitioner:
             ],
             dtype=np.int64,
         )
-        max_bw = jnp.asarray(np.minimum(caps, 2**31 - 1), dtype=jnp.int32)
+        max_bw = jnp.asarray(np.minimum(caps, WMAX), dtype=WEIGHT_DTYPE)
         min_bw = None
         if p.min_block_weights is not None:
             mins = np.array(
@@ -299,7 +302,7 @@ class DeepMultilevelPartitioner:
                 ],
                 dtype=np.int64,
             )
-            min_bw = jnp.asarray(np.minimum(mins, 2**31 - 1), dtype=jnp.int32)
+            min_bw = jnp.asarray(np.minimum(mins, WMAX), dtype=WEIGHT_DTYPE)
         return max_bw, min_bw
 
     def _extend_partition(

@@ -1,0 +1,96 @@
+"""64-bit weight build (KAMINPAR_TPU_64BIT=1, kaminpar_tpu/dtypes.py).
+
+The analog of the reference's KAMINPAR_64BIT_[NODE|EDGE]WEIGHTS CMake
+options (CMakeLists.txt:67-75).  The flag must be set before first
+import, so the regression runs in a subprocess: a graph whose TOTAL EDGE
+WEIGHT exceeds 2^31 — arithmetically impossible to partition correctly
+in the int32 build — must partition feasibly with the device cut
+matching an independent int64 numpy recomputation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import json
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from kaminpar_tpu.dtypes import ACC_DTYPE, X64_WEIGHTS
+assert X64_WEIGHTS
+import jax.numpy as jnp
+assert ACC_DTYPE == jnp.int64
+
+from kaminpar_tpu.graphs.factories import make_rmat
+from kaminpar_tpu.graphs.host import HostGraph, host_partition_metrics
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.utils.logger import OutputLevel
+
+base = make_rmat(1 << 11, 20_000, seed=29)
+# heavy edge weights: total edge weight ~ 40e3 * 2^17 * 2 ≈ 10.5e9 > 2^31
+rng = np.random.default_rng(1)
+m = base.m
+ew = rng.integers(1 << 15, 1 << 17, m).astype(np.int64)
+# symmetrize: weight must match for both directions of an edge
+src = base.edge_sources()
+key = np.minimum(src, base.adjncy).astype(np.int64) * (1 << 32) + np.maximum(
+    src, base.adjncy
+)
+order = np.argsort(key, kind="stable")
+ew_sym = np.empty_like(ew)
+ew_pairs = ew[order].reshape(-1, 2)
+ew_pairs[:, 1] = ew_pairs[:, 0]
+ew_sym[order] = ew_pairs.reshape(-1)
+g = HostGraph(xadj=base.xadj, adjncy=base.adjncy, edge_weights=ew_sym)
+total_ew = int(ew_sym.sum())
+assert total_ew > 2**31, total_ew
+
+p = KaMinPar("default")
+p.set_output_level(OutputLevel.QUIET)
+part = p.set_graph(g).compute_partition(k=4, epsilon=0.03, seed=1)
+res = host_partition_metrics(g, part, 4)
+
+# distributed smoke under the flag: the dist graph buffers must hold
+# int64 weights (they silently wrapped before the plumbing)
+from kaminpar_tpu.parallel import dKaMinPar
+dp = dKaMinPar(n_devices=2)
+dp.set_output_level(OutputLevel.QUIET)
+dpart = dp.set_graph(g).compute_partition(k=4, epsilon=0.03, seed=1)
+dres = host_partition_metrics(g, np.asarray(dpart), 4)
+print(json.dumps({
+    "cut": int(res["cut"]),
+    "imbalance": float(res["imbalance"]),
+    "dist_cut": int(dres["cut"]),
+    "dist_imbalance": float(dres["imbalance"]),
+    "total_edge_weight": total_ew,
+}))
+"""
+
+
+def test_64bit_build_partitions_graph_with_overflowing_edge_weights():
+    env = dict(os.environ)
+    env["KAMINPAR_TPU_64BIT"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    out = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+        timeout=1200,
+    )
+    assert out.returncode == 0, out.stderr[-4000:]
+    line = out.stdout.strip().splitlines()[-1]
+    res = json.loads(line)
+    assert res["total_edge_weight"] > 2**31
+    assert res["imbalance"] <= 0.03 + 1e-9
+    # sane cut: positive, below total edge weight / 2
+    assert 0 < res["cut"] < res["total_edge_weight"] // 2
+    assert 0 < res["dist_cut"] < res["total_edge_weight"] // 2
+    assert res["dist_imbalance"] <= 0.03 + 1e-9
