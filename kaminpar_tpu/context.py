@@ -133,6 +133,11 @@ class CoarseningContext:
     clustering: ClusteringContext = field(default_factory=ClusteringContext)
     contraction_limit: int = 2000
     convergence_threshold: float = 0.05
+    # TPU-specific limping-tail cutoff: once n <= 8 * contraction_limit,
+    # a level shrinking less than this fraction ends coarsening (every
+    # accepted level costs a full refine pass during uncoarsening; the
+    # host IP pool handles a 10-16k-node coarsest graph directly)
+    stall_threshold: float = 0.12
     # linear-time MGP (arXiv 2504.17615; SparsificationClusterCoarsener
     # analog): fraction of edges kept per level before clustering
     sparsification_keep_ratio: float = 0.5

@@ -19,8 +19,8 @@ import os
 import jax
 import jax.numpy as jnp
 
-X64_WEIGHTS = os.environ.get("KAMINPAR_TPU_64BIT", "0") not in (
-    "", "0", "false", "off",
+X64_WEIGHTS = os.environ.get("KAMINPAR_TPU_64BIT", "0").lower() not in (
+    "", "0", "false", "off", "no",
 )
 if X64_WEIGHTS:
     jax.config.update("jax_enable_x64", True)
@@ -30,6 +30,9 @@ if X64_WEIGHTS:
 ACC_DTYPE = jnp.int64 if X64_WEIGHTS else jnp.int32
 # Device weight storage matches the accumulator.
 WEIGHT_DTYPE = ACC_DTYPE
+# Largest representable weight (clamp bound for caps read from int64
+# host arrays).
+WMAX = int(jnp.iinfo(WEIGHT_DTYPE).max)
 # Gain/weight sentinel: the minimum of the accumulator dtype.  (Named for
 # the default build; under KAMINPAR_TPU_64BIT it is int64's minimum — a
 # 32-bit sentinel would collide with real 64-bit gains.)

@@ -38,9 +38,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from ..graphs.csr import DeviceGraph
+from ..graphs.csr import DeviceGraph, WEIGHT_DTYPE
 from ..utils.math import pad_size
-from ..graphs.csr import WEIGHT_DTYPE
 from .segments import ACC_DTYPE, aggregate_by_key
 
 

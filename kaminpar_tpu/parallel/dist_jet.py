@@ -151,7 +151,7 @@ def _dist_jet_impl(
         best_cut0 = jnp.where(
             is_feasible(part0),
             _local_cut(part0, src_l, dst_l, ew_l),
-            jnp.iinfo(jnp.int32).max,
+            jnp.iinfo(ACC_DTYPE).max,
         )
 
         def round_body(rnd, carry):
@@ -192,7 +192,7 @@ def _dist_jet_impl(
                 cut = _local_cut(part, src_l, dst_l, ew_l)
                 # sentinel-aware, as in ops/jet.py: until a feasible
                 # partition exists, improvement = reaching feasibility
-                has_best = best_cut < jnp.iinfo(jnp.int32).max
+                has_best = best_cut < jnp.iinfo(ACC_DTYPE).max
                 improved_enough = jnp.where(
                     has_best,
                     (best_cut - cut).astype(jnp.float32)

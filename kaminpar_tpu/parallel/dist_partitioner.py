@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from ..dtypes import WEIGHT_DTYPE, WMAX
 from ..context import Context
 from ..graphs.csr import device_graph_from_host, host_graph_from_device
 from ..graphs.host import HostGraph, contract_clustering_host
@@ -166,7 +167,7 @@ class dKaMinPar:
                     ),
                 )
                 lvl_seed = (ctx.seed * 7919 + len(levels) * 31337) & 0x7FFFFFFF
-                labels = clusterer(dg, min(mcw, 2**31 - 1), jnp.int32(lvl_seed))
+                labels = clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
                 if current.m <= MAX_FUSED_EDGE_SLOTS:
                     # contraction on DEVICE (sort-based dedup kernel; see
                     # module docstring): only the coarse CSR is pulled
@@ -236,7 +237,8 @@ class dKaMinPar:
 
         # uncoarsening + distributed refinement (deep_multilevel.cc:181+)
         max_bw = jnp.asarray(
-            self.ctx.partition.max_block_weights, dtype=jnp.int32
+            np.minimum(self.ctx.partition.max_block_weights, WMAX),
+            dtype=WEIGHT_DTYPE,
         )
         num_levels = len(levels)
         with timer.scoped_timer("dist-uncoarsening"):

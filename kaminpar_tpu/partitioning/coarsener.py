@@ -182,6 +182,19 @@ class Coarsener:
         if c_n >= (1.0 - c_ctx.convergence_threshold) * self.current_n:
             # converged: drop this level (not enough shrinkage)
             return False
+        if (
+            c_n >= (1.0 - c_ctx.stall_threshold) * self.current_n
+            and self.current_n <= 8 * c_ctx.contraction_limit
+        ):
+            # limping tail cutoff: near the contraction limit, dense
+            # near-cap graphs shrink only ~6-8% per level while every
+            # accepted level costs a full refine pass (Jet + LP + a
+            # contraction + fresh executables) during uncoarsening —
+            # profiled at the 10M bench as the dominant systemic cost.
+            # The host initial-partitioning pool handles a 10-16k-node
+            # coarsest graph directly, so declare convergence instead of
+            # limping to the threshold.
+            return False
         self.levels.append(
             CoarseningLevel(
                 fine_graph=self.current,

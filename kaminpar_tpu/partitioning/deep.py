@@ -38,9 +38,8 @@ from ..utils import timer
 from ..utils.logger import log_progress
 from .coarsener import Coarsener
 from .refiner import RefinerPipeline
+from ..dtypes import WMAX
 from .rb import bipartition_max_block_weights, split_k
-
-WMAX = int(jnp.iinfo(WEIGHT_DTYPE).max)
 
 
 @dataclass

@@ -13,6 +13,7 @@ from typing import Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..dtypes import WMAX
 from ..context import Context
 from ..graphs.csr import (
     DeviceGraph,
@@ -41,7 +42,7 @@ class KWayMultilevelPartitioner:
             dgraph = device_graph_from_host(graph)
 
         max_bw = jnp.asarray(
-            np.minimum(ctx.partition.max_block_weights, 2**31 - 1),
+            np.minimum(ctx.partition.max_block_weights, WMAX),
             dtype=jnp.int32,
         )
         min_bw = (

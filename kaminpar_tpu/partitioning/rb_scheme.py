@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from ..dtypes import WMAX
 from ..context import Context
 from ..graphs.csr import device_graph_from_host
 from ..graphs.host import HostGraph
@@ -37,7 +38,7 @@ class RBMultilevelPartitioner:
                 padded = np.zeros(dgraph.n_pad, dtype=np.int32)
                 padded[: graph.n] = part
                 max_bw = jnp.asarray(
-                    np.minimum(ctx.partition.max_block_weights, 2**31 - 1),
+                    np.minimum(ctx.partition.max_block_weights, WMAX),
                     dtype=jnp.int32,
                 )
                 min_bw = (

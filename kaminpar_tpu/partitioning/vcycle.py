@@ -15,6 +15,7 @@ from typing import List
 import jax.numpy as jnp
 import numpy as np
 
+from ..dtypes import WEIGHT_DTYPE, WMAX
 from ..context import Context
 from ..graphs.csr import device_graph_from_host
 from ..graphs.host import HostGraph
@@ -60,7 +61,7 @@ class VcycleDeepMultilevelPartitioner:
         partition = jnp.asarray(padded)
 
         max_bw = jnp.asarray(
-            np.minimum(ctx.partition.max_block_weights, 2**31 - 1),
+            np.minimum(ctx.partition.max_block_weights, WMAX),
             dtype=jnp.int32,
         )
         min_bw = (
@@ -92,7 +93,7 @@ class VcycleDeepMultilevelPartitioner:
             )
             labels = lp_cluster(
                 current,
-                jnp.int32(min(max_cw, 2**31 - 1)),
+                jnp.asarray(min(max_cw, WMAX), dtype=WEIGHT_DTYPE),
                 seed,
                 lp_cfg,
                 communities=current_part,
