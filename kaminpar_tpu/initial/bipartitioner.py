@@ -13,6 +13,7 @@ Analog of kaminpar-shm/initial_partitioning/:
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Callable, List, Optional, Tuple
 
@@ -135,10 +136,29 @@ class InitialMultilevelBipartitioner:
         rng: np.random.Generator,
     ) -> np.ndarray:
         """Coarsen -> flat pool bipartition -> uncoarsen with FM refinement.
-        Returns int8 partition of `graph`."""
+        Returns int8 partition of `graph`.
+
+        Runs the native (C++) multilevel bipartitioner when the library is
+        available — the reference's design point of sequential native
+        initial partitioning (initial_bipartitioner_worker_pool.h:42); the
+        numpy/python path below is the fallback and the behavioral spec."""
         if graph.n == 0:
             return np.zeros(0, dtype=np.int8)
         max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
+        if os.environ.get("KAMINPAR_TPU_NO_NATIVE_IP", "") != "1":
+            from .. import native
+
+            # check availability BEFORE drawing the seed: the fallback
+            # must see the same rng stream whether the native path was
+            # skipped by env flag or by a missing toolchain
+            if native.available():
+                with timer.scoped_timer("ip-native"):
+                    part = native.ml_bipartition(
+                        graph, max_block_weights, self.ctx,
+                        seed=int(rng.integers(0, 2**62)),
+                    )
+                if part is not None:
+                    return part
         with timer.scoped_timer("ip-coarsen"):
             levels = coarsen_for_bipartition(
                 graph,

@@ -21,15 +21,18 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "codec.cpp")
+_SRCS = [os.path.join(_DIR, "codec.cpp"), os.path.join(_DIR, "ip.cpp")]
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
 def _build() -> Optional[str]:
-    with open(_SRC, "rb") as f:
-        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    h = hashlib.sha256()
+    for src in _SRCS:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
     out = os.path.join(_DIR, f"libkmpnative-{tag}.so")
     if os.path.exists(out):
         return out
@@ -46,7 +49,8 @@ def _build() -> Optional[str]:
         ) as tmp:
             tmp_path = tmp.name
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp_path],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
+             "-o", tmp_path],
             check=True,
             capture_output=True,
         )
@@ -87,6 +91,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.kmp_parse_metis_body.argtypes = [
         ctypes.c_char_p, i64, i64, ctypes.c_int, ctypes.c_int, i64,
         p_i64, p_i32, p_i64, p_i64,
+    ]
+    i32 = ctypes.c_int32
+    f64 = ctypes.c_double
+    p_i8 = np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS")
+    lib.kmp_ml_bipartition.restype = i64
+    lib.kmp_ml_bipartition.argtypes = [
+        i64, p_i64, p_i32, p_i64, p_i64, i64, i64,       # graph + caps
+        i64, f64, i64,                                   # coarsening
+        i64, i64, i64, f64, i32, i32, i32, i32,          # pool
+        i32, i32, i64, f64, i64,                         # pool FM
+        i32, i32, i64, f64, i64,                         # per-level FM
+        ctypes.c_uint64, p_i8,
     ]
     _lib = lib
     return _lib
@@ -209,4 +225,59 @@ def _decode_gaps_np(n, xadj, offsets, data, out):
                 shift += 7
             prev = x - 1 if e == lo else prev + x
             out[e] = prev
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Native sequential multilevel bipartitioner (ip.cpp)
+# ---------------------------------------------------------------------------
+
+
+def ml_bipartition(graph, max_block_weights, ip_ctx, seed: int):
+    """Run the native multilevel 2-way bipartitioner on a HostGraph.
+
+    Native counterpart of initial.InitialMultilevelBipartitioner (see
+    ip.cpp header); returns an int8 partition, or None when the native
+    library is unavailable (caller falls back to the numpy path).
+    """
+    lib = get_lib()
+    if lib is None or graph.n == 0:
+        return None
+    from ..context import FMStoppingRule
+
+    xadj = np.ascontiguousarray(graph.xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(graph.adjncy, dtype=np.int32)
+    node_w = np.ascontiguousarray(graph.node_weight_array(), dtype=np.int64)
+    edge_w = np.ascontiguousarray(graph.edge_weight_array(), dtype=np.int64)
+    max_bw = np.asarray(max_block_weights, dtype=np.int64)
+    ic = ip_ctx.coarsening
+    pool = ip_ctx.pool
+    pfm = pool.refinement
+    fm = ip_ctx.refinement
+    max_cluster_weight = max(
+        1, int(ic.cluster_weight_multiplier * int(max_bw.max()))
+    )
+    out = np.empty(graph.n, dtype=np.int8)
+    lib.kmp_ml_bipartition(
+        graph.n, xadj, adjncy, node_w, edge_w,
+        int(max_bw[0]), int(max_bw[1]),
+        int(ic.contraction_limit), float(ic.convergence_threshold),
+        max_cluster_weight,
+        int(pool.min_num_repetitions),
+        int(pool.min_num_non_adaptive_repetitions),
+        int(pool.max_num_repetitions), float(pool.repetition_multiplier),
+        int(bool(pool.use_adaptive_bipartitioner_selection)),
+        int(bool(pool.enable_bfs_bipartitioner)),
+        int(bool(pool.enable_ggg_bipartitioner)),
+        int(bool(pool.enable_random_bipartitioner)),
+        int(bool(pfm.disabled)),
+        int(pfm.stopping_rule == FMStoppingRule.ADAPTIVE),
+        int(pfm.num_fruitless_moves), float(pfm.alpha),
+        int(pfm.num_iterations),
+        int(bool(fm.disabled)),
+        int(fm.stopping_rule == FMStoppingRule.ADAPTIVE),
+        int(fm.num_fruitless_moves), float(fm.alpha),
+        int(fm.num_iterations),
+        int(seed) & 0xFFFFFFFFFFFFFFFF, out,
+    )
     return out

@@ -655,6 +655,17 @@ def rating_topk_rows(
     sorts + streaming passes, no scatters.  Returns the flat tuple
     (lab1, w1, ..., lab_k, w_k), each [n_pad], read at row ends
     (end[i]-1-j); absent entries are (-1, INT32_MIN).
+
+    Pad-slot invariant: callers may key pad slots with n_pad (the
+    delta-round path) OR with n_pad-1 (the full-round path, which passes
+    owner_key=graph.src where pad edges carry owner n_pad-1).  The
+    latter is sound ONLY because node n_pad-1 is always a pad node with
+    degree 0 and an empty row span, so (a) pad slots still sort after
+    every real row's slots and (b) no real read position end[i]-1-j ever
+    lands inside them (deg[n_pad-1] == 0 gates validj).  A graph layout
+    change that gives node n_pad-1 real edges would silently corrupt the
+    top-K reads — keep the last pad row empty (see
+    DeviceGraph.from_host's padding contract).
     """
     o_s, nb_s, w_s = sort_by_two_keys(owner_key, nb, w.astype(ACC_DTYPE))
     prev_o = jnp.concatenate([jnp.array([-1], o_s.dtype), o_s[:-1]])

@@ -86,12 +86,20 @@ class DistGraph:
         return self.src.shape[0]
 
     @property
+    def num_devices(self) -> int:
+        """Mesh size D; send_idx is a [D*D, s_max] per-peer block table."""
+        import math
+
+        D = math.isqrt(self.send_idx.shape[0])
+        assert D * D == self.send_idx.shape[0], (
+            "send_idx must have D*D peer rows"
+        )
+        return D
+
+    @property
     def g_loc(self) -> int:
         """Ghost slots per device."""
-        D = self.send_idx.shape[0] and int(
-            round(self.send_idx.shape[0] ** 0.5)
-        )
-        return self.ghost_gid.shape[0] // max(D, 1)
+        return self.ghost_gid.shape[0] // max(self.num_devices, 1)
 
     @property
     def s_max(self) -> int:

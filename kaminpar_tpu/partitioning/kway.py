@@ -13,7 +13,7 @@ from typing import Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..dtypes import WMAX
+from ..dtypes import WEIGHT_DTYPE, WMAX
 from ..context import Context
 from ..graphs.csr import (
     DeviceGraph,
@@ -43,10 +43,13 @@ class KWayMultilevelPartitioner:
 
         max_bw = jnp.asarray(
             np.minimum(ctx.partition.max_block_weights, WMAX),
-            dtype=jnp.int32,
+            dtype=WEIGHT_DTYPE,
         )
         min_bw = (
-            jnp.asarray(ctx.partition.min_block_weights, dtype=jnp.int32)
+            jnp.asarray(
+                np.minimum(ctx.partition.min_block_weights, WMAX),
+                dtype=WEIGHT_DTYPE,
+            )
             if ctx.partition.min_block_weights is not None
             else None
         )

@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from ..dtypes import WMAX
+from ..dtypes import WEIGHT_DTYPE, WMAX
 from ..context import Context
 from ..graphs.csr import device_graph_from_host
 from ..graphs.host import HostGraph
@@ -39,10 +39,13 @@ class RBMultilevelPartitioner:
                 padded[: graph.n] = part
                 max_bw = jnp.asarray(
                     np.minimum(ctx.partition.max_block_weights, WMAX),
-                    dtype=jnp.int32,
+                    dtype=WEIGHT_DTYPE,
                 )
                 min_bw = (
-                    jnp.asarray(ctx.partition.min_block_weights, dtype=jnp.int32)
+                    jnp.asarray(
+                        np.minimum(ctx.partition.min_block_weights, WMAX),
+                        dtype=WEIGHT_DTYPE,
+                    )
                     if ctx.partition.min_block_weights is not None
                     else None
                 )

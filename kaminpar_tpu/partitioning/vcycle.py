@@ -62,10 +62,13 @@ class VcycleDeepMultilevelPartitioner:
 
         max_bw = jnp.asarray(
             np.minimum(ctx.partition.max_block_weights, WMAX),
-            dtype=jnp.int32,
+            dtype=WEIGHT_DTYPE,
         )
         min_bw = (
-            jnp.asarray(ctx.partition.min_block_weights, dtype=jnp.int32)
+            jnp.asarray(
+                np.minimum(ctx.partition.min_block_weights, WMAX),
+                dtype=WEIGHT_DTYPE,
+            )
             if ctx.partition.min_block_weights is not None
             else None
         )

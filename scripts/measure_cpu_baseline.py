@@ -62,10 +62,78 @@ def run_binary(binary: str, graph_path: str, k: int, eps: float, seed: int):
     )
 
 
+def _merge_into_baseline(updates: dict, drop: tuple = ()) -> None:
+    """Merge `updates` into BASELINE_CPU.json, removing `drop` keys first
+    (merge, not overwrite: keys measured by other runs survive)."""
+    path = os.path.join(os.path.dirname(__file__), "..", "BASELINE_CPU.json")
+    data = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            data = json.load(f)
+    for key in drop:
+        data.pop(key, None)
+    data.update(updates)
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+
+
+def main_large(binary: str) -> None:
+    """Measure the reference binary's phase times on the LARGE bench
+    graphs (the 10M-edge profile_pipeline graph and the scale-22 graph),
+    the scales where the repo's crossover claim lives.  Merge-updates
+    BASELINE_CPU.json with large10m_* / large22_* keys."""
+    from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.io import write_metis
+
+    configs = [
+        # (key_prefix, n, m, gen_seed, k) — must match
+        # scripts/profile_pipeline.py and the scale-22 entry already in
+        # BASELINE_CPU.json respectively
+        ("large10m", 1 << 20, 10_000_000, 7, 16),
+        ("large22", 1 << 22, 40_000_000, 22, 64),
+    ]
+    for prefix, n, m, gen_seed, k in configs:
+        host = make_rmat(n, m, seed=gen_seed)
+        with tempfile.TemporaryDirectory() as tmp:
+            graph_path = os.path.join(tmp, f"{prefix}.metis")
+            write_metis(host, graph_path)
+            del host
+            runs = [
+                run_binary(binary, graph_path, k, bench.BENCH_EPS, s)
+                for s in SEEDS
+            ]
+        best_cut = min(r[0] for r in runs)
+        coarsening_s = min((r[1] for r in runs if r[1] is not None), default=None)
+        partitioning_s = min((r[2] for r in runs if r[2] is not None), default=None)
+        seeds_str = f"{SEEDS[0]}-{SEEDS[-1]}" if len(SEEDS) > 1 else str(SEEDS[0])
+        updates = {
+            f"{prefix}_graph": f"rmat n={n} m={m} seed={gen_seed}",
+            f"{prefix}_edge_cut_k{k}": best_cut,
+            f"{prefix}_note": "reference KaMinPar binary (default preset, "
+            f"-t {THREADS} on {multiprocessing.cpu_count()} logical CPUs — "
+            "when CPUs < threads the threads time-slice, so a 1-CPU box "
+            "measures the ~sequential reference and a real 8-core run "
+            "would be FASTER (TPU-vs-CPU ratios computed against these "
+            f"times are optimistic); best of seeds {seeds_str}) full "
+            f"partition, k={k} eps={bench.BENCH_EPS}",
+        }
+        if coarsening_s is not None:
+            updates[f"{prefix}_coarsening_s"] = coarsening_s
+        if partitioning_s is not None:
+            updates[f"{prefix}_partitioning_s"] = partitioning_s
+        _merge_into_baseline(updates)
+        print(json.dumps(updates))
+
+
 def main() -> None:
-    binary = sys.argv[1] if len(sys.argv) > 1 else "/tmp/kmp_build/apps/KaMinPar"
+    args = [a for a in sys.argv[1:] if a != "--large"]
+    binary = args[0] if args else "/tmp/kmp_build/apps/KaMinPar"
     if not os.path.exists(binary):
         raise SystemExit(f"reference binary not found: {binary}")
+    if "--large" in sys.argv[1:]:
+        main_large(binary)
+        return
 
     from kaminpar_tpu.graphs.factories import make_rmat
     from kaminpar_tpu.io import write_metis
@@ -84,39 +152,29 @@ def main() -> None:
         coarsening_s = min((r[1] for r in runs if r[1] is not None), default=None)
         partitioning_s = min((r[2] for r in runs if r[2] is not None), default=None)
 
-    path = os.path.join(os.path.dirname(__file__), "..", "BASELINE_CPU.json")
-    data = {}
-    if os.path.exists(path):
-        with open(path) as f:
-            data = json.load(f)
-    for key in LEGACY_KEYS:
-        data.pop(key, None)
     seeds_str = f"{SEEDS[0]}-{SEEDS[-1]}" if len(SEEDS) > 1 else str(SEEDS[0])
-    data.update(
-        {
-            "medium_graph": f"rmat n={bench.MED_N} m={bench.MED_M} "
-            f"seed={bench.MED_SEED}",
-            "medium_edge_cut": best_cut,
-            "medium_note": "reference KaMinPar binary (default preset, "
-            f"-t {THREADS}, best of seeds {seeds_str}) full partition on "
-            f"the medium bench graph, k={bench.BENCH_K} "
-            f"eps={bench.BENCH_EPS}",
-            "cpu_cores": multiprocessing.cpu_count(),
-        }
-    )
+    updates = {
+        "medium_graph": f"rmat n={bench.MED_N} m={bench.MED_M} "
+        f"seed={bench.MED_SEED}",
+        "medium_edge_cut": best_cut,
+        "medium_note": "reference KaMinPar binary (default preset, "
+        f"-t {THREADS}, best of seeds {seeds_str}) full partition on "
+        f"the medium bench graph, k={bench.BENCH_K} "
+        f"eps={bench.BENCH_EPS}",
+        "cpu_cores": multiprocessing.cpu_count(),
+    }
     # never pair a fresh cut with stale phase times: when the timer tree
     # failed to parse, drop the old denominators instead of keeping them
+    drop = list(LEGACY_KEYS)
     if coarsening_s is not None:
-        data["medium_coarsening_s"] = coarsening_s
+        updates["medium_coarsening_s"] = coarsening_s
     else:
-        data.pop("medium_coarsening_s", None)
+        drop.append("medium_coarsening_s")
     if partitioning_s is not None:
-        data["medium_partitioning_s"] = partitioning_s
+        updates["medium_partitioning_s"] = partitioning_s
     else:
-        data.pop("medium_partitioning_s", None)
-    with open(path, "w") as f:
-        json.dump(data, f, indent=2)
-        f.write("\n")
+        drop.append("medium_partitioning_s")
+    _merge_into_baseline(updates, drop=tuple(drop))
     print(json.dumps({"medium_edge_cut": best_cut}))
 
 

@@ -2,6 +2,7 @@
 partitioning coverage inside e2e tests)."""
 
 import numpy as np
+import pytest
 
 from kaminpar_tpu.context import InitialPartitioningContext, InitialRefinementContext
 from kaminpar_tpu.graphs import factories
@@ -38,7 +39,10 @@ def test_fm_refine_reduces_cut():
     assert (_host_block_weights(g, part) <= 40).all()
 
 
-def test_multilevel_bipartition_quality_path():
+@pytest.mark.parametrize("native_ip", [True, False])
+def test_multilevel_bipartition_quality_path(native_ip, monkeypatch):
+    if not native_ip:
+        monkeypatch.setenv("KAMINPAR_TPU_NO_NATIVE_IP", "1")
     g = factories.make_path(200)
     part = bipartition(
         g, np.array([103, 103]), InitialPartitioningContext(),
@@ -47,7 +51,10 @@ def test_multilevel_bipartition_quality_path():
     assert _host_cut(g, part) <= 3  # optimum is 1
 
 
-def test_multilevel_bipartition_grid():
+@pytest.mark.parametrize("native_ip", [True, False])
+def test_multilevel_bipartition_grid(native_ip, monkeypatch):
+    if not native_ip:
+        monkeypatch.setenv("KAMINPAR_TPU_NO_NATIVE_IP", "1")
     g = factories.make_grid_graph(16, 16)
     part = bipartition(
         g, np.array([135, 135]), InitialPartitioningContext(),
@@ -58,7 +65,10 @@ def test_multilevel_bipartition_grid():
     assert (bw <= 135).all()
     assert cut <= 32  # optimum 16
 
-def test_weighted_bipartition():
+@pytest.mark.parametrize("native_ip", [True, False])
+def test_weighted_bipartition(native_ip, monkeypatch):
+    if not native_ip:
+        monkeypatch.setenv("KAMINPAR_TPU_NO_NATIVE_IP", "1")
     g = factories.make_path(20)
     g.node_weights = np.ones(20, dtype=np.int64)
     g.node_weights[0] = 10
@@ -67,3 +77,43 @@ def test_weighted_bipartition():
         np.random.default_rng(3),
     )
     assert (_host_block_weights(g, part) <= 16).all()
+
+
+def test_native_bipartitioner_matches_python_class():
+    """The native (C++) multilevel bipartitioner must produce feasible
+    partitions of the same quality class as the numpy path (it replaces
+    it whenever the toolchain is available — ip.cpp)."""
+    from kaminpar_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    g = factories.make_grid_graph(24, 24)
+    ctx = InitialPartitioningContext()
+    caps = np.array([297, 297])
+    part = native.ml_bipartition(g, caps, ctx, seed=11)
+    assert part is not None and part.dtype == np.int8
+    assert set(np.unique(part)) <= {0, 1}
+    assert (_host_block_weights(g, part) <= caps).all()
+    assert _host_cut(g, part) <= 48  # optimum 24, same band as python
+
+    # determinism: same seed, same result
+    part2 = native.ml_bipartition(g, caps, ctx, seed=11)
+    assert np.array_equal(part, part2)
+
+
+def test_native_bipartitioner_weighted_feasible():
+    from kaminpar_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(2)
+    g = factories.make_grid_graph(16, 16)
+    g.node_weights = rng.integers(1, 9, g.n).astype(np.int64)
+    total = int(g.node_weights.sum())
+    cap = int(1.05 * np.ceil(total / 2))
+    part = native.ml_bipartition(g, [cap, cap], InitialPartitioningContext(), seed=5)
+    assert (_host_block_weights(g, part) <= cap).all()
