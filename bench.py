@@ -60,6 +60,45 @@ def _init_platform() -> None:
         clear_backends()
 
 
+def _measure_large_coarsening() -> float | None:
+    """LP+coarsening wall-clock on the LARGE (10M-edge) bench graph —
+    the scale where the repo's CPU-vs-TPU comparison is meaningful (the
+    medium graph is launch-floor-dominated; see docs/performance.md).
+    Same graph and phase boundary as BASELINE_CPU.json's
+    large10m_coarsening_s (scripts/measure_cpu_baseline.py --large).
+    Returns seconds (best of two runs — the first pays executable-cache
+    loads even when compiled; the CPU denominator is likewise the
+    binary's fastest run), or None on failure (the bench line then
+    simply omits the large-graph ratio)."""
+    import time
+
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.graphs.csr import device_graph_from_host
+    from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.partitioning.coarsener import Coarsener
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    host = make_rmat(1 << 20, 10_000_000, seed=7)
+    ctx = create_context_by_preset_name("default")
+    ctx.partition.setup(host, k=BENCH_K, epsilon=BENCH_EPS)
+    ctx.seed = 1
+    best = None
+    for _ in range(2):
+        dgraph = device_graph_from_host(host)
+        int(jnp.sum(dgraph.src[:1]))  # force the upload before timing
+        coarsener = Coarsener(ctx, dgraph, host.n)
+        threshold = max(2 * ctx.coarsening.contraction_limit, 2)
+        t0 = time.perf_counter()
+        while coarsener.current_n > threshold:
+            if not coarsener.coarsen():
+                break
+        int(jnp.sum(coarsener.current.src[:1]))  # readback-synced stop
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
 def main() -> None:
     import numpy as np
 
@@ -115,6 +154,9 @@ def main() -> None:
 
     vs = 0.0
     vs_cpu = None
+    vs_cpu_10m = None
+    coarsening_10m_s = None
+    base = {}
     baseline_path = os.path.join(os.path.dirname(__file__), "BASELINE_CPU.json")
     if os.path.exists(baseline_path):
         with open(baseline_path) as f:
@@ -128,6 +170,24 @@ def main() -> None:
             # reference binary's (8-thread) coarsening on the same graph
             vs_cpu = round(cpu_coarsening / coarsening_s, 3)
 
+    # large-graph speed ratio at >=10M edges — the scale that decides
+    # the CPU-vs-TPU story (skippable for quick local runs)
+    if (
+        base.get("large10m_coarsening_s")
+        and os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1"
+    ):
+        try:
+            coarsening_10m_s = _measure_large_coarsening()
+        except Exception as e:  # never let the large run break the line
+            import sys
+
+            print(f"bench: large-graph measurement failed: {e}",
+                  file=sys.stderr)
+        if coarsening_10m_s and coarsening_10m_s > 0.01:
+            vs_cpu_10m = round(
+                base["large10m_coarsening_s"] / coarsening_10m_s, 3
+            )
+
     line = {
         "metric": "edge_cut_rmat600k_k16",
         "value": cut,
@@ -138,6 +198,10 @@ def main() -> None:
     }
     if vs_cpu is not None:
         line["vs_cpu_coarsening"] = vs_cpu
+    if coarsening_10m_s is not None:
+        line["lp_coarsening_10m_seconds"] = round(coarsening_10m_s, 2)
+    if vs_cpu_10m is not None:
+        line["vs_cpu_coarsening_10m"] = vs_cpu_10m
     print(json.dumps(line))
 
 
