@@ -45,6 +45,7 @@ from .segments import (
     expand_active_rows,
     packed_afterburner_gain,
     packed_afterburner_gain_rows,
+    prune_candidates_to_budget,
 )
 
 # Below this many edge slots the incremental machinery is not worth the
@@ -147,46 +148,35 @@ def _jet_iteration(
     candidate = (
         is_real & is_border & (lock == 0) & (gain > threshold)
     )
-    next_part = jnp.where(candidate, best, part)
 
     # ---- filter: afterburner (jet_refiner.cc:133-170) ----
     # packed metadata + streaming row sums; see
     # segments.packed_afterburner_gain (shared with LP refinement).
-    # Only edges of CANDIDATE rows contribute to the filter, so when the
-    # candidate set is small its rows are compacted into the delta buffer
-    # and the filter's two edge-wide gathers shrink to buffer width.
-    def _ab_full(args):
-        part_, next_, gain_, cand_ = args
-        return packed_afterburner_gain(
-            graph.src, graph.dst, graph.edge_w, graph.row_ptr,
-            part_, next_, gain_, cand_, k,
-        )
-
+    # Only edges of CANDIDATE rows contribute to the filter.  On large
+    # graphs the candidate set is first PRUNED to the best-gain subset
+    # whose rows fit the delta buffer (two-stage candidate pruning), so
+    # the filter's two gathers ALWAYS run at buffer width — no edge-wide
+    # fallback; pruned candidates compete again next iteration.
     if dslots is None:
-        adj_gain = _ab_full((part, next_part, gain, candidate))
-    else:
-
-        def _ab_rows(args):
-            part_, next_, gain_, cand_ = args
-            owner_c, _, edge_id, valid, start, end = expand_active_rows(
-                graph.row_ptr, graph.degrees, cand_, dslots
-            )
-            eid = jnp.clip(edge_id, 0, graph.src.shape[0] - 1)
-            dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
-            w_b = jnp.where(valid, graph.edge_w[eid], 0)
-            return packed_afterburner_gain_rows(
-                owner_c, dst_b, w_b, start, end,
-                part_, next_, gain_, cand_, k,
-            )
-
-        cand_edges = jnp.sum(
-            jnp.where(candidate, graph.degrees, 0), dtype=jnp.int32
+        next_part = jnp.where(candidate, best, part)
+        adj_gain = packed_afterburner_gain(
+            graph.src, graph.dst, graph.edge_w, graph.row_ptr,
+            part, next_part, gain, candidate, k,
         )
-        adj_gain = lax.cond(
-            cand_edges <= dslots,
-            _ab_rows,
-            _ab_full,
-            (part, next_part, gain, candidate),
+    else:
+        candidate = prune_candidates_to_budget(
+            candidate, gain, graph.degrees, salt ^ 0x5BD1E995, dslots
+        )
+        next_part = jnp.where(candidate, best, part)
+        owner_c, _, edge_id, valid, start, end = expand_active_rows(
+            graph.row_ptr, graph.degrees, candidate, dslots
+        )
+        eid = jnp.clip(edge_id, 0, graph.src.shape[0] - 1)
+        dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
+        w_b = jnp.where(valid, graph.edge_w[eid], 0)
+        adj_gain = packed_afterburner_gain_rows(
+            owner_c, dst_b, w_b, start, end,
+            part, next_part, gain, candidate, k,
         )
     accept = candidate & (adj_gain > 0)
 

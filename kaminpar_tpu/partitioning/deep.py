@@ -48,6 +48,13 @@ class _BlockSpan:
     count: int  # number of final blocks
 
 
+# Below this many edge slots the old host extraction (small readback +
+# numpy) wins over minting device extraction programs; above it the
+# device path avoids a full-graph readback per k-doubling
+# (subgraph_extractor.h:36-177 analog, ops/subgraphs.py).
+DEVICE_EXTEND_MIN_EDGE_SLOTS = 1 << 22
+
+
 def compute_k_for_n(n: int, ctx: Context) -> int:
     """partition_utils.cc:94-101."""
     C = ctx.coarsening.contraction_limit
@@ -218,14 +225,31 @@ class DeepMultilevelPartitioner:
     def _device_bipartition(
         self, sub: HostGraph, max_block_weights: np.ndarray, rng
     ) -> np.ndarray:
+        """Host-graph entry: upload, then run the device bipartition
+        (passing `sub` down avoids a readback when coarsening converges
+        immediately)."""
+        dg = device_graph_from_host(sub)
+        part = self._device_bipartition_dev(
+            dg, sub.n, sub.m, max_block_weights, rng, host_sub=sub
+        )
+        return np.asarray(part)[: sub.n].astype(np.int8)
+
+    def _device_bipartition_dev(
+        self, dg: DeviceGraph, n: int, m: int,
+        max_block_weights: np.ndarray, rng,
+        host_sub: HostGraph | None = None,
+    ):
         """Bipartition a large block subgraph through the device pipeline:
         LP coarsening + contraction on device until ~2000 nodes, host pool
         bipartition of the coarsest, then per-level 2-way LP refinement on
         device (the large-block replacement for the sequential
         InitialMultilevelBipartitioner inside extend_partition,
-        helper.cc:220 — same structure, device-speed hot loops)."""
+        helper.cc:220 — same structure, device-speed hot loops).  Takes
+        and returns DEVICE arrays (i32[n_pad], 0/1) — the caller decides
+        whether the result ever visits the host."""
         from ..ops.contraction import contract_clustering
         from ..ops.lp import lp_cluster, lp_refine
+        from ..ops.subgraphs import host_graph_from_padded
 
         ctx = self.ctx
         ic = ctx.initial_partitioning.coarsening
@@ -233,9 +257,8 @@ class DeepMultilevelPartitioner:
         max_w = np.asarray(max_block_weights, dtype=np.int64)
         mcw = max(1, int(ic.cluster_weight_multiplier * max_w.max()))
 
-        dg = device_graph_from_host(sub)
         levels = []
-        current, cur_n = dg, sub.n
+        current, cur_n = dg, n
         # hand off to the sequential host pool at the same scale the main
         # pipeline does (deep coarsening threshold = 2 * contraction_limit)
         stop_n = max(2, 2 * ctx.coarsening.contraction_limit)
@@ -251,9 +274,12 @@ class DeepMultilevelPartitioner:
             levels.append((current, coarse))
             current, cur_n = coarse.graph, c_n
 
-        coarsest_host = (
-            sub if not levels else host_graph_from_device(current)
-        )
+        if levels:
+            coarsest_host = host_graph_from_device(current)
+        elif host_sub is not None:
+            coarsest_host = host_sub  # already in hand — no readback
+        else:
+            coarsest_host = host_graph_from_padded(dg, n, m)
         bp = InitialMultilevelBipartitioner(
             ctx.initial_partitioning
         ).bipartition(coarsest_host, max_w, rng)
@@ -273,11 +299,10 @@ class DeepMultilevelPartitioner:
         # bipartitioner would have run per level (initial_fm_refiner.h:68)
         from ..ops.jet import jet_refine
 
-        part = jet_refine(
+        return jet_refine(
             dg, part, 2, caps, jnp.int32(seed ^ 0x2545F491),
             ctx.refinement.jet,
         )
-        return np.asarray(part)[: sub.n].astype(np.int8)
 
     def _current_block_weights(self, k: int):
         ctx = self.ctx
@@ -308,7 +333,89 @@ class DeepMultilevelPartitioner:
         self, dgraph: DeviceGraph, partition, spans, next_k: int, rng
     ):
         """extend_partition (helper.cc:220,349): bipartition each block that
-        still spans more than one final block, until current_k == next_k."""
+        still spans more than one final block, until current_k == next_k.
+
+        Large levels run the DEVICE extraction (ops/subgraphs.py — no
+        full-graph readback); small levels keep the host path, whose
+        readback is cheap and whose numpy extraction needs no extra
+        device programs."""
+        if dgraph.m_pad >= DEVICE_EXTEND_MIN_EDGE_SLOTS:
+            return self._extend_partition_device(
+                dgraph, partition, spans, next_k, rng
+            )
+        return self._extend_partition_host(
+            dgraph, partition, spans, next_k, rng
+        )
+
+    def _extend_partition_device(
+        self, dgraph: DeviceGraph, partition, spans, next_k: int, rng
+    ):
+        """Device-side extend_partition: block-major extraction on device,
+        per-block bipartitions (device pipeline for big blocks, host pool
+        for small ones — only the small blocks and coarsest sub-levels are
+        ever downloaded), partition assembly on device."""
+        from ..graphs.csr import shape_floors
+        from ..ops.subgraphs import (
+            assemble_extended_partition,
+            extract_blocks_device,
+            host_graph_from_padded,
+            scatter_block_bipartition,
+            slice_block,
+        )
+
+        ctx = self.ctx
+        with timer.scoped_timer("extend-partition"):
+            current_k = len(spans)
+            ext = extract_blocks_device(dgraph, partition, current_k)
+            n_floor, m_floor = shape_floors()
+            bp_global = jnp.zeros(dgraph.n_pad, dtype=jnp.int32)
+            bipartitioner = InitialMultilevelBipartitioner(
+                ctx.initial_partitioning
+            )
+            new_spans: List[_BlockSpan] = []
+            base_ids = np.zeros(current_k, dtype=np.int32)
+            is_split = np.zeros(current_k, dtype=bool)
+            next_id = 0
+            for bidx, span in enumerate(spans):
+                base_ids[bidx] = next_id
+                if span.count <= 1:
+                    new_spans.append(span)
+                    next_id += 1
+                    continue
+                is_split[bidx] = True
+                sub, n_b, m_b = slice_block(ext, bidx, n_floor, m_floor)
+                max_w = bipartition_max_block_weights(
+                    ctx, span.first, span.count,
+                    int(ext.block_weights[bidx]),
+                )
+                if n_b >= ctx.partitioning.device_bipartition_threshold:
+                    bp = self._device_bipartition_dev(
+                        sub, n_b, m_b, max_w, rng
+                    )
+                else:
+                    host_sub = host_graph_from_padded(sub, n_b, m_b)
+                    bp_np = bipartitioner.bipartition(host_sub, max_w, rng)
+                    padded = np.zeros(sub.n_pad, dtype=np.int32)
+                    padded[:n_b] = bp_np
+                    bp = jnp.asarray(padded)
+                bp_global = scatter_block_bipartition(
+                    bp_global, bp, ext.node_start[bidx], jnp.int32(n_b),
+                    sub.n_pad,
+                )
+                k0, k1 = split_k(span.count)
+                new_spans.append(_BlockSpan(span.first, k0))
+                new_spans.append(_BlockSpan(span.first + k0, k1))
+                next_id += 2
+            new_part = assemble_extended_partition(
+                ext.b, ext.new_id, ext.node_start, bp_global,
+                jnp.asarray(base_ids), jnp.asarray(is_split), current_k,
+            )
+            self._spans = new_spans
+            return new_part, new_spans, len(new_spans)
+
+    def _extend_partition_host(
+        self, dgraph: DeviceGraph, partition, spans, next_k: int, rng
+    ):
         ctx = self.ctx
         with timer.scoped_timer("extend-partition"):
             host = host_graph_from_device(dgraph)

@@ -127,3 +127,91 @@ def test_overlay_preset_partitions(rgg2d):
     )
     assert part.shape == (rgg2d.n,)
     assert part.min() >= 0 and part.max() < 4
+
+
+# ---------------------------------------------------------------------------
+# Device-side block-induced subgraph extraction (ops/subgraphs.py)
+# ---------------------------------------------------------------------------
+
+
+def test_device_block_extraction_matches_host():
+    """The device extraction must produce the same per-block subgraphs as
+    the host extractor (graphs/host.extract_block_subgraphs), up to the
+    shared block-major node ordering."""
+    import numpy as np
+
+    from kaminpar_tpu.graphs import factories
+    from kaminpar_tpu.graphs.csr import device_graph_from_host
+    from kaminpar_tpu.graphs.host import extract_block_subgraphs
+    from kaminpar_tpu.ops.subgraphs import (
+        extract_blocks_device,
+        host_graph_from_padded,
+        slice_block,
+    )
+
+    g = factories.make_rmat(1 << 9, 4_000, seed=9)
+    rng = np.random.default_rng(3)
+    k = 4
+    part = rng.integers(0, k, g.n).astype(np.int64)
+
+    dg = device_graph_from_host(g)
+    import jax.numpy as jnp
+
+    padded = np.zeros(dg.n_pad, dtype=np.int32)
+    padded[: g.n] = part
+    ext = extract_blocks_device(dg, jnp.asarray(padded), k)
+    host_ext = extract_block_subgraphs(g, part, k)
+
+    for b in range(k):
+        sub_dev, n_b, m_b = slice_block(ext, b, 16, 16)
+        sub_host = host_ext.subgraphs[b]
+        assert n_b == sub_host.n
+        assert m_b == sub_host.m
+        got = host_graph_from_padded(sub_dev, n_b, m_b)
+        # both extractors number block nodes in ascending global id, so
+        # the CSR must match exactly
+        np.testing.assert_array_equal(got.xadj, sub_host.xadj)
+        # neighbor sets per row match (row-internal order may differ)
+        for u in range(n_b):
+            np.testing.assert_array_equal(
+                np.sort(got.adjncy[got.xadj[u]:got.xadj[u + 1]]),
+                np.sort(sub_host.adjncy[sub_host.xadj[u]:sub_host.xadj[u + 1]]),
+            )
+        np.testing.assert_array_equal(
+            got.node_weight_array(), sub_host.node_weight_array()
+        )
+    # block weights agree with a host recomputation
+    nw = g.node_weight_array()
+    for b in range(k):
+        assert int(ext.block_weights[b]) == int(nw[part == b].sum())
+
+
+def test_device_extend_partition_end_to_end(monkeypatch):
+    """Force the device extend_partition path on a small graph and check
+    it produces a feasible partition in the same cut class as the host
+    path (deep.py _extend_partition_device)."""
+    import numpy as np
+
+    from kaminpar_tpu import kaminpar as kmp_mod
+    from kaminpar_tpu.graphs import factories
+    from kaminpar_tpu.graphs.host import host_partition_metrics
+    from kaminpar_tpu.partitioning import deep as deep_mod
+
+    g = factories.make_rmat(1 << 11, 16_000, seed=4)
+    k, eps = 8, 0.03
+
+    def run():
+        p = kmp_mod.KaMinPar("default")
+        from kaminpar_tpu.utils.logger import OutputLevel
+
+        p.set_output_level(OutputLevel.QUIET)
+        return p.set_graph(g).compute_partition(k=k, epsilon=eps, seed=2)
+
+    host_part = run()
+    monkeypatch.setattr(deep_mod, "DEVICE_EXTEND_MIN_EDGE_SLOTS", 1)
+    dev_part = run()
+    res_h = host_partition_metrics(g, host_part, k)
+    res_d = host_partition_metrics(g, dev_part, k)
+    cap = (1 + eps) * np.ceil(g.node_weight_array().sum() / k)
+    assert res_d["block_weights"].max() <= cap
+    assert res_d["cut"] <= 1.15 * res_h["cut"]
