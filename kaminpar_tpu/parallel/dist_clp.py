@@ -8,15 +8,18 @@ computed at the start of a superstep stay exact for every node moved in it
 (the reference motivates the design the same way, clp_refiner.cc:1-70).
 
 Per superstep (color c):
-  1. nodes of color c rate adjacent blocks from the replicated partition
-     (local segmented reduction over the device's edge shard);
+  1. nodes of color c rate adjacent blocks from the owner-sharded
+     partition state (part_l + ghost slice — local segmented reduction
+     over the device's edge shard);
   2. positive-gain moves under the per-block weight caps are selected;
   3. capacity safety across devices uses the same psum'd demand throttle as
      dist_lp (the reference instead commits probabilistically and rolls
      back, clp_refiner.cc `handle_node` + move rollback);
-  4. one `all_gather` republishes the owned label slices, one `psum` folds
-     the block-weight deltas — the collective form of the reference's
-     ghost-block sync (graphutils/synchronization.h:21).
+  4. one O(interface) mesh.halo_exchange republishes the changed labels
+     to ghosts, one `psum` folds the block-weight deltas — the collective
+     form of the reference's ghost-block sync
+     (graphutils/synchronization.h:21).  The single O(n) all_gather runs
+     at loop exit.
 
 The whole refinement — coloring supersteps x iterations — is one
 `shard_map`'d XLA program.
@@ -47,7 +50,7 @@ from ..ops.segments import (
 )
 from .dist_coloring import dist_greedy_coloring
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS, throttled_local_capacity
+from .mesh import NODE_AXIS, halo_exchange, throttled_local_capacity
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "num_iterations"))
@@ -62,29 +65,33 @@ def _dist_clp_impl(
     seed: jax.Array,
     num_iterations: int,
 ):
-    def per_device(src_l, dst_l, ew_l, nw_l, n, part0, colors, num_colors,
+    def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
+                   send_idx_l, recv_map_l, part0, colors, num_colors,
                    cap, seed):
         n_loc = nw_l.shape[0]
+        g_loc = ghost_gid_l.shape[0]
         d = lax.axis_index(NODE_AXIS)
         offset = (d * n_loc).astype(jnp.int32)
         node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
         seg = src_l - offset
+        dstloc_c = jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)
         colors_l = lax.dynamic_slice(colors, (offset,), (n_loc,))
+        part_l0 = lax.dynamic_slice(part0, (offset,), (n_loc,))
+        ghost0 = part0[jnp.clip(ghost_gid_l, 0, part0.shape[0] - 1)]
 
         bw0 = lax.psum(
             jax.ops.segment_sum(
                 nw_l.astype(ACC_DTYPE),
-                jnp.clip(lax.dynamic_slice(part0, (offset,), (n_loc,)), 0, k - 1),
+                jnp.clip(part_l0, 0, k - 1),
                 num_segments=k,
             ),
             NODE_AXIS,
         )
 
-        def superstep(part, bw, c, salt):
-            part_l = lax.dynamic_slice(part, (offset,), (n_loc,))
+        def superstep(part_l, ghost, bw, c, salt):
             eligible = (colors_l == c) & (node_ids_l < n)
 
-            neigh_block = part[dst_l]
+            neigh_block = jnp.concatenate([part_l, ghost])[dstloc_c]
             seg_g, key_g, w_g = aggregate_by_key(seg, neigh_block, ew_l)
             key_c = jnp.clip(key_g, 0, k - 1)
             seg_c = jnp.clip(seg_g, 0, n_loc - 1)
@@ -108,50 +115,54 @@ def _dist_clp_impl(
             )
 
             new_part_l = jnp.where(accept_l, target_l, part_l)
-            new_part = lax.all_gather(new_part_l, NODE_AXIS, tiled=True)
+            new_ghost = halo_exchange(
+                new_part_l, send_idx_l, recv_map_l, g_loc
+            )
             delta = lax.psum(
                 move_weight_delta(part_l, target_l, accept_l, nw_l, k),
                 NODE_AXIS,
             )
-            return new_part, bw + delta
+            return new_part_l, new_ghost, bw + delta
 
         def iter_body(i, carry):
-            part, bw = carry
+            part_l, ghost, bw = carry
 
-            def color_body(c, carry2):
-                part, bw = carry2
+            def color_cond_body(state):
+                c, part_l, ghost, bw = state
                 salt = (
                     seed.astype(jnp.int32) * 48271
                     + i * 16807
                     + c * 1566083941
                 ) & 0x7FFFFFFF
-                return superstep(part, bw, c, salt)
+                part_l, ghost, bw = superstep(part_l, ghost, bw, c, salt)
+                return (c + 1, part_l, ghost, bw)
 
-            def color_cond_body(state):
-                c, part, bw = state
-                part, bw = color_body(c, (part, bw))
-                return (c + 1, part, bw)
-
-            _, part, bw = lax.while_loop(
+            _, part_l, ghost, bw = lax.while_loop(
                 lambda s: s[0] < num_colors,
                 color_cond_body,
-                (jnp.int32(0), part, bw),
+                (jnp.int32(0), part_l, ghost, bw),
             )
-            return (part, bw)
+            return (part_l, ghost, bw)
 
-        part, _ = lax.fori_loop(
-            0, num_iterations, iter_body, (part0, bw0)
+        part_l, _, _ = lax.fori_loop(
+            0, num_iterations, iter_body, (part_l0, ghost0, bw0)
         )
-        return part
+        # ONE O(n) gather at loop exit
+        return lax.all_gather(part_l, NODE_AXIS, tiled=True)
 
     return _shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(NODE_AXIS),) * 4 + (P(),) * 6,
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(), P(), P(), P(),
+        ),
         out_specs=P(),
         check_vma=False,
     )(
-        graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
+        graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
+        graph.n, graph.ghost_gid, graph.send_idx, graph.recv_map,
         partition, colors, num_colors, max_block_weights, seed,
     )
 

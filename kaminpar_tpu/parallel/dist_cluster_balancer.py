@@ -24,14 +24,17 @@ halves but expresses them bulk-synchronously:
            edges to the home block are the loss term (the reference's
            cluster gain, cluster_balancer.cc ClustersMemoryContext).
 
-  select   cluster candidates live in leader slots of a node-indexed
-           vector; one all_gather replicates them and every device runs
-           the identical capacity-respecting prefix commit
+  select   each device locally sorts out its TOP-T cluster candidates by
+           relative gain (the per-PE priority queue) and all_gathers the
+           [T] candidate tuples — O(D*T) volume, not O(n); every device
+           runs the identical capacity-respecting prefix commit
            (ops/segments.accept_prefix_by_capacity) — the collective
            replacement for the reduction tree + rank-0 pick + broadcast.
 
-  apply    members adopt their leader's accepted target; block weights
-           stay replicated via the same commit arithmetic on every device.
+  apply    members adopt their leader's accepted target locally (clusters
+           never span devices); one O(interface) mesh.halo_exchange
+           republishes the changed labels to ghosts.  The single O(n)
+           all_gather runs at loop exit.
 
 Used by the hybrid refinement pipeline when the node balancer alone cannot
 reach feasibility (factories.cc HYBRID_CLUSTER_BALANCER lineage).
@@ -60,13 +63,14 @@ from ..ops.segments import (
     argmax_per_segment,
     hash_u32,
 )
+from .dist_balancer import topk_candidate_commit
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, halo_exchange
 
 
 def _build_local_clusters(
-    src_l, dst_l, ew_l, nw_l, offset, n_loc, part_l, part,
-    in_overloaded, limit_of_block, k, salt, merge_rounds,
+    src_l, dst_l, ew_l, nw_l, offset, n_loc, part_l, part_tab,
+    in_overloaded, limit_of_block, k, salt, merge_rounds, dstloc_c,
 ):
     """Agglomerate owned overloaded-block nodes into move clusters.
 
@@ -94,9 +98,7 @@ def _build_local_clusters(
             labels[jnp.clip(dst_l - offset, 0, n_loc - 1)],
             -1,
         )
-        same_block = dst_local & (
-            part[jnp.clip(dst_l, 0, part.shape[0] - 1)] == part_l[seg]
-        )
+        same_block = dst_local & (part_tab[dstloc_c] == part_l[seg])
         # rate cluster-to-cluster: rows live at the *leader's* slot, so a
         # cluster weighs all its members' edges when picking a merge target
         key = jnp.where(
@@ -146,18 +148,25 @@ def _build_local_clusters(
     return labels, cw
 
 
+CLUSTER_CANDIDATES_PER_DEVICE = 2048
+
+
 def dist_cluster_balance_round(
-    src_l, dst_l, ew_l, nw_l, n, part, k, cap, salt, merge_rounds
-) -> Tuple[jax.Array, jax.Array]:
+    src_l, dst_l, dstloc_l, ew_l, nw_l, n, part_l, ghost_part,
+    send_idx_l, recv_map_l, k, cap, salt, merge_rounds,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One cluster-balancing round inside shard_map: build clusters, rate,
-    globally commit, apply.  Returns (new replicated partition, #moved)."""
+    top-T candidate gather + identical commit, apply locally.  Operates on
+    the owner-sharded partition; returns (part_l, ghost_part, #moved,
+    still_overloaded)."""
     n_loc = nw_l.shape[0]
-    n_pad = part.shape[0]
+    g_loc = ghost_part.shape[0]
     d = lax.axis_index(NODE_AXIS)
     offset = (d * n_loc).astype(jnp.int32)
     node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
     seg = src_l - offset
-    part_l = lax.dynamic_slice(part, (offset,), (n_loc,))
+    dstloc_c = jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)
+    part_tab = jnp.concatenate([part_l, ghost_part])
 
     bw = lax.psum(
         jax.ops.segment_sum(
@@ -177,14 +186,13 @@ def dist_cluster_balance_round(
     )
 
     labels_l, cw_l = _build_local_clusters(
-        src_l, dst_l, ew_l, nw_l, offset, n_loc, part_l, part,
-        in_overloaded, limit_of_block, k, salt, merge_rounds,
+        src_l, dst_l, ew_l, nw_l, offset, n_loc, part_l, part_tab,
+        in_overloaded, limit_of_block, k, salt, merge_rounds, dstloc_c,
     )
 
     # -- rate clusters against adjacent blocks ---------------------------
     seg_c = jnp.clip(seg, 0, n_loc - 1)
     lab_of_src = labels_l[seg_c]
-    dst_c = jnp.clip(dst_l, 0, n_pad - 1)
     dst_local = (dst_l >= offset) & (dst_l < offset + n_loc)
     lab_of_dst = jnp.where(
         dst_local, labels_l[jnp.clip(dst_l - offset, 0, n_loc - 1)], -2
@@ -193,7 +201,7 @@ def dist_cluster_balance_round(
     # rating rows live at the *leader's* local slot
     leader_slot = jnp.where(lab_of_src >= 0, lab_of_src - offset, -1)
     key_block = jnp.where(
-        (lab_of_src >= 0) & ~intra & (dst_l < n), part[dst_c], -1
+        (lab_of_src >= 0) & ~intra & (dst_l < n), part_tab[dstloc_c], -1
     )
     seg_m = jnp.where(key_block >= 0, leader_slot, -1)
     seg_g, key_g, w_g = aggregate_by_key(seg_m, key_block, ew_l)
@@ -236,39 +244,42 @@ def dist_cluster_balance_round(
     gain_l = jnp.where(cand, gain_l, 0)
     cwc_l = jnp.where(cand, cw_l, 0)
 
-    # -- replicate candidates; identical deterministic commit everywhere --
-    target = lax.all_gather(target_l, NODE_AXIS, tiled=True)
-    gain = lax.all_gather(gain_l, NODE_AXIS, tiled=True)
-    cw = lax.all_gather(cwc_l, NODE_AXIS, tiled=True)
-
-    order_key = -relative_gain_key(gain, cw)
-    src_block = jnp.where(target >= 0, jnp.clip(part, 0, k - 1), -1)
-    accept_out = accept_prefix_by_capacity(
-        src_block, order_key, cw, overload, reach=True
+    # -- shared top-T gather + identical commit (see dist_balancer) ------
+    order_l = -relative_gain_key(gain_l, cwc_l)
+    T = min(CLUSTER_CANDIDATES_PER_DEVICE, n_loc)
+    do, tgt_T, lid_T, accept, cw_g, tgt_g, src_block = topk_candidate_commit(
+        target_l, order_l, cwc_l, part_l, overload, headroom, T, k, d,
     )
-    target2 = jnp.where(accept_out, target, -1)
-    accept_in = accept_prefix_by_capacity(target2, order_key, cw, headroom)
-    accept = accept_out & accept_in  # indexed by global leader id
 
-    # -- apply: members follow their leader ------------------------------
-    lab_c = jnp.clip(labels_l, 0, n_pad - 1)
-    member_moves = (labels_l >= 0) & accept[lab_c]
+    # -- apply: members follow their leader (always local) ---------------
+    accepted_leader = (
+        jnp.zeros(n_loc, dtype=jnp.bool_)
+        .at[lid_T]
+        .set(do, mode="drop")
+    )
+    tgt_of_leader = (
+        jnp.full(n_loc, -1, dtype=jnp.int32)
+        .at[lid_T]
+        .set(jnp.where(do, tgt_T, -1), mode="drop")
+    )
+    lab_slot = jnp.clip(labels_l - offset, 0, n_loc - 1)
+    member_moves = (labels_l >= 0) & accepted_leader[lab_slot]
     new_part_l = jnp.where(
-        member_moves, jnp.clip(target[lab_c], 0, k - 1), part_l
+        member_moves, jnp.clip(tgt_of_leader[lab_slot], 0, k - 1), part_l
     )
-    new_part = lax.all_gather(new_part_l, NODE_AXIS, tiled=True)
+    new_ghost = halo_exchange(new_part_l, send_idx_l, recv_map_l, g_loc)
     moved = jnp.sum(accept.astype(jnp.int32))
-    # post-move block weights from the (replicated) accepted candidates —
+    # post-move block weights from the gathered accepted candidates —
     # saves the cond() a second cross-device weight reduction
-    moved_w = jnp.where(accept, cw, 0)
+    moved_w = jnp.where(accept, cw_g, 0)
     delta_in = jax.ops.segment_sum(
-        moved_w, jnp.clip(target, 0, k - 1), num_segments=k
+        moved_w, jnp.clip(tgt_g, 0, k - 1), num_segments=k
     )
     delta_out = jax.ops.segment_sum(
         moved_w, jnp.clip(src_block, 0, k - 1), num_segments=k
     )
     still_overloaded = jnp.any(bw - delta_out + delta_in > cap)
-    return new_part, moved, still_overloaded
+    return new_part_l, new_ghost, moved, still_overloaded
 
 
 @partial(
@@ -277,32 +288,47 @@ def dist_cluster_balance_round(
 def _dist_cluster_balance_impl(
     mesh, graph, partition, k, cap, seed, max_rounds, merge_rounds
 ):
-    def per_device(src_l, dst_l, ew_l, nw_l, n, part0, cap, seed):
+    def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
+                   send_idx_l, recv_map_l, part0, cap, seed):
+        n_loc = nw_l.shape[0]
+        d = lax.axis_index(NODE_AXIS)
+        offset = (d * n_loc).astype(jnp.int32)
+        part_l0 = lax.dynamic_slice(part0, (offset,), (n_loc,))
+        ghost0 = part0[jnp.clip(ghost_gid_l, 0, part0.shape[0] - 1)]
+
         def cond(state):
-            i, part, moved, still_overloaded = state
+            i, _, _, moved, still_overloaded = state
             return (i < max_rounds) & (moved != 0) & still_overloaded
 
         def body(state):
-            i, part, _, _ = state
+            i, part_l, ghost, _, _ = state
             salt = (seed.astype(jnp.int32) * 48611 + i * 104729) & 0x7FFFFFFF
-            part, moved, still = dist_cluster_balance_round(
-                src_l, dst_l, ew_l, nw_l, n, part, k, cap, salt, merge_rounds
+            part_l, ghost, moved, still = dist_cluster_balance_round(
+                src_l, dst_l, dstloc_l, ew_l, nw_l, n, part_l, ghost,
+                send_idx_l, recv_map_l, k, cap, salt, merge_rounds,
             )
-            return (i + 1, part, moved, still)
+            return (i + 1, part_l, ghost, moved, still)
 
-        _, part, _, _ = lax.while_loop(
-            cond, body, (jnp.int32(0), part0, jnp.int32(1), jnp.array(True))
+        _, part_l, _, _, _ = lax.while_loop(
+            cond, body,
+            (jnp.int32(0), part_l0, ghost0, jnp.int32(1), jnp.array(True)),
         )
-        return part
+        # ONE O(n) gather at loop exit
+        return lax.all_gather(part_l, NODE_AXIS, tiled=True)
 
     return _shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(NODE_AXIS),) * 4 + (P(),) * 4,
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(), P(),
+        ),
         out_specs=P(),
         check_vma=False,
     )(
-        graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
+        graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
+        graph.n, graph.ghost_gid, graph.send_idx, graph.recv_map,
         partition, cap, seed,
     )
 

@@ -33,44 +33,47 @@ except ImportError:  # pragma: no cover
 
 from ..ops.segments import hash_u32
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, halo_exchange
 
 
 @partial(jax.jit, static_argnames=("mesh", "max_rounds"))
 def _dist_coloring_impl(mesh, graph: DistGraph, seed, max_rounds: int):
-    n_pad = graph.n_pad
-
-    def per_device(src_l, dst_l, ew_l, nw_l, n, seed):
+    def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
+                   send_idx_l, recv_map_l, seed):
         n_loc = nw_l.shape[0]
+        g_loc = ghost_gid_l.shape[0]
         d = lax.axis_index(NODE_AXIS)
         offset = (d * n_loc).astype(jnp.int32)
         node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
         seg = src_l - offset
+        seg_c = jnp.clip(seg, 0, n_loc - 1)
+        dstloc_c = jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)
         is_real_l = node_ids_l < n
 
-        # fixed random priority per pass (Jones-Plassmann); ties broken by id
-        prio = hash_u32(jnp.arange(n_pad, dtype=jnp.int32), seed)
+        # fixed random priority per pass (Jones-Plassmann); ties broken by
+        # id.  Priorities are pure hashes of GLOBAL ids, so neighbors'
+        # priorities come straight from dst_l — only the colored/uncolored
+        # status needs the ghost halo.
+        prio_l = hash_u32(node_ids_l, seed)
+        neigh_prio_hash = hash_u32(dst_l, seed)
 
         def cond(state):
-            rnd, colors, uncolored = state
+            rnd, _, _, uncolored = state
             return (rnd < max_rounds) & (uncolored != 0)
 
         def body(state):
-            rnd, colors, _ = state
-            colors_l = lax.dynamic_slice(colors, (offset,), (n_loc,))
+            rnd, colors_l, ghost_colors, _ = state
             un_l = (colors_l < 0) & is_real_l
-            prio_l = prio[node_ids_l]
 
             # priority of uncolored neighbors (colored/pad neighbors are
             # inert); lexicographic (prio, id) strict-minimum test via two
-            # segment mins — uint64 keys are unavailable without x64
-            # pad edges point at the global pad node, which is never
-            # colored — exclude it or it blocks its endpoint forever
-            un_full = colors < 0
-            neigh_un = un_full[dst_l] & (dst_l < n)
-            seg_c = jnp.clip(seg, 0, n_loc - 1)
+            # segment mins — uint64 keys are unavailable without x64.
+            # pad edges point at the pad node, which is never colored —
+            # exclude it (dst_l < n) or it blocks its endpoint forever
+            tab = jnp.concatenate([colors_l, ghost_colors])
+            neigh_un = (tab[dstloc_c] < 0) & (dst_l < n)
             neigh_prio = jnp.where(
-                neigh_un, prio[dst_l], jnp.iinfo(jnp.int32).max
+                neigh_un, neigh_prio_hash, jnp.iinfo(jnp.int32).max
             )
             min_p = jax.ops.segment_min(
                 neigh_prio, seg_c, num_segments=n_loc
@@ -87,35 +90,53 @@ def _dist_coloring_impl(mesh, graph: DistGraph, seed, max_rounds: int):
             )
 
             new_colors_l = jnp.where(winner, rnd, colors_l)
-            new_colors = lax.all_gather(new_colors_l, NODE_AXIS, tiled=True)
+            new_ghost = halo_exchange(
+                new_colors_l, send_idx_l, recv_map_l, g_loc
+            )
             uncolored = lax.psum(
                 jnp.sum(((new_colors_l < 0) & is_real_l).astype(jnp.int32)),
                 NODE_AXIS,
             )
-            return (rnd + 1, new_colors, uncolored)
+            return (rnd + 1, new_colors_l, new_ghost, uncolored)
 
-        colors0 = jnp.full(n_pad, -1, dtype=jnp.int32)
-        rounds, colors, _ = lax.while_loop(
-            cond, body, (jnp.int32(0), colors0, jnp.int32(1))
+        colors0_l = jnp.full(n_loc, -1, dtype=jnp.int32)
+        ghost0 = jnp.full(g_loc, -1, dtype=jnp.int32)
+        rounds, colors_l, _, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), colors0_l, ghost0, jnp.int32(1))
         )
         # leftovers past max_rounds (pathological priority chains): each
         # gets its OWN fresh color so the independent-set guarantee of
-        # every color class survives even without convergence
-        leftover = (colors < 0) & (jnp.arange(n_pad, dtype=jnp.int32) < n)
+        # every color class survives even without convergence.  The
+        # device-prefix offsets come from an O(D) gather of counts.
+        leftover = (colors_l < 0) & is_real_l
+        count_l = jnp.sum(leftover.astype(jnp.int32))
+        counts = lax.all_gather(count_l, NODE_AXIS)  # [D]
+        prefix = jnp.sum(jnp.where(
+            jnp.arange(counts.shape[0]) < d, counts, 0
+        )).astype(jnp.int32)
         rank = jnp.cumsum(leftover.astype(jnp.int32)) - leftover.astype(
             jnp.int32
         )
-        colors = jnp.where(leftover, rounds + rank, colors)
+        colors_l = jnp.where(leftover, rounds + prefix + rank, colors_l)
+        # exit-only O(n) gather
+        colors = lax.all_gather(colors_l, NODE_AXIS, tiled=True)
         num_colors = jnp.max(colors) + 1
         return colors, num_colors
 
     return _shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(NODE_AXIS),) * 4 + (P(), P()),
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(),
+        ),
         out_specs=(P(), P()),
         check_vma=False,
-    )(graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n, seed)
+    )(
+        graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
+        graph.n, graph.ghost_gid, graph.send_idx, graph.recv_map, seed,
+    )
 
 
 def dist_greedy_coloring(

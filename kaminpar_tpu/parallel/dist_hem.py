@@ -40,78 +40,102 @@ from ..ops.segments import (
     argmax_per_segment,
 )
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, halo_exchange
 
 
 @partial(jax.jit, static_argnames=("mesh", "num_rounds"))
 def _dist_hem_impl(mesh, graph: DistGraph, max_cluster_weight, seed,
                    num_rounds: int):
-    n_pad = graph.n_pad
-
-    def per_device(src_l, dst_l, ew_l, nw_l, n, cap, seed):
+    def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
+                   send_idx_l, recv_map_l, cap, seed):
         n_loc = nw_l.shape[0]
+        g_loc = ghost_gid_l.shape[0]
         d = lax.axis_index(NODE_AXIS)
         offset = (d * n_loc).astype(jnp.int32)
         node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
         seg = src_l - offset
+        seg_c = jnp.clip(seg, 0, n_loc - 1)
+        dstloc_c = jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)
         is_real_l = node_ids_l < n
-        nw_full = lax.all_gather(nw_l, NODE_AXIS, tiled=True)
+        # static ghost node weights: one exchange at entry
+        ghost_nw = halo_exchange(nw_l, send_idx_l, recv_map_l, g_loc)
+        nw_tab = jnp.concatenate([nw_l, ghost_nw])
 
-        def round_body(rnd, labels):
-            # matched nodes carry a foreign label (or own one as a leader
-            # with a partner); a node is available iff it is a singleton
-            # leader of itself and nobody joined it
-            matched = labels != jnp.arange(n_pad, dtype=jnp.int32)
-            # a leader whose id was adopted by someone else is matched too
-            adopted = jnp.zeros(n_pad, dtype=jnp.int32).at[
-                jnp.clip(labels, 0, n_pad - 1)
-            ].max(matched.astype(jnp.int32))
-            available = ~matched & (adopted == 0)
-
-            labels_l = lax.dynamic_slice(labels, (offset,), (n_loc,))
-            avail_l = lax.dynamic_slice(available, (offset,), (n_loc,))
-
-            # propose: heaviest available neighbor under the weight cap
+        def round_body(rnd, state):
+            labels_l, avail_l, ghost_avail = state
             salt = (seed.astype(jnp.int32) * 69621 + rnd * 7919) & 0x7FFFFFFF
-            seg_g, key_g, w_g = aggregate_by_key(seg, dst_l, ew_l)
+            avail_tab = jnp.concatenate([avail_l, ghost_avail])
+
+            # propose: heaviest available neighbor under the weight cap.
+            # Grouping key is the LOCAL slot so the chosen partner's own
+            # proposal can be read from the halo table below
+            seg_g, key_g, w_g = aggregate_by_key(seg, dstloc_c, ew_l)
+            key_c = jnp.clip(key_g, 0, n_loc + g_loc - 1)
             feas_g = (
-                available[jnp.clip(key_g, 0, n_pad - 1)]
+                (avail_tab[key_c] > 0)
                 & (
-                    nw_full[jnp.clip(key_g, 0, n_pad - 1)].astype(ACC_DTYPE)
+                    nw_tab[key_c].astype(ACC_DTYPE)
                     + nw_l[jnp.clip(seg_g, 0, n_loc - 1)].astype(ACC_DTYPE)
                     <= cap
                 )
                 & (seg_g >= 0)
             )
-            prop_l, _ = argmax_per_segment(
+            prop_slot_l, _ = argmax_per_segment(
                 seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=feas_g
             )
-            prop_l = jnp.where(avail_l & is_real_l, prop_l, -1)
-            prop = lax.all_gather(prop_l, NODE_AXIS, tiled=True)
-
-            # handshake: mutual proposals match; label both min(u, v)
-            partner = jnp.where(
-                (prop_l >= 0)
-                & (prop[jnp.clip(prop_l, 0, n_pad - 1)] == node_ids_l),
-                prop_l,
+            proposes = (avail_l > 0) & is_real_l & (prop_slot_l >= 0)
+            slot_c = jnp.clip(prop_slot_l, 0, n_loc + g_loc - 1)
+            # the partner's GLOBAL id, from the slot (owned or ghost)
+            prop_gid_l = jnp.where(
+                proposes,
+                jnp.where(
+                    prop_slot_l < n_loc,
+                    offset + prop_slot_l,
+                    ghost_gid_l[jnp.clip(prop_slot_l - n_loc, 0, g_loc - 1)],
+                ),
                 -1,
             )
-            new_labels_l = jnp.where(
-                partner >= 0, jnp.minimum(node_ids_l, partner), labels_l
+            # publish proposals (as global ids) to ghosts, then handshake:
+            # mutual proposals match; label both min(u, v)
+            ghost_prop = halo_exchange(
+                prop_gid_l, send_idx_l, recv_map_l, g_loc
             )
-            return lax.all_gather(new_labels_l, NODE_AXIS, tiled=True)
+            prop_tab = jnp.concatenate([prop_gid_l, ghost_prop])
+            partner_gid = jnp.where(
+                proposes & (prop_tab[slot_c] == node_ids_l), prop_gid_l, -1
+            )
+            matched = partner_gid >= 0
+            new_labels_l = jnp.where(
+                matched, jnp.minimum(node_ids_l, partner_gid), labels_l
+            )
+            new_avail_l = jnp.where(matched, 0, avail_l)
+            new_ghost_avail = halo_exchange(
+                new_avail_l, send_idx_l, recv_map_l, g_loc
+            )
+            return (new_labels_l, new_avail_l, new_ghost_avail)
 
-        labels0 = jnp.arange(n_pad, dtype=jnp.int32)
-        return lax.fori_loop(0, num_rounds, round_body, labels0)
+        labels0_l = node_ids_l
+        avail0_l = is_real_l.astype(jnp.int32)
+        ghost_avail0 = halo_exchange(avail0_l, send_idx_l, recv_map_l, g_loc)
+        labels_l, _, _ = lax.fori_loop(
+            0, num_rounds, round_body, (labels0_l, avail0_l, ghost_avail0)
+        )
+        # exit-only O(n) gather
+        return lax.all_gather(labels_l, NODE_AXIS, tiled=True)
 
     return _shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(NODE_AXIS),) * 4 + (P(),) * 3,
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(),
+        ),
         out_specs=P(),
         check_vma=False,
     )(
-        graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
+        graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
+        graph.n, graph.ghost_gid, graph.send_idx, graph.recv_map,
         max_cluster_weight, seed,
     )
 

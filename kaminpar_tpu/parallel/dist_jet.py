@@ -4,19 +4,24 @@ Analog of the reference's distributed Jet refiner
 (kaminpar-dist/refinement/jet/jet_refiner.cc), which runs the same
 find/filter/execute/rebalance scheme as the shared-memory Jet
 (see ops/jet.py) with ghost-synchronized block IDs.  Bulk-synchronous Jet
-is already the natural fit for SPMD: per iteration each device
+is already the natural fit for SPMD; the partition state is OWNER-SHARDED
+(part_l i32[n_loc] + ghost slice i32[g_loc]) and every per-iteration
+collective is O(interface) or O(k):
 
-  1. finds candidate moves for its owned nodes from the replicated
-     partition (local segmented reductions over its edge shard);
-  2. publishes per-node (candidate gain) via `all_gather` — the ghost sync
-     that the reference does with a sparse alltoall — and runs the
-     afterburner filter locally (each edge is stored at both endpoints, so
-     every device sees all edges incident to its nodes);
-  3. executes accepted moves and republishes the label slices;
-  4. rebalances with the distributed node balancer
-     (parallel/dist_balancer.dist_balance_round);
-  5. tracks the best partition by the psum'd edge cut and rolls back to it
-     at the end of each round (jet_refiner.cc best-partition snapshots).
+  1. find: candidate moves for owned nodes from the local edge shard +
+     ghost block table (local segmented reductions);
+  2. filter: the afterburner needs each interface neighbor's (candidate
+     gain, destination) — one stacked mesh.halo_exchange, the reference's
+     sparse alltoall (graphutils/communication.h:242);
+  3. execute: accepted moves apply locally; one halo exchange republishes
+     the changed labels to ghosts;
+  4. rebalance with the distributed node balancer
+     (parallel/dist_balancer.dist_balance_round — top-T candidate gather,
+     O(D*T));
+  5. best-partition snapshots by the psum'd edge cut, rollback at round
+     end (jet_refiner.cc best-partition snapshots).
+
+The one O(n) all_gather runs at loop exit.
 """
 
 from __future__ import annotations
@@ -43,34 +48,40 @@ from ..ops.segments import (
 )
 from .dist_balancer import dist_balance_round
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS
+from .mesh import NODE_AXIS, halo_exchange
 
 
-def _local_cut(part, src_l, dst_l, ew_l):
-    """Global edge cut: each undirected edge is stored at both endpoints,
-    so the psum of local sums counts every cut edge twice."""
-    local = jnp.sum(
-        jnp.where(part[src_l] != part[dst_l], ew_l, 0).astype(ACC_DTYPE)
-    )
+def _local_cut(part_l, ghost_part, seg, dstloc_l, ew_l):
+    """Global edge cut from the owner-sharded state: each undirected edge
+    is stored at both endpoints, so the psum counts every cut edge twice."""
+    n_loc = part_l.shape[0]
+    tab = jnp.concatenate([part_l, ghost_part])
+    own = part_l[jnp.clip(seg, 0, n_loc - 1)]
+    nb = tab[jnp.clip(dstloc_l, 0, tab.shape[0] - 1)]
+    local = jnp.sum(jnp.where(own != nb, ew_l, 0).astype(ACC_DTYPE))
     return lax.psum(local, NODE_AXIS) // 2
 
 
 def _jet_iteration_dist(
-    src_l, dst_l, ew_l, nw_l, n, part, lock_l, k, cap, gain_temp, salt
+    src_l, dst_l, dstloc_l, ew_l, nw_l, n, part_l, ghost_part, lock_l,
+    k, cap, gain_temp, salt, send_idx_l, recv_map_l,
 ):
     n_loc = nw_l.shape[0]
+    g_loc = ghost_part.shape[0]
     d = lax.axis_index(NODE_AXIS)
     offset = (d * n_loc).astype(jnp.int32)
     node_ids_l = offset + jnp.arange(n_loc, dtype=jnp.int32)
     seg = src_l - offset
-    part_l = lax.dynamic_slice(part, (offset,), (n_loc,))
+    seg_c = jnp.clip(seg, 0, n_loc - 1)
+    dstloc_c = jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)
+    tab = jnp.concatenate([part_l, ghost_part])
     is_real_l = node_ids_l < n
 
     # ---- find (jet_refiner.cc:104-131) ----
-    neigh_block = part[dst_l]
+    neigh_block = tab[dstloc_c]
     seg_g, key_g, w_g = aggregate_by_key(seg, neigh_block, ew_l)
-    seg_c = jnp.clip(seg_g, 0, n_loc - 1)
-    is_ext = (seg_g >= 0) & (key_g != part_l[seg_c])
+    sgc = jnp.clip(seg_g, 0, n_loc - 1)
+    is_ext = (seg_g >= 0) & (key_g != part_l[sgc])
     best, best_conn = argmax_per_segment(
         seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=is_ext
     )
@@ -82,37 +93,38 @@ def _jet_iteration_dist(
     candidate_l = is_real_l & (best >= 0) & (lock_l == 0) & (gain_l > threshold)
     next_part_l = jnp.where(candidate_l, best, part_l)
 
-    # ---- filter: afterburner needs every candidate's (gain, destination)
-    # — the ghost sync, here two tiled all_gathers ----
-    gain_full = lax.all_gather(
-        jnp.where(candidate_l, gain_l, INT32_MIN), NODE_AXIS, tiled=True
+    # ---- filter: afterburner — one stacked halo exchange publishes the
+    # interface nodes' (candidate gain, destination) to their ghosts ----
+    gain_cand_l = jnp.where(candidate_l, gain_l, INT32_MIN)
+    ghost_gain, ghost_next = halo_exchange(
+        jnp.stack([gain_cand_l, next_part_l]), send_idx_l, recv_map_l, g_loc
     )
-    next_part = lax.all_gather(next_part_l, NODE_AXIS, tiled=True)
+    gain_tab = jnp.concatenate([gain_cand_l, ghost_gain])
+    next_tab = jnp.concatenate([next_part_l, ghost_next])
 
-    gain_u = gain_full[src_l]
-    gain_v = gain_full[dst_l]
+    gain_u = gain_cand_l[seg_c]
+    gain_v = gain_tab[dstloc_c]
     v_is_cand = gain_v > INT32_MIN
+    # total order across devices: global ids break ties
     v_before_u = v_is_cand & (
         (gain_v > gain_u) | ((gain_v == gain_u) & (dst_l < src_l))
     )
-    block_v = jnp.where(v_before_u, next_part[dst_l], part[dst_l])
-    to_u = next_part[src_l]
-    from_u = part[src_l]
+    block_v = jnp.where(v_before_u, next_tab[dstloc_c], tab[dstloc_c])
+    to_u = next_part_l[seg_c]
+    from_u = part_l[seg_c]
     contrib = jnp.where(
         to_u == block_v, ew_l, jnp.where(from_u == block_v, -ew_l, 0)
     )
     adj_gain = jax.ops.segment_sum(
-        jnp.where(candidate_l[jnp.clip(seg, 0, n_loc - 1)], contrib, 0),
-        jnp.clip(seg, 0, n_loc - 1),
-        num_segments=n_loc,
+        jnp.where(candidate_l[seg_c], contrib, 0), seg_c, num_segments=n_loc
     )
     accept_l = candidate_l & (adj_gain > 0)
 
     # ---- execute ----
     new_part_l = jnp.where(accept_l, next_part_l, part_l)
-    new_part = lax.all_gather(new_part_l, NODE_AXIS, tiled=True)
+    new_ghost = halo_exchange(new_part_l, send_idx_l, recv_map_l, g_loc)
     new_lock_l = accept_l.astype(jnp.int32)
-    return new_part, new_lock_l
+    return new_part_l, new_ghost, new_lock_l
 
 
 @partial(
@@ -127,13 +139,16 @@ def _dist_jet_impl(
     initial_gain_temp, final_gain_temp, fruitless_threshold,
     num_rounds, max_iterations, max_fruitless, balancer_rounds,
 ):
-    def per_device(src_l, dst_l, ew_l, nw_l, n, part0, cap, seed):
+    def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
+                   send_idx_l, recv_map_l, part0, cap, seed):
         n_loc = nw_l.shape[0]
         d = lax.axis_index(NODE_AXIS)
         offset = (d * n_loc).astype(jnp.int32)
+        seg = src_l - offset
+        part_l0 = lax.dynamic_slice(part0, (offset,), (n_loc,))
+        ghost0 = part0[jnp.clip(ghost_gid_l, 0, part0.shape[0] - 1)]
 
-        def is_feasible(part):
-            part_l = lax.dynamic_slice(part, (offset,), (n_loc,))
+        def is_feasible(part_l):
             bw = lax.psum(
                 jax.ops.segment_sum(
                     nw_l.astype(ACC_DTYPE),
@@ -147,15 +162,14 @@ def _dist_jet_impl(
         # best-partition snapshots track the best FEASIBLE cut; an
         # infeasible input must not pin the snapshot (its cut can be
         # arbitrarily low — e.g. everything in one block cuts nothing)
-        best0 = part0
         best_cut0 = jnp.where(
-            is_feasible(part0),
-            _local_cut(part0, src_l, dst_l, ew_l),
+            is_feasible(part_l0),
+            _local_cut(part_l0, ghost0, seg, dstloc_l, ew_l),
             jnp.iinfo(ACC_DTYPE).max,
         )
 
         def round_body(rnd, carry):
-            part, best, best_cut = carry
+            part_l, ghost, best_l, best_cut = carry
             gain_temp = jnp.where(
                 num_rounds > 1,
                 initial_gain_temp
@@ -170,26 +184,42 @@ def _dist_jet_impl(
                 return (i < max_iterations) & (fruitless < max_fruitless)
 
             def iter_body(state):
-                i, fruitless, part, lock_l, best, best_cut = state
+                i, fruitless, part_l, ghost, lock_l, best_l, best_cut = state
                 salt = (
                     seed.astype(jnp.int32) * 31321
                     + rnd * 2221
                     + i * 1566083941
                 ) & 0x7FFFFFFF
-                part, lock_l = _jet_iteration_dist(
-                    src_l, dst_l, ew_l, nw_l, n, part, lock_l, k, cap,
-                    gain_temp, salt,
+                part_l, ghost, lock_l = _jet_iteration_dist(
+                    src_l, dst_l, dstloc_l, ew_l, nw_l, n, part_l, ghost,
+                    lock_l, k, cap, gain_temp, salt, send_idx_l, recv_map_l,
                 )
 
-                def bal_body(j, p):
-                    s = (salt + j * 7919) & 0x7FFFFFFF
-                    p2, _ = dist_balance_round(
-                        src_l, dst_l, ew_l, nw_l, n, p, k, cap, s
-                    )
-                    return p2
+                # run the balancer to feasibility (or a dry round), not a
+                # fixed count: a round moves at most D*T nodes, so big
+                # post-move overloads need batching.  Feasible partitions
+                # exit after the first (cheap) overload check.
+                def bal_cond(state):
+                    j, _, _, moved, still = state
+                    return (j < 4 * balancer_rounds) & (moved != 0) & still
 
-                part = lax.fori_loop(0, balancer_rounds, bal_body, part)
-                cut = _local_cut(part, src_l, dst_l, ew_l)
+                def bal_body(state):
+                    j, p, g_, _, _ = state
+                    s = (salt + j * 7919) & 0x7FFFFFFF
+                    p2, g2, moved, still = dist_balance_round(
+                        src_l, dst_l, dstloc_l, ew_l, nw_l, n, p, g_,
+                        send_idx_l, recv_map_l, k, cap, s,
+                    )
+                    return (j + 1, p2, g2, moved, still)
+
+                _, part_l, ghost, _, _ = lax.while_loop(
+                    bal_cond, bal_body,
+                    (
+                        jnp.int32(0), part_l, ghost, jnp.int32(1),
+                        ~is_feasible(part_l),
+                    ),
+                )
+                cut = _local_cut(part_l, ghost, seg, dstloc_l, ew_l)
                 # sentinel-aware, as in ops/jet.py: until a feasible
                 # partition exists, improvement = reaching feasibility
                 has_best = best_cut < jnp.iinfo(ACC_DTYPE).max
@@ -198,35 +228,49 @@ def _dist_jet_impl(
                     (best_cut - cut).astype(jnp.float32)
                     > (1.0 - fruitless_threshold)
                     * jnp.abs(best_cut).astype(jnp.float32),
-                    is_feasible(part),
+                    is_feasible(part_l),
                 )
                 fruitless = jnp.where(improved_enough, 0, fruitless + 1)
-                is_best = (cut <= best_cut) & is_feasible(part)
-                best = jnp.where(is_best, part, best)
+                is_best = (cut <= best_cut) & is_feasible(part_l)
+                best_l = jnp.where(is_best, part_l, best_l)
                 best_cut = jnp.where(is_best, cut, best_cut)
-                return (i + 1, fruitless, part, lock_l, best, best_cut)
+                return (
+                    i + 1, fruitless, part_l, ghost, lock_l, best_l, best_cut
+                )
 
             lock0 = jnp.zeros(n_loc, dtype=jnp.int32)
-            (_, _, part, _, best, best_cut) = lax.while_loop(
+            (_, _, part_l, ghost, _, best_l, best_cut) = lax.while_loop(
                 iter_cond,
                 iter_body,
-                (jnp.int32(0), jnp.int32(0), part, lock0, best, best_cut),
+                (
+                    jnp.int32(0), jnp.int32(0), part_l, ghost, lock0,
+                    best_l, best_cut,
+                ),
             )
-            return (best, best, best_cut)
+            # rollback to best; re-sync ghosts from it
+            ghost_best = halo_exchange(best_l, send_idx_l, recv_map_l,
+                                       ghost.shape[0])
+            return (best_l, ghost_best, best_l, best_cut)
 
-        _, best, _ = lax.fori_loop(
-            0, num_rounds, round_body, (part0, best0, best_cut0)
+        _, _, best_l, _ = lax.fori_loop(
+            0, num_rounds, round_body, (part_l0, ghost0, part_l0, best_cut0)
         )
-        return best
+        # ONE O(n) gather at loop exit
+        return lax.all_gather(best_l, NODE_AXIS, tiled=True)
 
     return _shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(NODE_AXIS),) * 4 + (P(),) * 4,
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(), P(),
+        ),
         out_specs=P(),
         check_vma=False,
     )(
-        graph.src, graph.dst, graph.edge_w, graph.node_w, graph.n,
+        graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
+        graph.n, graph.ghost_gid, graph.send_idx, graph.recv_map,
         partition, cap, seed,
     )
 
