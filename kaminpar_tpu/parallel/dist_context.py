@@ -22,7 +22,7 @@ from typing import Callable, List
 
 import jax.numpy as jnp
 
-from ..context import Context, JetRefinementContext
+from ..context import Context, JetRefinementContext, PartitioningMode
 from ..ops.lp import LPConfig
 from ..presets import create_context_by_preset_name
 
@@ -56,6 +56,10 @@ class DistContext:
     the coarsest-graph initial partitioning."""
 
     shm: Context = field(default_factory=lambda: create_context_by_preset_name("default"))
+    # DEEP (deep_multilevel.cc lineage: coarsest partitioned at a reduced
+    # k' with block spans, k doubled by mesh-side extension during
+    # uncoarsening) or KWAY (kway_multilevel.cc: full k at the coarsest)
+    mode: PartitioningMode = PartitioningMode.DEEP
     clustering: DistClusteringAlgorithm = DistClusteringAlgorithm.GLOBAL_LP
     refinement: List[DistRefinementAlgorithm] = field(
         default_factory=lambda: [

@@ -1,0 +1,194 @@
+"""Sharded distributed cluster contraction over the device mesh.
+
+Analog of the reference's global contraction
+(kaminpar-dist/coarsening/contraction/global_cluster_contraction.cc, in
+particular the coarse node/edge migration alltoalls at :1100+): build the
+coarse graph from a clustering WITHOUT ever materializing the fine graph
+on one device.  Per device inside `shard_map`:
+
+  1. map the local edge shard to coarse endpoints (labels and the dense
+     leader->coarse-id map are replicated — both are O(n) arrays the
+     driver already holds);
+  2. locally deduplicate (cu, cv) pairs with one sort-based
+     aggregate_by_key — the per-PE rating-map dedup of the reference;
+  3. MIGRATE: bucket the deduplicated rows by the owner device of cu
+     (contiguous coarse-id chunks) and exchange them with ONE static
+     [D, cap] all_to_all — the reference's sparse alltoall of coarse
+     edges;
+  4. merge rows arriving from different source devices with a second
+     aggregate_by_key; every (cu, cv) pair now lives exactly once, on
+     cu's owner.
+
+The host driver assembles the per-shard results into the coarse CSR (the
+shards have disjoint, ascending cu ranges, so assembly is a concatenate)
+— the coarse graph is geometrically smaller, and the fine edge list never
+leaves its shards.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..graphs.host import HostGraph
+from ..ops.segments import ACC_DTYPE, aggregate_by_key
+from .dist_graph import DistGraph
+from .mesh import NODE_AXIS
+
+# output rows per device = OUT_FACTOR * m_loc; a device's merged coarse
+# rows exceed its fine edge shard only under extreme skew — the driver
+# checks the returned count and raises rather than truncating
+OUT_FACTOR = 2
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
+                              c_n):
+    D = int(mesh.devices.size)
+    n_pad = graph.n_pad
+
+    def per_device(src_l, dst_l, ew_l, n, labels, cmap_full, c_n):
+        cap = src_l.shape[0]  # m_loc
+        # coarse-id ownership chunks over the COARSE id range [0, c_n):
+        # chunking by n_pad would send every row to device 0 (coarse ids
+        # are a small prefix of the padded fine range)
+        chunk = jnp.maximum((c_n + D - 1) // D, 1)
+        # 1. coarse endpoints of the local edge shard
+        lab_src = labels[jnp.clip(src_l, 0, n_pad - 1)]
+        lab_dst = labels[jnp.clip(dst_l, 0, n_pad - 1)]
+        cu = cmap_full[jnp.clip(lab_src, 0, n_pad - 1)]
+        cv = cmap_full[jnp.clip(lab_dst, 0, n_pad - 1)]
+        keep = (src_l < n) & (dst_l < n) & (cu != cv)
+
+        # 2. local dedup (rows compacted to the front, sorted by (cu, cv)).
+        # Invalid rows use a LARGE sentinel, not -1: aggregate_by_key sorts
+        # groups by key ascending, and the valid rows must form the PREFIX
+        big = jnp.int32(n_pad)
+        seg = jnp.where(keep, cu, big)
+        seg_g, key_g, w_g = aggregate_by_key(seg, jnp.where(keep, cv, big), ew_l)
+        rows_valid = (seg_g >= 0) & (seg_g < big)
+
+        # 3. migrate: bucket rows by cu's owner device; rows are sorted by
+        # cu, so the target is monotone and the in-bucket position is a
+        # running index
+        tgt = jnp.where(rows_valid, seg_g // chunk, D).astype(jnp.int32)
+        idx = jnp.arange(cap, dtype=jnp.int32)
+        start = jax.ops.segment_min(
+            jnp.where(rows_valid, idx, cap), tgt, num_segments=D + 1
+        )
+        pos = idx - start[jnp.clip(tgt, 0, D - 1)]
+        flat = jnp.where(
+            rows_valid & (pos < cap), tgt * cap + pos, D * cap
+        )
+
+        def to_buckets(vals, fill):
+            buf = (
+                jnp.full(D * cap + 1, fill, dtype=vals.dtype)
+                .at[flat]
+                .set(jnp.where(rows_valid, vals, fill), mode="drop")
+            )
+            return buf[: D * cap].reshape(D, cap)
+
+        send_cu = to_buckets(seg_g, jnp.int32(-1))
+        send_cv = to_buckets(key_g, jnp.int32(-1))
+        send_w = to_buckets(w_g, jnp.zeros((), ACC_DTYPE))
+        recv_cu = lax.all_to_all(send_cu, NODE_AXIS, 0, 0, tiled=True)
+        recv_cv = lax.all_to_all(send_cv, NODE_AXIS, 0, 0, tiled=True)
+        recv_w = lax.all_to_all(send_w, NODE_AXIS, 0, 0, tiled=True)
+
+        # 4. merge duplicates arriving from different source devices (the
+        # same large-sentinel rule keeps valid rows as the prefix)
+        seg2 = recv_cu.reshape(-1)
+        cv2 = recv_cv.reshape(-1)
+        seg_f, key_f, w_f = aggregate_by_key(
+            jnp.where(seg2 >= 0, seg2, big),
+            jnp.where(seg2 >= 0, cv2, big),
+            recv_w.reshape(-1),
+        )
+        valid_f = (seg_f >= 0) & (seg_f < big)
+        count = jnp.sum(valid_f.astype(jnp.int32))
+        out_cap = OUT_FACTOR * cap
+        return seg_f[:out_cap], key_f[:out_cap], w_f[:out_cap], count[None]
+
+    return _shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(
+            P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+            P(), P(), P(), P(),
+        ),
+        out_specs=(P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS)),
+        check_vma=False,
+    )(
+        graph.src, graph.dst, graph.edge_w, graph.n,
+        labels, cmap_full, c_n,
+    )
+
+
+def dist_contract_clustering(
+    graph: DistGraph,
+    dg_host_n: int,
+    node_w: np.ndarray,
+    labels: np.ndarray,
+) -> Tuple[HostGraph, np.ndarray]:
+    """Contract a clustering of the sharded graph; returns (coarse
+    HostGraph, cmap fine->coarse).  The coarse edge list is produced by
+    the sharded migrate kernel above; only coarse-sized data reaches the
+    host."""
+    n_pad = graph.n_pad
+    lab = np.asarray(labels, dtype=np.int64)
+    used = np.zeros(n_pad, dtype=bool)
+    used[lab[:dg_host_n]] = True
+    cmap_full = (np.cumsum(used) - 1).astype(np.int32)
+    c_n = int(used.sum())
+    cmap = cmap_full[lab[:dg_host_n]]
+
+    cu_s, cv_s, w_s, counts = _dist_contract_edges_impl(
+        graph.src.sharding.mesh, graph, jnp.asarray(lab, jnp.int32),
+        jnp.asarray(cmap_full), jnp.int32(c_n),
+    )
+    D = int(graph.src.sharding.mesh.devices.size)
+    cu_s = np.asarray(cu_s).reshape(D, -1)
+    cv_s = np.asarray(cv_s).reshape(D, -1)
+    w_s = np.asarray(w_s).reshape(D, -1)
+    counts = np.asarray(counts).reshape(-1)
+    out_cap = cu_s.shape[1]
+    if (counts > out_cap).any():
+        raise RuntimeError(
+            "sharded contraction overflow: a device's merged coarse rows "
+            f"exceed {out_cap}; raise dist_contraction.OUT_FACTOR"
+        )
+    # shards hold disjoint ascending cu chunks and are (cu, cv)-sorted, so
+    # concatenation in device order is globally sorted
+    parts_cu = [cu_s[d, : counts[d]] for d in range(D)]
+    parts_cv = [cv_s[d, : counts[d]] for d in range(D)]
+    parts_w = [w_s[d, : counts[d]] for d in range(D)]
+    g_cu = np.concatenate(parts_cu) if parts_cu else np.zeros(0, np.int64)
+    g_cv = np.concatenate(parts_cv)
+    g_w = np.concatenate(parts_w).astype(np.int64)
+
+    c_node_w = np.zeros(c_n, dtype=np.int64)
+    np.add.at(c_node_w, cmap, np.asarray(node_w[:dg_host_n], dtype=np.int64))
+    xadj = np.zeros(c_n + 1, dtype=np.int64)
+    np.add.at(xadj, g_cu.astype(np.int64) + 1, 1)
+    xadj = np.cumsum(xadj)
+    coarse = HostGraph(
+        xadj=xadj,
+        adjncy=g_cv.astype(np.int32),
+        node_weights=c_node_w,
+        edge_weights=(
+            g_w if len(g_w) and not (g_w == 1).all() else None
+        ),
+    )
+    return coarse, cmap

@@ -42,8 +42,9 @@ from jax.sharding import Mesh
 from ..dtypes import WEIGHT_DTYPE, WMAX
 from ..context import Context
 from ..graphs.csr import device_graph_from_host, host_graph_from_device
-from ..graphs.host import HostGraph, contract_clustering_host
+from ..graphs.host import HostGraph
 from ..ops.contraction import contract_clustering
+from .dist_contraction import dist_contract_clustering
 from ..ops.segments import MAX_FUSED_EDGE_SLOTS
 from ..utils import timer
 from ..utils.logger import log
@@ -190,11 +191,14 @@ class dKaMinPar:
                     cmap = np.asarray(coarse_dev.cmap)[: current.n]
                     coarse = host_graph_from_device(coarse_dev.graph)
                 else:
-                    # beyond the single-device budget: host rebuild (the
-                    # graph is sharded precisely because one device cannot
-                    # hold it — do not materialize an unsharded copy)
-                    coarse, cmap = contract_clustering_host(
-                        current, np.asarray(labels)
+                    # beyond the single-device budget: SHARDED contraction
+                    # (per-shard dedup + coarse-edge migrate all_to_all,
+                    # parallel/dist_contraction.py — the
+                    # global_cluster_contraction.cc:1100+ analog); the
+                    # fine edge list never leaves its shards
+                    coarse, cmap = dist_contract_clustering(
+                        dg, current.n, current.node_weight_array(),
+                        np.asarray(labels),
                     )
                     if coarse.n >= (
                         1.0 - c_ctx.convergence_threshold
@@ -203,12 +207,28 @@ class dKaMinPar:
                 levels.append((dg, cmap, current))
                 current = coarse
 
+        # DEEP mode partitions the coarsest at a reduced k' and doubles k
+        # on the mesh during uncoarsening; KWAY partitions at full k
+        from ..context import PartitioningMode
+
+        deep = self.ctx.mode == PartitioningMode.DEEP
+        if deep:
+            from ..partitioning.deep import compute_k_for_n
+
+            ip_k = max(2, min(k, compute_k_for_n(current.n, self.ctx.shm)))
+        else:
+            ip_k = k
+        spans = self._initial_spans(ip_k, k)
+
         # initial partitioning: shm pipeline on the coarsest graph.  The
         # reference replicates the coarsest graph onto every PE, runs shm
         # KaMinPar per PE with that PE's seed, and keeps the best cut
         # (replicate_graph_everywhere + distribute_best_partition,
         # kaminpar-dist/partitioning/deep_multilevel.cc:125-176).  One
-        # host plays all PEs: independent seeded runs, best cut wins.
+        # host plays all PEs: independent seeded runs with best-cut
+        # selection are the mesh-subgroup replication analog — each
+        # replica coarsens the handed-over graph further through its own
+        # shm hierarchy, like the reference's independent PE subgroups.
         with timer.scoped_timer("dist-initial-partitioning"):
             from ..kaminpar import KaMinPar
             from ..utils.logger import OutputLevel, output_level, set_output_level
@@ -225,7 +245,7 @@ class dKaMinPar:
                     shm.set_output_level(OutputLevel.QUIET)
                     shm.set_graph(current)
                     cand = shm.compute_partition(
-                        k=k,
+                        k=ip_k,
                         epsilon=self.ctx.partition.epsilon,
                         seed=(self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
                     )
@@ -235,29 +255,160 @@ class dKaMinPar:
             finally:
                 set_output_level(outer_level)
 
-        # uncoarsening + distributed refinement (deep_multilevel.cc:181+)
-        max_bw = jnp.asarray(
-            np.minimum(self.ctx.partition.max_block_weights, WMAX),
-            dtype=WEIGHT_DTYPE,
-        )
+        # uncoarsening + distributed refinement (deep_multilevel.cc:181+):
+        # project up, refine at the current k, and in DEEP mode extend the
+        # partition on the mesh while the level's size supports more
+        # blocks (the extend_partition lineage, helper.cc:220)
+        current_k = ip_k
         num_levels = len(levels)
         with timer.scoped_timer("dist-uncoarsening"):
             for level_idx, (dg, cmap, fine_host) in enumerate(
                 reversed(levels)
             ):
                 partition = partition[cmap]  # project up
-                full = np.zeros(dg.n_pad, dtype=np.int32)
-                full[: fine_host.n] = partition
-                refined = refiner(
-                    dg,
-                    jnp.asarray(full),
-                    k,
-                    max_bw,
-                    (self.ctx.seed * 92821 + level_idx) & 0x7FFFFFFF,
-                    level=num_levels - 1 - level_idx,
+                level = num_levels - 1 - level_idx
+                seed = (self.ctx.seed * 92821 + level_idx) & 0x7FFFFFFF
+                partition = self._refine_dist(
+                    refiner, dg, fine_host, partition, current_k, spans,
+                    seed, level,
                 )
-                partition = np.asarray(refined)[: fine_host.n]
+                if deep:
+                    from ..partitioning.deep import compute_k_for_n
+
+                    target_k = min(
+                        k, compute_k_for_n(fine_host.n, self.ctx.shm)
+                    )
+                    while current_k < target_k:
+                        partition, spans, current_k = self._extend_on_mesh(
+                            fine_host, partition, spans
+                        )
+                        partition = self._refine_dist(
+                            refiner, dg, fine_host, partition, current_k,
+                            spans, seed ^ (0x9E37 + current_k), level,
+                        )
+        # final extensions to k (finest level)
+        if deep and levels:
+            dg, _, fine_host = levels[0]
+            while current_k < k:
+                partition, spans, current_k = self._extend_on_mesh(
+                    fine_host, partition, spans
+                )
+                partition = self._refine_dist(
+                    refiner, dg, fine_host, partition, current_k, spans,
+                    (self.ctx.seed * 48947 + current_k) & 0x7FFFFFFF, 0,
+                )
+        elif current_k < k:
+            # no dist levels (tiny graph): the shm IP already ran at ip_k;
+            # fall back to a full-k shm partition
+            from ..kaminpar import KaMinPar
+
+            shm = KaMinPar(self.ctx.shm.copy())
+            partition = shm.set_graph(graph).compute_partition(
+                k=k, epsilon=self.ctx.partition.epsilon, seed=self.ctx.seed
+            )
+            current_k = k
         return partition
+
+    # -- deep-mode helpers -------------------------------------------------
+
+    def _initial_spans(self, current_k: int, final_k: int):
+        """Block spans (first final block, count) for the current blocks —
+        the shm deep partitioner's bookkeeping (partitioning/deep.py)."""
+        from ..partitioning.rb import split_k
+
+        spans: List[Tuple[int, int]] = []
+
+        def rec(first: int, count: int, blocks: int):
+            if blocks == 1:
+                spans.append((first, count))
+                return
+            b0 = blocks // 2 + (blocks & 1)
+            k0, k1 = split_k(count)
+            rec(first, k0, b0)
+            rec(first + k0, k1, blocks - b0)
+
+        rec(0, final_k, current_k)
+        return spans
+
+    def _span_caps(self, spans) -> jnp.ndarray:
+        p = self.ctx.partition
+        caps = np.array(
+            [
+                p.total_max_block_weights(first, first + count)
+                for first, count in spans
+            ],
+            dtype=np.int64,
+        )
+        return jnp.asarray(np.minimum(caps, WMAX), dtype=WEIGHT_DTYPE)
+
+    def _refine_dist(
+        self, refiner, dg, fine_host, partition, current_k, spans, seed,
+        level,
+    ) -> np.ndarray:
+        full = np.zeros(dg.n_pad, dtype=np.int32)
+        full[: fine_host.n] = partition
+        refined = refiner(
+            dg, jnp.asarray(full), current_k, self._span_caps(spans),
+            seed, level=level,
+        )
+        return np.asarray(refined)[: fine_host.n]
+
+    def _extend_on_mesh(self, fine_host: HostGraph, partition, spans):
+        """Double k by bipartitioning every multi-span block's induced
+        subgraph — the extend_partition lineage (helper.cc:220).  The
+        reference extracts block subgraphs onto PE GROUPS and runs shm
+        KaMinPar per group (kaminpar-dist/graphutils/subgraph_extractor.cc
+        :872, deep_multilevel.cc:181+); on a one-host mesh the group
+        parallelism collapses to a loop, so blocks are extracted on the
+        host and bipartitioned by the native sequential multilevel
+        bipartitioner (native/ip.cpp), after which the caller's
+        distributed refinement at the doubled k polishes on the mesh."""
+        from ..graphs.host import extract_block_subgraphs
+        from ..initial import InitialMultilevelBipartitioner
+        from ..partitioning.deep import DeepMultilevelPartitioner
+        from ..partitioning.rb import bipartition_max_block_weights, split_k
+
+        rng = np.random.default_rng(
+            (self.ctx.seed * 63018038201 + len(spans)) & 0x7FFFFFFF
+        )
+        current_k = len(spans)
+        ext = extract_block_subgraphs(
+            fine_host, partition.astype(np.int64), current_k
+        )
+        bipartitioner = InitialMultilevelBipartitioner(
+            self.ctx.shm.initial_partitioning
+        )
+        # large blocks route through the shm deep partitioner's device
+        # bipartition pipeline, exactly like the shm extension does
+        deep_helper = DeepMultilevelPartitioner(self.ctx.shm)
+        device_threshold = self.ctx.shm.partitioning.device_bipartition_threshold
+        n = fine_host.n
+        new_part = np.zeros(n, dtype=np.int32)
+        new_spans: List[Tuple[int, int]] = []
+        next_id = 0
+        for b, (first, count) in enumerate(spans):
+            mask = partition == b
+            if count <= 1:
+                new_part[mask] = next_id
+                new_spans.append((first, count))
+                next_id += 1
+                continue
+            sub = ext.subgraphs[b]
+            max_w = bipartition_max_block_weights(
+                self.ctx.shm, first, count, sub.total_node_weight
+            )
+            if sub.n >= device_threshold:
+                bp = deep_helper._device_bipartition(sub, max_w, rng)
+            else:
+                bp = bipartitioner.bipartition(sub, max_w, rng)
+            k0, k1 = split_k(count)
+            new_part[mask] = np.where(
+                bp[ext.node_mapping[mask]] == 0, next_id, next_id + 1
+            )
+            new_spans.append((first, k0))
+            new_spans.append((first + k0, k1))
+            next_id += 2
+        return new_part, new_spans, len(new_spans)
 
     def _host_cut(self, graph: HostGraph, partition: np.ndarray) -> int:
         src = graph.edge_sources()

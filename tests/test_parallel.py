@@ -501,3 +501,115 @@ def test_halo_exchange_delivers_ghost_labels(n_devices):
         np.testing.assert_array_equal(
             ghosts_np[d][real], vals_np[gid_np[d][real]]
         )
+
+
+def test_dist_deep_mode_quality_2_vs_8_devices():
+    """DEEP-mode dist driver (k-doubling uncoarsening with block spans,
+    per-block extension + mesh refinement — deep_multilevel.cc analog):
+    2-device and 8-device runs must land in the same cut class, and both
+    within a band of the single-chip pipeline."""
+    from kaminpar_tpu import KaMinPar
+    from kaminpar_tpu.context import PartitioningMode
+    from kaminpar_tpu.parallel import dKaMinPar
+    from kaminpar_tpu.parallel.dist_context import (
+        create_dist_context_by_preset_name,
+    )
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    graph = make_grid_graph(64, 64)
+    k, eps = 8, 0.03
+    src = graph.edge_sources()
+    ew = graph.edge_weight_array()
+    nw = graph.node_weight_array()
+    cap = int((1 + eps) * np.ceil(nw.sum() / k)) + int(nw.max())
+
+    cuts = {}
+    for n_devices in (2, 8):
+        ctx = create_dist_context_by_preset_name("default")
+        assert ctx.mode == PartitioningMode.DEEP
+        part = (
+            dKaMinPar(ctx, n_devices=n_devices)
+            .set_graph(graph)
+            .compute_partition(k=k, epsilon=eps, seed=3)
+        )
+        bw = np.zeros(k, dtype=np.int64)
+        np.add.at(bw, part, nw)
+        assert (bw <= cap).all(), f"infeasible at {n_devices} devices"
+        cuts[n_devices] = int(ew[part[src] != part[graph.adjncy]].sum() // 2)
+
+    sc = KaMinPar("default")
+    sc.set_output_level(OutputLevel.QUIET)
+    spart = sc.set_graph(graph).compute_partition(k=k, epsilon=eps, seed=3)
+    scut = int(ew[spart[src] != spart[graph.adjncy]].sum() // 2)
+
+    # the cut class is pinned on both mesh sizes: within 2x of each other
+    # and within 2x of the single-chip pipeline (+ additive slack for the
+    # tiny-graph regime)
+    assert cuts[2] <= 2 * cuts[8] + 16 and cuts[8] <= 2 * cuts[2] + 16
+    for c in cuts.values():
+        assert c <= 2 * scut + 16
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_contraction_matches_host(n_devices):
+    """The sharded migrate contraction (parallel/dist_contraction.py) must
+    produce exactly the coarse graph the host contraction builds — same
+    dense relabeling (ascending leader id), same summed edge weights."""
+    from kaminpar_tpu.graphs.host import contract_clustering_host
+    from kaminpar_tpu.parallel.dist_contraction import (
+        dist_contract_clustering,
+    )
+
+    graph = make_rmat(1 << 9, 4_000, seed=13)
+    rng = np.random.default_rng(1)
+    mesh = make_mesh(n_devices)
+    dg = dist_graph_from_host(graph, mesh)
+    # a plausible clustering: labels point at random neighbors-or-self
+    labels = np.arange(dg.n_pad, dtype=np.int64)
+    pick = rng.integers(0, graph.n, graph.n)
+    merge = rng.random(graph.n) < 0.7
+    labels[: graph.n] = np.where(merge, pick, labels[: graph.n])
+    # one pointer hop makes most chains collapse like LP leaders do
+    labels[: graph.n] = labels[labels[: graph.n]]
+
+    coarse_h, cmap_h = contract_clustering_host(graph, labels[: graph.n])
+    coarse_d, cmap_d = dist_contract_clustering(
+        dg, graph.n, graph.node_weight_array(), labels
+    )
+    np.testing.assert_array_equal(cmap_d, cmap_h)
+    assert coarse_d.n == coarse_h.n
+    np.testing.assert_array_equal(coarse_d.xadj, coarse_h.xadj)
+    np.testing.assert_array_equal(
+        coarse_d.node_weight_array(), coarse_h.node_weight_array()
+    )
+    # per-row neighbor/weight sets match (row order may differ)
+    for u in range(coarse_h.n):
+        lo_h, hi_h = coarse_h.xadj[u], coarse_h.xadj[u + 1]
+        lo_d, hi_d = coarse_d.xadj[u], coarse_d.xadj[u + 1]
+        h = sorted(zip(coarse_h.adjncy[lo_h:hi_h],
+                       coarse_h.edge_weight_array()[lo_h:hi_h]))
+        d = sorted(zip(coarse_d.adjncy[lo_d:hi_d],
+                       coarse_d.edge_weight_array()[lo_d:hi_d]))
+        assert h == d, f"row {u} differs"
+
+
+def test_dist_pipeline_with_forced_sharded_contraction(monkeypatch):
+    """End-to-end dist run with the single-device contraction budget
+    forced to zero: every level must go through the sharded migrate
+    contraction, and the partition stays feasible."""
+    from kaminpar_tpu.parallel import dKaMinPar
+    from kaminpar_tpu.parallel import dist_partitioner as dp_mod
+
+    monkeypatch.setattr(dp_mod, "MAX_FUSED_EDGE_SLOTS", 0)
+    graph = make_grid_graph(48, 48)
+    k, eps = 4, 0.03
+    part = (
+        dKaMinPar("default", n_devices=8)
+        .set_graph(graph)
+        .compute_partition(k=k, epsilon=eps, seed=2)
+    )
+    nw = graph.node_weight_array()
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, part, nw)
+    cap = int((1 + eps) * np.ceil(nw.sum() / k)) + int(nw.max())
+    assert (bw <= cap).all()
