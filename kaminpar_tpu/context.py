@@ -243,10 +243,13 @@ class BalancerContext:
 class FMRefinementContext:
     """Host-side k-way FM (refinement/fm) knobs."""
 
-    num_iterations: int = 10
+    num_iterations: int = 3
     num_seed_nodes: int = 10
     alpha: float = 1.0
     num_fruitless_moves: int = 100
+    # run FM only on levels <= max_level (0 = finest); coarse levels are
+    # Jet territory and FM's host pass cost there buys ~0.1% cut
+    max_level: int = 1
 
 
 @dataclass

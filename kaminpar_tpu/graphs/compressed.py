@@ -1,19 +1,28 @@
 """Compressed host graph (TeraPart analog).
 
 The reference's memory-frugal mode stores neighborhoods gap+varint encoded
+with interval encoding for consecutive runs and a StreamVByte batch codec
 (kaminpar-common/graph_compression/compressed_neighborhoods.h:52-60,
-varint.h; datastructures/compressed_graph.h:30) so tera-scale graphs fit in
-RAM.  In the TPU framework the *device* graph must stay flat int32 CSR (XLA
-kernels want dense arrays), so compression lives on the host side of the
-DLPack boundary: a `CompressedHostGraph` holds the varint-gap streams
-(encoded/decoded by the native C++ codec, kaminpar_tpu/native/codec.cpp)
-and materializes plain CSR lazily — whole-graph for device upload, per-node
-for host algorithms.
+varint.h, streamvbyte.h; datastructures/compressed_graph.h:30) so
+tera-scale graphs fit in RAM.  In the TPU framework the *device* graph
+must stay flat int32 CSR (XLA kernels want dense arrays), so compression
+lives on the host side of the DLPack boundary: a `CompressedHostGraph`
+holds the encoded streams (native C++ codecs, kaminpar_tpu/native/
+codec.cpp + codec2.cpp) and materializes plain CSR lazily — whole-graph
+for device upload, per-node for host algorithms.
 
-Edge weights, when present, are stored as raw arrays (the reference
-interleaves varint-coded weights; a follow-up can pack them the same way —
-unweighted graphs, the common tera-scale case, already get the full
-benefit).
+Two codecs:
+  * "gap"  — varint gap streams (codec.cpp; numpy fallback exists);
+  * "v2"   — interval encoding + StreamVByte-class packed residuals +
+             varint edge weights (codec2.cpp; native only) — the
+             TeraPart-parity codec and the default when the native
+             library is available.  Edge weights are stored COMPRESSED
+             in the v2 emit order (interval members first), so decoded
+             adjacency and weights always pair 1:1.
+
+The reference's high-degree split (compressed_neighborhoods.h) exists to
+parallelize per-node decode across threads; bulk decode here is one
+native pass, so degree skew needs no special casing.
 """
 
 from __future__ import annotations
@@ -29,13 +38,16 @@ from .host import HostGraph
 
 @dataclass
 class CompressedHostGraph:
-    """Varint-gap compressed CSR (CompressedGraph analog)."""
+    """Compressed CSR (CompressedGraph analog)."""
 
     xadj: np.ndarray  # i64[n+1] degrees prefix (uncompressed, like reference)
     offsets: np.ndarray  # i64[n+1] byte offset per node's stream
-    data: np.ndarray  # u8[total] varint gap streams
+    data: np.ndarray  # u8[total] encoded neighborhoods
     node_weights: Optional[np.ndarray] = None
-    edge_weights: Optional[np.ndarray] = None
+    edge_weights: Optional[np.ndarray] = None  # raw (gap codec only)
+    codec: str = "gap"  # "gap" (codec.cpp) or "v2" (codec2.cpp)
+    wdata: Optional[np.ndarray] = None  # u8: varint weights (v2 only)
+    woffsets: Optional[np.ndarray] = None  # i64[n+1] (v2 only)
 
     @property
     def n(self) -> int:
@@ -50,16 +62,27 @@ class CompressedHostGraph:
 
     def neighbors(self, u: int) -> np.ndarray:
         """Decode one node (compressed_graph.h adjacent_nodes analog)."""
+        if self.codec == "v2":
+            return native.decode_v2_node(u, self.xadj, self.offsets, self.data)
         return native.decode_node(u, self.xadj, self.offsets, self.data)
 
     def decode(self) -> HostGraph:
         """Materialize the full CSR graph."""
-        adjncy = native.decode_gaps(self.xadj, self.offsets, self.data)
+        if self.codec == "v2":
+            adjncy = native.decode_v2(self.xadj, self.offsets, self.data)
+            ew = self.edge_weights
+            if self.wdata is not None:
+                ew = native.decode_v2_weights(
+                    self.xadj, self.woffsets, self.wdata
+                )
+        else:
+            adjncy = native.decode_gaps(self.xadj, self.offsets, self.data)
+            ew = self.edge_weights
         return HostGraph(
             xadj=self.xadj.copy(),
             adjncy=adjncy,
             node_weights=self.node_weights,
-            edge_weights=self.edge_weights,
+            edge_weights=ew,
         )
 
     def node_weight_array(self) -> np.ndarray:
@@ -77,21 +100,31 @@ class CompressedHostGraph:
             total += self.node_weights.nbytes
         if self.edge_weights is not None:
             total += self.edge_weights.nbytes
+        if self.wdata is not None:
+            total += self.wdata.nbytes + self.woffsets.nbytes
         return total
 
     def compression_ratio(self) -> float:
-        """Uncompressed adjacency bytes / compressed stream bytes
+        """Uncompressed adjacency(+weight) bytes / compressed stream bytes
         (the reference reports the same ratio in its compression stats)."""
         raw = self.m * 4
-        return raw / max(1, self.data.nbytes)
+        enc = self.data.nbytes
+        if self.wdata is not None:
+            raw += self.m * 4
+            enc += self.wdata.nbytes
+        return raw / max(1, enc)
 
 
-def compress_host_graph(graph: HostGraph) -> CompressedHostGraph:
+def compress_host_graph(
+    graph: HostGraph, codec: str = "auto"
+) -> CompressedHostGraph:
     """Build the compressed form (compressed_graph_builder.h analog).
 
-    Neighborhoods must be sorted ascending for gap coding; the builder
-    sorts per node when needed (the reference's builder requires the same
-    and offers reorder_edges_by_compression, permutator.h:241)."""
+    Neighborhoods must be sorted ascending for gap/interval coding; the
+    builder sorts per node when needed (the reference's builder requires
+    the same and offers reorder_edges_by_compression, permutator.h:241).
+    `codec`: "v2" (TeraPart parity, native only), "gap", or "auto" (v2
+    when the native library is available)."""
     adjncy = graph.adjncy
     xadj = np.asarray(graph.xadj, dtype=np.int64)
     # ensure sorted neighborhoods (cheap check first)
@@ -108,6 +141,26 @@ def compress_host_graph(graph: HostGraph) -> CompressedHostGraph:
         adjncy = adjncy[order]
         if ew is not None:
             ew = np.asarray(ew)[order]
+    if codec == "auto":
+        codec = "v2" if native.available() else "gap"
+    if codec == "v2":
+        enc = native.encode_v2(xadj, adjncy)
+        if enc is None:
+            raise RuntimeError("v2 codec requires the native library")
+        data, offsets = enc
+        wdata = woffsets = None
+        if ew is not None:
+            wdata, woffsets = native.encode_v2_weights(xadj, adjncy, ew)
+        return CompressedHostGraph(
+            xadj=xadj,
+            offsets=offsets,
+            data=data,
+            node_weights=graph.node_weights,
+            edge_weights=None,
+            codec="v2",
+            wdata=wdata,
+            woffsets=woffsets,
+        )
     data, offsets = native.encode_gaps(xadj, adjncy)
     return CompressedHostGraph(
         xadj=xadj,
@@ -115,4 +168,5 @@ def compress_host_graph(graph: HostGraph) -> CompressedHostGraph:
         data=data,
         node_weights=graph.node_weights,
         edge_weights=ew,
+        codec="gap",
     )

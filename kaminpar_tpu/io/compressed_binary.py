@@ -20,11 +20,15 @@ def write_compressed(path: str, graph: CompressedHostGraph) -> None:
         "xadj": graph.xadj,
         "offsets": graph.offsets,
         "data": graph.data,
+        "codec": np.frombuffer(graph.codec.encode(), dtype=np.uint8),
     }
     if graph.node_weights is not None:
         arrays["node_weights"] = np.asarray(graph.node_weights)
     if graph.edge_weights is not None:
         arrays["edge_weights"] = np.asarray(graph.edge_weights)
+    if graph.wdata is not None:
+        arrays["wdata"] = graph.wdata
+        arrays["woffsets"] = graph.woffsets
     np.savez_compressed(path, **arrays)
 
 
@@ -38,6 +42,9 @@ def load_compressed(path: str) -> CompressedHostGraph:
             data=z["data"],
             node_weights=z["node_weights"] if "node_weights" in z else None,
             edge_weights=z["edge_weights"] if "edge_weights" in z else None,
+            codec=bytes(z["codec"]).decode() if "codec" in z else "gap",
+            wdata=z["wdata"] if "wdata" in z else None,
+            woffsets=z["woffsets"] if "woffsets" in z else None,
         )
 
 

@@ -21,7 +21,12 @@ from typing import Optional
 import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRCS = [os.path.join(_DIR, "codec.cpp"), os.path.join(_DIR, "ip.cpp")]
+_SRCS = [
+    os.path.join(_DIR, "codec.cpp"),
+    os.path.join(_DIR, "codec2.cpp"),
+    os.path.join(_DIR, "ip.cpp"),
+    os.path.join(_DIR, "fm.cpp"),
+]
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -104,6 +109,27 @@ def get_lib() -> Optional[ctypes.CDLL]:
         i32, i32, i64, f64, i64,                         # per-level FM
         ctypes.c_uint64, p_i8,
     ]
+    lib.kmp_fm_refine.restype = i64
+    lib.kmp_fm_refine.argtypes = [
+        i64, p_i64, p_i32, p_i64, p_i64, i64, p_i64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS,WRITEABLE"),
+        i64, i64, f64, i64, i32, ctypes.c_uint64,
+    ]
+    # v2 codec (interval + streamvbyte-class residuals + varint weights)
+    lib.kmp_encode_v2_size.restype = i64
+    lib.kmp_encode_v2_size.argtypes = [i64, p_i64, p_i32, p_i64]
+    lib.kmp_encode_v2.restype = None
+    lib.kmp_encode_v2.argtypes = [i64, p_i64, p_i32, p_i64, p_u8]
+    lib.kmp_decode_v2.restype = None
+    lib.kmp_decode_v2.argtypes = [i64, p_i64, p_i64, p_u8, p_i32]
+    lib.kmp_decode_v2_node.restype = i64
+    lib.kmp_decode_v2_node.argtypes = [i64, p_i64, p_i64, p_u8, p_i32]
+    lib.kmp_encode_v2_weights_size.restype = i64
+    lib.kmp_encode_v2_weights_size.argtypes = [i64, p_i64, p_i32, p_i64, p_i64]
+    lib.kmp_encode_v2_weights.restype = None
+    lib.kmp_encode_v2_weights.argtypes = [i64, p_i64, p_i32, p_i64, p_i64, p_u8]
+    lib.kmp_decode_v2_weights.restype = None
+    lib.kmp_decode_v2_weights.argtypes = [i64, p_i64, p_i64, p_u8, p_i64]
     _lib = lib
     return _lib
 
@@ -280,4 +306,119 @@ def ml_bipartition(graph, max_block_weights, ip_ctx, seed: int):
         int(fm.num_iterations),
         int(seed) & 0xFFFFFFFFFFFFFFFF, out,
     )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Native localized batch k-way FM (fm.cpp)
+# ---------------------------------------------------------------------------
+
+
+def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int):
+    """Run the native localized batch FM on a HostGraph partition.
+
+    Native counterpart of the reference's parallel localized FM scheme
+    (see fm.cpp header); refines `partition` IN PLACE and returns the
+    total cut improvement, or None when the native library is
+    unavailable."""
+    lib = get_lib()
+    if lib is None or graph.n == 0 or k <= 1:
+        return None
+    xadj = np.ascontiguousarray(graph.xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(graph.adjncy, dtype=np.int32)
+    node_w = np.ascontiguousarray(graph.node_weight_array(), dtype=np.int64)
+    edge_w = np.ascontiguousarray(graph.edge_weight_array(), dtype=np.int64)
+    max_bw = np.ascontiguousarray(max_block_weights, dtype=np.int64)
+    assert partition.dtype == np.int32 and partition.flags.c_contiguous
+    return int(
+        lib.kmp_fm_refine(
+            graph.n, xadj, adjncy, node_w, edge_w, int(k), max_bw,
+            partition,
+            int(fm_ctx.num_iterations), int(fm_ctx.num_seed_nodes),
+            float(fm_ctx.alpha), int(fm_ctx.num_fruitless_moves),
+            1,  # adaptive stopping (the reference's default for FM)
+            int(seed) & 0xFFFFFFFFFFFFFFFF,
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# v2 codec: interval + streamvbyte-class residuals + varint edge weights
+# (codec2.cpp — the TeraPart compressed_neighborhoods parity codec).
+# Native-only: the numpy fallback keeps the v1 gap codec.
+# ---------------------------------------------------------------------------
+
+
+def encode_v2(xadj, adjncy):
+    """Encode sorted CSR neighborhoods with the v2 codec.
+    Returns (bytes u8[total], offsets i64[n+1]) or None without the lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(xadj) - 1
+    xadj = np.ascontiguousarray(xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(adjncy, dtype=np.int32)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    total = lib.kmp_encode_v2_size(n, xadj, adjncy, offsets)
+    out = np.empty(total, dtype=np.uint8)
+    lib.kmp_encode_v2(n, xadj, adjncy, offsets, out)
+    return out, offsets
+
+
+def decode_v2(xadj, offsets, data):
+    """Decode a v2 stream; returns adjncy i32[m] in EMIT order
+    (interval members first — pairs 1:1 with the weight stream)."""
+    lib = get_lib()
+    assert lib is not None, "v2 codec requires the native library"
+    n = len(xadj) - 1
+    xadj = np.ascontiguousarray(xadj, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    out = np.empty(int(xadj[-1]), dtype=np.int32)
+    lib.kmp_decode_v2(n, xadj, offsets, data, out)
+    return out
+
+
+def decode_v2_node(u, xadj, offsets, data):
+    lib = get_lib()
+    assert lib is not None, "v2 codec requires the native library"
+    deg = int(xadj[u + 1] - xadj[u])
+    out = np.empty(deg, dtype=np.int32)
+    if deg:
+        lib.kmp_decode_v2_node(
+            int(u),
+            np.ascontiguousarray(xadj, dtype=np.int64),
+            np.ascontiguousarray(offsets, dtype=np.int64),
+            np.ascontiguousarray(data, dtype=np.uint8),
+            out,
+        )
+    return out
+
+
+def encode_v2_weights(xadj, adjncy, edge_w):
+    """Varint-encode edge weights in the v2 EMIT order.
+    Returns (bytes, woffsets) or None without the lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(xadj) - 1
+    xadj = np.ascontiguousarray(xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(adjncy, dtype=np.int32)
+    edge_w = np.ascontiguousarray(edge_w, dtype=np.int64)
+    woffsets = np.zeros(n + 1, dtype=np.int64)
+    total = lib.kmp_encode_v2_weights_size(n, xadj, adjncy, edge_w, woffsets)
+    out = np.empty(total, dtype=np.uint8)
+    lib.kmp_encode_v2_weights(n, xadj, adjncy, edge_w, woffsets, out)
+    return out, woffsets
+
+
+def decode_v2_weights(xadj, woffsets, wdata):
+    lib = get_lib()
+    assert lib is not None, "v2 codec requires the native library"
+    n = len(xadj) - 1
+    xadj = np.ascontiguousarray(xadj, dtype=np.int64)
+    woffsets = np.ascontiguousarray(woffsets, dtype=np.int64)
+    wdata = np.ascontiguousarray(wdata, dtype=np.uint8)
+    out = np.empty(int(xadj[-1]), dtype=np.int64)
+    lib.kmp_decode_v2_weights(n, xadj, woffsets, wdata, out)
     return out

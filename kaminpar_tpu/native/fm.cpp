@@ -1,0 +1,324 @@
+// Localized batch k-way FM refinement (native host runtime).
+//
+// The native analog of the reference's parallel localized FM
+// (kaminpar-shm/refinement/fm/fm_refiner.cc:48-110 FMRefiner/
+// LocalizedFMRefiner, gains/delta_gain_caches.h:202): seed nodes are
+// polled from a shared border queue, each batch grows a localized region
+// speculatively against a DELTA overlay of the partition and gain table,
+// and only the best prefix of the batch's moves is committed to the
+// global state; non-moved region nodes are released for later batches.
+// This is exactly the reference's scheme minus the thread pool — batches
+// run one after another on the host (the TPU has no per-node PQ path;
+// see kaminpar_tpu/refinement/fm.py) — with the same state machinery:
+// dense (n, k) gain table (gains/sparse_gain_cache.h lineage), sparse
+// delta map, adaptive (Osipov-Sanders) or simple stopping.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ^ 0x9E3779B97F4A7C15ULL) {
+    if (s == 0) s = 0x2545F4914F6CDD1DULL;
+  }
+  uint64_t next() {
+    uint64_t z = (s += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  uint32_t tie() { return (uint32_t)(next() >> 32); }
+};
+
+struct Ctx {
+  int64_t n, k;
+  const int64_t* xadj;
+  const int32_t* adjncy;
+  const int64_t* node_w;
+  const int64_t* edge_w;
+  const int64_t* max_bw;
+  int32_t* part;
+  std::vector<int64_t> conn;  // dense (n, k) connection table
+  std::vector<int64_t> bw;    // global block weights
+
+  int64_t conn_at(int64_t u, int64_t b) const { return conn[u * k + b]; }
+};
+
+// node states within a pass
+enum : uint8_t { FREE = 0, IN_REGION = 1, MOVED = 2 };
+
+void build_conn(Ctx& c) {
+  std::fill(c.conn.begin(), c.conn.end(), 0);
+  std::fill(c.bw.begin(), c.bw.end(), 0);
+  for (int64_t u = 0; u < c.n; ++u) {
+    c.bw[c.part[u]] += c.node_w[u];
+    for (int64_t e = c.xadj[u]; e < c.xadj[u + 1]; ++e)
+      c.conn[u * c.k + c.part[c.adjncy[e]]] += c.edge_w[e];
+  }
+}
+
+// Delta overlay (delta_gain_caches.h analog): tentative partition and
+// gain-table deltas for the current batch.  Touched nodes get a dense
+// ARENA row copy of their (k-wide) connection row plus a tentative
+// block field — one hash lookup per row access instead of k map probes
+// per gain query (the hot path of the whole refiner).
+struct Delta {
+  const Ctx* c;
+  std::unordered_map<int64_t, int32_t> slot;  // u -> arena index
+  std::vector<int64_t> rows;                  // arena, k per slot
+  std::vector<int32_t> blocks;                // arena slot -> tent. block
+  std::vector<int64_t> bw_delta;
+
+  explicit Delta(const Ctx& ctx) : c(&ctx), bw_delta(ctx.k, 0) {
+    slot.reserve(1 << 14);
+  }
+  void clear() {
+    slot.clear();
+    rows.clear();
+    blocks.clear();
+    std::fill(bw_delta.begin(), bw_delta.end(), 0);
+  }
+  // arena row of u, materialized from the global table on first touch
+  int64_t* row(int64_t u) {
+    auto [it, fresh] = slot.try_emplace(u, (int32_t)blocks.size());
+    if (fresh) {
+      rows.insert(rows.end(), c->conn.begin() + u * c->k,
+                  c->conn.begin() + (u + 1) * c->k);
+      blocks.push_back(c->part[u]);
+    }
+    return rows.data() + (int64_t)it->second * c->k;
+  }
+  int32_t block(int64_t u) const {
+    auto it = slot.find(u);
+    return it == slot.end() ? c->part[u] : blocks[it->second];
+  }
+  // read-only row view (global when untouched)
+  const int64_t* row_view(int64_t u) const {
+    auto it = slot.find(u);
+    return it == slot.end() ? c->conn.data() + u * c->k
+                            : rows.data() + (int64_t)it->second * c->k;
+  }
+  int64_t weight(int64_t b) const { return c->bw[b] + bw_delta[b]; }
+  // tentatively move u from -> to, updating neighbor rows
+  void move(int64_t u, int32_t from, int32_t to) {
+    row(u);  // materialize so the block override has a slot
+    blocks[slot.find(u)->second] = to;
+    bw_delta[from] -= c->node_w[u];
+    bw_delta[to] += c->node_w[u];
+    for (int64_t e = c->xadj[u]; e < c->xadj[u + 1]; ++e) {
+      const int32_t v = c->adjncy[e];
+      int64_t* r = row(v);
+      r[from] -= c->edge_w[e];
+      r[to] += c->edge_w[e];
+    }
+  }
+};
+
+// best feasible move of u under the delta view: (gain, target) or
+// (INT64_MIN, -1)
+std::pair<int64_t, int32_t> best_move(const Delta& d, int64_t u, Rng& rng) {
+  const Ctx& c = *d.c;
+  const int32_t b = d.block(u);
+  const int64_t* r = d.row_view(u);
+  const int64_t own = r[b];
+  int64_t best_gain = INT64_MIN;
+  int32_t best_t = -1;
+  uint32_t best_tie = 0;
+  for (int32_t t = 0; t < c.k; ++t) {
+    if (t == b) continue;
+    if (d.weight(t) + c.node_w[u] > c.max_bw[t]) continue;
+    const int64_t g = r[t] - own;
+    if (g > best_gain) {
+      best_gain = g;
+      best_t = t;
+      best_tie = rng.tie();
+    } else if (g == best_gain && best_t >= 0) {
+      const uint32_t tb = rng.tie();
+      if (tb > best_tie) {
+        best_t = t;
+        best_tie = tb;
+      }
+    }
+  }
+  return {best_gain, best_t};
+}
+
+// commit a move to the GLOBAL state
+void commit_move(Ctx& c, int64_t u, int32_t from, int32_t to) {
+  c.part[u] = to;
+  c.bw[from] -= c.node_w[u];
+  c.bw[to] += c.node_w[u];
+  for (int64_t e = c.xadj[u]; e < c.xadj[u + 1]; ++e) {
+    const int32_t v = c.adjncy[e];
+    c.conn[(int64_t)v * c.k + from] -= c.edge_w[e];
+    c.conn[(int64_t)v * c.k + to] += c.edge_w[e];
+  }
+}
+
+struct Move {
+  int64_t u;
+  int32_t from, to;
+  int64_t gain;
+};
+
+// one localized batch (LocalizedFMRefiner::run_batch); returns committed
+// gain
+int64_t run_batch(Ctx& c, Delta& d, std::vector<uint8_t>& state,
+                  const std::vector<int64_t>& seeds, double alpha,
+                  int64_t num_fruitless, int use_adaptive, Rng& rng) {
+  d.clear();
+  using Entry = std::tuple<int64_t, uint32_t, int64_t, int32_t>;
+  std::priority_queue<Entry> pq;
+  std::vector<int64_t> touched;
+
+  auto push = [&](int64_t u) {
+    auto [g, t] = best_move(d, u, rng);
+    if (t >= 0) pq.push({g, rng.tie(), u, t});
+  };
+  for (int64_t s : seeds) {
+    if (state[s] == FREE) {
+      state[s] = IN_REGION;
+      touched.push_back(s);
+      push(s);
+    }
+  }
+
+  std::vector<Move> moves;
+  int64_t cur = 0, best = 0;
+  size_t best_len = 0;
+  int64_t fruitless = 0;
+  int64_t steps = 0;
+  double mean = 0.0, m2 = 0.0;
+  const size_t max_moves = 4096;  // region safety cap
+
+  while (!pq.empty() && moves.size() < max_moves) {
+    auto [g, tie, u, t] = pq.top();
+    pq.pop();
+    if (state[u] == MOVED) continue;
+    // stale check: gains shift as the region moves.  Re-queue only on a
+    // GAIN change — the target may legitimately differ on ties (random
+    // tie-break per query), and re-queuing on target alone could cycle
+    auto [g2, t2] = best_move(d, u, rng);
+    if (t2 < 0) continue;
+    if (g2 != g) {
+      pq.push({g2, rng.tie(), u, t2});
+      continue;
+    }
+    t = t2;
+    const int32_t b = d.block(u);
+    d.move(u, b, t);
+    moves.push_back({u, b, t, g2});
+    cur += g2;
+    if (cur > best) {
+      best = cur;
+      best_len = moves.size();
+    }
+    // expand: adjacent FREE nodes join the region
+    for (int64_t e = c.xadj[u]; e < c.xadj[u + 1]; ++e) {
+      const int32_t v = c.adjncy[e];
+      if (state[v] == FREE) {
+        state[v] = IN_REGION;
+        touched.push_back(v);
+        push(v);
+      } else if (state[v] == IN_REGION) {
+        push(v);
+      }
+    }
+    // stopping policies (stopping_policies.h:16)
+    if (use_adaptive) {
+      ++steps;
+      const double dlt = (double)g - mean;
+      mean += dlt / (double)steps;
+      m2 += dlt * ((double)g - mean);
+      if (steps >= 2) {
+        const double variance = m2 / (double)(steps - 1);
+        if (mean < 0 &&
+            (double)steps * mean * mean > alpha * variance + 10.0)
+          break;
+      }
+    } else {
+      fruitless = (g > 0) ? 0 : fruitless + 1;
+      if (fruitless >= num_fruitless) break;
+    }
+  }
+
+  // commit the best prefix globally; release the rest
+  for (size_t i = 0; i < best_len; ++i) {
+    commit_move(c, moves[i].u, moves[i].from, moves[i].to);
+    state[moves[i].u] = MOVED;
+  }
+  for (int64_t u : touched)
+    if (state[u] == IN_REGION) state[u] = FREE;
+  return best;
+}
+
+}  // namespace
+
+extern "C" int64_t kmp_fm_refine(
+    int64_t n, const int64_t* xadj, const int32_t* adjncy,
+    const int64_t* node_w, const int64_t* edge_w, int64_t k,
+    const int64_t* max_bw, int32_t* part, int64_t num_iterations,
+    int64_t num_seed_nodes, double alpha, int64_t num_fruitless_moves,
+    int32_t use_adaptive, uint64_t seed) {
+  if (n <= 0 || k <= 1) return 0;
+  // dense (n, k) table: refuse absurd sizes (large-k uses other refiners)
+  if (n * k > (int64_t)3e8) return 0;
+  Ctx c{n, k, xadj, adjncy, node_w, edge_w, max_bw, part, {}, {}};
+  c.conn.resize(n * k);
+  c.bw.resize(k);
+  Rng rng(seed);
+  build_conn(c);
+
+  int64_t total = 0;
+  int64_t first_pass_gain = 0;
+  std::vector<uint8_t> state(n);
+  std::vector<int64_t> border;
+  std::vector<int64_t> seeds;
+  for (int64_t pass = 0; pass < std::max<int64_t>(1, num_iterations);
+       ++pass) {
+    // border nodes: nonzero external connection
+    border.clear();
+    for (int64_t u = 0; u < n; ++u) {
+      const int64_t own = c.conn_at(u, c.part[u]);
+      int64_t deg_w = 0;
+      for (int64_t b = 0; b < k; ++b) deg_w += c.conn_at(u, b);
+      if (deg_w > own) border.push_back(u);
+    }
+    if (border.empty()) break;
+    for (int64_t i = (int64_t)border.size() - 1; i > 0; --i)
+      std::swap(border[i], border[(int64_t)(rng.next() % (uint64_t)(i + 1))]);
+
+    std::fill(state.begin(), state.end(), FREE);
+    Delta d(c);
+    int64_t pass_gain = 0;
+    size_t head = 0;
+    const int64_t nseeds = std::max<int64_t>(1, num_seed_nodes);
+    while (head < border.size()) {
+      seeds.clear();
+      while (head < border.size() && (int64_t)seeds.size() < nseeds) {
+        const int64_t u = border[head++];
+        if (state[u] == FREE) seeds.push_back(u);
+      }
+      if (seeds.empty()) break;
+      pass_gain += run_batch(c, d, state, seeds, alpha,
+                             num_fruitless_moves, use_adaptive, rng);
+    }
+    total += pass_gain;
+    if (pass_gain <= 0) break;
+    // improvement abortion (initial_fm_refiner improvement_abortion
+    // lineage): later passes chase diminishing returns at full pass cost
+    if (pass == 0)
+      first_pass_gain = pass_gain;
+    else if (pass_gain * 20 < first_pass_gain)
+      break;
+  }
+  return total;
+}

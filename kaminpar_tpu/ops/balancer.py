@@ -34,9 +34,18 @@ from .segments import (
     ACC_DTYPE,
     INT32_MIN,
     accept_prefix_by_capacity,
+    aggregate_by_key,
+    argmax_per_segment,
     best_from_dense,
+    connection_to_label,
     dense_block_ratings,
 )
+
+# Above this k a dense (n, k) rating table is shape-infeasible (the
+# reference's large-k regime, sparse/compact gain caches —
+# kaminpar-shm/refinement/gains/compact_hashing_gain_cache.h:34); the
+# balancer rates via edge aggregation instead.
+BALANCER_DENSE_MAX_K = 256
 
 
 def relative_gain_key(gain: jax.Array, weight: jax.Array) -> jax.Array:
@@ -62,8 +71,14 @@ def overload_balance_round(
     k: int,
     max_block_weights: jax.Array,
     salt: jax.Array,
+    conn: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    """One bulk-synchronous balancing round; returns (partition, moved)."""
+    """One bulk-synchronous balancing round; returns (partition, moved).
+
+    `conn` is an optional PRE-BUILT dense (n, k) connection table for
+    `partition` (the Jet refiner maintains one incrementally); when given,
+    the round does NO edge-wide work at all — rating, commit, and weight
+    arithmetic are all O(n*k)/O(n)."""
     n_pad = graph.n_pad
     node_ids = jnp.arange(n_pad, dtype=jnp.int32)
     is_real = node_ids < graph.n
@@ -76,14 +91,42 @@ def overload_balance_round(
     in_overloaded = (overload[part] > 0) & is_real
 
     # best feasible target per node: highest-connection non-overloaded block
-    # with room for the node (dense (n, k) rating — one segment_sum, no
-    # sort; bw + node_w <= cap excludes overloaded targets by itself)
-    conn = dense_block_ratings(
-        graph.src, graph.dst, graph.edge_w, part, n_pad, k
-    )
-    best, best_w, w_own = best_from_dense(
-        conn, part, bw, graph.node_w, cap, salt
-    )
+    # with room for the node.  Small k: dense (n, k) rating (one
+    # segment_sum, no sort).  Large k: the dense table is
+    # shape-infeasible — rate by edge aggregation (sort-based, the
+    # compact-gain-cache regime).
+    if k <= BALANCER_DENSE_MAX_K:
+        if conn is None:
+            conn = dense_block_ratings(
+                graph.src, graph.dst, graph.edge_w, part, n_pad, k
+            )
+        best, best_w, w_own = best_from_dense(
+            conn, part, bw, graph.node_w, cap, salt
+        )
+    else:
+        neigh_block = part[graph.dst]
+        seg_g, key_g, w_g = aggregate_by_key(graph.src, neigh_block, graph.edge_w)
+        key_c = jnp.clip(key_g, 0, k - 1)
+        seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+        fits = (
+            bw[key_c] + graph.node_w[seg_c].astype(ACC_DTYPE) <= cap[key_c]
+        )
+        feasible = (seg_g >= 0) & (key_g != part[seg_c]) & fits
+        best, best_w = argmax_per_segment(
+            seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=feasible
+        )
+        w_own = connection_to_label(seg_g, key_g, w_g, part, n_pad)
+        # zero-connection escape (the dense table rates every block; the
+        # edge aggregation only rates ADJACENT ones): movers with no
+        # feasible neighbor block go to the max-headroom block if they fit
+        headroom_now = jnp.maximum(cap - bw, 0)
+        fallback = jnp.argmax(headroom_now).astype(jnp.int32)
+        fb_ok = (
+            graph.node_w.astype(ACC_DTYPE) <= headroom_now[fallback]
+        ) & (part != fallback)
+        use_fb = (best < 0) & fb_ok
+        best = jnp.where(use_fb, fallback, best)
+        best_w = jnp.where(use_fb, 0, best_w)
 
     # (no separate fallback needed: the dense table rates every fitting
     # block, including zero-connection ones, so best < 0 already means no
@@ -172,14 +215,31 @@ def underload_balance(
         surplus = jnp.maximum(bw - min_block_weights.astype(ACC_DTYPE), 0)
 
         # candidates: nodes in surplus blocks adjacent to a deficit block
-        # (dense rating restricted to deficit columns)
-        conn = dense_block_ratings(
-            graph.src, graph.dst, graph.edge_w, part, n_pad, k
-        )
-        best, best_w, _ = best_from_dense(
-            conn, part, bw, graph.node_w, bw, salt,
-            require_fit=False, allowed=deficit > 0,
-        )
+        # (dense rating restricted to deficit columns; large k rates by
+        # edge aggregation — see BALANCER_DENSE_MAX_K)
+        if k <= BALANCER_DENSE_MAX_K:
+            conn = dense_block_ratings(
+                graph.src, graph.dst, graph.edge_w, part, n_pad, k
+            )
+            best, best_w, _ = best_from_dense(
+                conn, part, bw, graph.node_w, bw, salt,
+                require_fit=False, allowed=deficit > 0,
+            )
+        else:
+            neigh_block = part[graph.dst]
+            seg_g, key_g, w_g = aggregate_by_key(
+                graph.src, neigh_block, graph.edge_w
+            )
+            key_c = jnp.clip(key_g, 0, k - 1)
+            seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+            feasible = (
+                (seg_g >= 0)
+                & (key_g != part[seg_c])
+                & (deficit[key_c] > 0)
+            )
+            best, best_w = argmax_per_segment(
+                seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=feasible
+            )
         # fallback for deficit blocks with no adjacent candidates (e.g. an
         # empty block): pull arbitrary nodes into the most-deficient block
         fallback = jnp.argmax(deficit).astype(jnp.int32)

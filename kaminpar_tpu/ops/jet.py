@@ -184,12 +184,35 @@ def _jet_iteration(
     new_part = jnp.where(accept, next_part, part)
     new_lock = accept.astype(jnp.int32)  # moved nodes rest next iteration
 
+    # ---- maintain the rating table across the jet moves ----
+    # when few nodes changed, re-scatter only their rows
+    def _conn_step(conn_, before, after):
+        if dslots is None:
+            return dense_block_ratings(
+                graph.src, graph.dst, graph.edge_w, after, n_pad, k
+            )
+        changed_edges = jnp.sum(
+            jnp.where(before != after, graph.degrees, 0), dtype=jnp.int32
+        )
+        return lax.cond(
+            changed_edges <= dslots,
+            lambda args: _conn_update_rows(graph, *args, k, dslots),
+            lambda args: dense_block_ratings(
+                graph.src, graph.dst, graph.edge_w, args[2], n_pad, k
+            ),
+            (conn_, before, after),
+        )
+
+    jet_conn = _conn_step(conn, part, new_part)
+
     # ---- rebalance (jet_refiner.cc:185-187) ----
     # while_loop, not fori: Jet iterations usually keep the partition
-    # feasible, and a false condition skips the edge-wide balancer body
-    # entirely — the dominant per-iteration cost otherwise.  The overload
-    # total rides in the loop state so the condition is a scalar check,
-    # not a second block-weight reduction per round.
+    # feasible, and a false condition skips the balancer body entirely.
+    # Balancer rounds rate from the post-jet conn table — STALE within
+    # the loop (the reference's balancer PQs also run on cached gains);
+    # block-weight caps are recomputed fresh per round, so feasibility is
+    # exact, and the table itself is reconciled ONCE after the loop from
+    # the partition diff.  No edge-wide work anywhere in the loop.
     def _overload(p):
         bw = jax.ops.segment_sum(
             graph.node_w.astype(ACC_DTYPE), p, num_segments=k
@@ -205,35 +228,25 @@ def _jet_iteration(
     def bal_body(state):
         i, p, _, _ = state
         s = (salt + i * 7919) & 0x7FFFFFFF
-        p2, moved = overload_balance_round(graph, p, k, max_block_weights, s)
+        p2, moved = overload_balance_round(
+            graph, p, k, max_block_weights, s, conn=jet_conn
+        )
         return (i + 1, p2, moved, _overload(p2))
 
-    _, new_part, _, _ = lax.while_loop(
+    _, bal_part, _, _ = lax.while_loop(
         bal_cond,
         bal_body,
         (jnp.int32(0), new_part, jnp.int32(1), _overload(new_part)),
     )
-
-    # ---- maintain the rating table for the next iteration ----
-    # moves AND balancer corrections are both captured by part vs
-    # new_part; when few nodes changed, re-scatter only their rows
-    if dslots is None:
-        new_conn = dense_block_ratings(
-            graph.src, graph.dst, graph.edge_w, new_part, n_pad, k
-        )
-    else:
-        changed_edges = jnp.sum(
-            jnp.where(part != new_part, graph.degrees, 0), dtype=jnp.int32
-        )
-        new_conn = lax.cond(
-            changed_edges <= dslots,
-            lambda args: _conn_update_rows(graph, *args, k, dslots),
-            lambda args: dense_block_ratings(
-                graph.src, graph.dst, graph.edge_w, args[2], n_pad, k
-            ),
-            (conn, part, new_part),
-        )
-    return new_part, new_lock, ext_sum, new_conn
+    # reconcile the table only when the balancer actually moved something
+    # (the common case is a feasible partition and zero balancer rounds)
+    new_conn = lax.cond(
+        jnp.any(bal_part != new_part),
+        lambda args: _conn_step(*args),
+        lambda args: args[0],
+        (jet_conn, new_part, bal_part),
+    )
+    return bal_part, new_lock, ext_sum, new_conn
 
 
 @partial(

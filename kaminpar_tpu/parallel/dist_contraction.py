@@ -51,6 +51,13 @@ from .mesh import NODE_AXIS
 # checks the returned count and raises rather than truncating
 OUT_FACTOR = 2
 
+# per-peer migrate bucket capacity = max(m_loc * BUCKET_SLACK // D,
+# BUCKET_MIN): O(m_loc/D) per device instead of O(m_loc) per PEER, so
+# total buffer memory stays O(m_loc * slack) — the point of sharding.
+# Skewed targets overflow-detect (count per bucket) and raise.
+BUCKET_SLACK = 4
+BUCKET_MIN = 1 << 16
+
 
 @partial(jax.jit, static_argnames=("mesh",))
 def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
@@ -81,24 +88,30 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
 
         # 3. migrate: bucket rows by cu's owner device; rows are sorted by
         # cu, so the target is monotone and the in-bucket position is a
-        # running index
+        # running index.  Bucket capacity is O(m_loc/D) (+slack), not
+        # m_loc — total send+recv memory stays O(m_loc), the point of a
+        # sharded contraction; skew overflows are detected, not truncated
+        bcap = max(cap * BUCKET_SLACK // D, BUCKET_MIN)
         tgt = jnp.where(rows_valid, seg_g // chunk, D).astype(jnp.int32)
         idx = jnp.arange(cap, dtype=jnp.int32)
         start = jax.ops.segment_min(
             jnp.where(rows_valid, idx, cap), tgt, num_segments=D + 1
         )
         pos = idx - start[jnp.clip(tgt, 0, D - 1)]
+        overflow = jnp.sum(
+            (rows_valid & (pos >= bcap)).astype(jnp.int32)
+        )
         flat = jnp.where(
-            rows_valid & (pos < cap), tgt * cap + pos, D * cap
+            rows_valid & (pos < bcap), tgt * bcap + pos, D * bcap
         )
 
         def to_buckets(vals, fill):
             buf = (
-                jnp.full(D * cap + 1, fill, dtype=vals.dtype)
+                jnp.full(D * bcap + 1, fill, dtype=vals.dtype)
                 .at[flat]
                 .set(jnp.where(rows_valid, vals, fill), mode="drop")
             )
-            return buf[: D * cap].reshape(D, cap)
+            return buf[: D * bcap].reshape(D, bcap)
 
         send_cu = to_buckets(seg_g, jnp.int32(-1))
         send_cv = to_buckets(key_g, jnp.int32(-1))
@@ -108,7 +121,9 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
         recv_w = lax.all_to_all(send_w, NODE_AXIS, 0, 0, tiled=True)
 
         # 4. merge duplicates arriving from different source devices (the
-        # same large-sentinel rule keeps valid rows as the prefix)
+        # same large-sentinel rule keeps valid rows as the prefix).  A
+        # bucket overflow anywhere poisons `count` past out_cap so the
+        # driver raises instead of silently dropping rows.
         seg2 = recv_cu.reshape(-1)
         cv2 = recv_cv.reshape(-1)
         seg_f, key_f, w_f = aggregate_by_key(
@@ -117,8 +132,13 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
             recv_w.reshape(-1),
         )
         valid_f = (seg_f >= 0) & (seg_f < big)
-        count = jnp.sum(valid_f.astype(jnp.int32))
         out_cap = OUT_FACTOR * cap
+        total_overflow = lax.psum(overflow, NODE_AXIS)
+        count = jnp.where(
+            total_overflow > 0,
+            jnp.int32(out_cap + 1),
+            jnp.sum(valid_f.astype(jnp.int32)),
+        )
         return seg_f[:out_cap], key_f[:out_cap], w_f[:out_cap], count[None]
 
     return _shard_map(
@@ -166,8 +186,9 @@ def dist_contract_clustering(
     out_cap = cu_s.shape[1]
     if (counts > out_cap).any():
         raise RuntimeError(
-            "sharded contraction overflow: a device's merged coarse rows "
-            f"exceed {out_cap}; raise dist_contraction.OUT_FACTOR"
+            "sharded contraction overflow: a migrate bucket or a device's "
+            f"merged coarse rows exceed capacity ({out_cap}); raise "
+            "dist_contraction.OUT_FACTOR / BUCKET_SLACK"
         )
     # shards hold disjoint ascending cu chunks and are (cu, cv)-sorted, so
     # concatenation in device order is globally sorted

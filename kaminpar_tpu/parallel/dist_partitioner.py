@@ -208,11 +208,13 @@ class dKaMinPar:
                 current = coarse
 
         # DEEP mode partitions the coarsest at a reduced k' and doubles k
-        # on the mesh during uncoarsening; KWAY partitions at full k
+        # on the mesh during uncoarsening; KWAY partitions at full k.
+        # With no dist levels there is nothing to double over — the shm
+        # IP result IS the final partition, so it must run at full k.
         from ..context import PartitioningMode
 
         deep = self.ctx.mode == PartitioningMode.DEEP
-        if deep:
+        if deep and levels:
             from ..partitioning.deep import compute_k_for_n
 
             ip_k = max(2, min(k, compute_k_for_n(current.n, self.ctx.shm)))
@@ -244,9 +246,26 @@ class dKaMinPar:
                     # process-global logger level past this scope
                     shm.set_output_level(OutputLevel.QUIET)
                     shm.set_graph(current)
+                    # span-aware caps: when ip_k does not divide k the
+                    # current blocks carry UNEQUAL final-block counts,
+                    # and the IP must balance to those targets or the
+                    # first refinement inherits systematic overloads
+                    p_ = self.ctx.partition
+                    ip_caps = np.array(
+                        [
+                            p_.total_max_block_weights(
+                                first, first + count
+                            )
+                            for first, count in spans
+                        ],
+                        dtype=np.int64,
+                    )
                     cand = shm.compute_partition(
                         k=ip_k,
                         epsilon=self.ctx.partition.epsilon,
+                        max_block_weights=(
+                            None if ip_k == k else ip_caps
+                        ),
                         seed=(self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
                     )
                     cut = self._host_cut(current, cand)

@@ -338,8 +338,13 @@ class DeepMultilevelPartitioner:
         Large levels run the DEVICE extraction (ops/subgraphs.py — no
         full-graph readback); small levels keep the host path, whose
         readback is cheap and whose numpy extraction needs no extra
-        device programs."""
-        if dgraph.m_pad >= DEVICE_EXTEND_MIN_EDGE_SLOTS:
+        device programs.  So does the large-k regime: with hundreds of
+        small blocks, per-block device programs would pay the ~87 ms
+        launch floor per block — one readback + native bipartitions win."""
+        if (
+            dgraph.m_pad >= DEVICE_EXTEND_MIN_EDGE_SLOTS
+            and len(spans) <= 64
+        ):
             return self._extend_partition_device(
                 dgraph, partition, spans, next_k, rng
             )

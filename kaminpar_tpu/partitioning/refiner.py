@@ -135,6 +135,13 @@ class RefinerPipeline:
                     full[: host.n] = refined
                     partition = jnp.asarray(full)
             elif algorithm == RefinementAlgorithm.GREEDY_FM:
+                # FM earns its host round-trip where moves are worth the
+                # most polish: the finest levels (coarse-level structure
+                # is Jet's job, and a full FM pass there re-pays ~0.1%
+                # cut for full pass cost).  Light intermediate extensions
+                # skip it entirely like they skip full Jet.
+                if self.light or level > self.ctx.refinement.fm.max_level:
+                    continue
                 from ..refinement.fm import fm_refine_host
 
                 with timer.scoped_timer("kway-fm"):

@@ -46,18 +46,32 @@ def fm_refine_host(
 
     graph = host_graph_from_device(dgraph)
     n = graph.n
-    part = np.asarray(partition)[:n].astype(np.int32)
+    # explicit copy: jax->numpy views are read-only and the native FM
+    # refines the partition in place
+    part = np.array(np.asarray(partition)[:n], dtype=np.int32, copy=True)
     max_bw = np.asarray(max_block_weights)[:k].astype(np.int64)
-    node_w = graph.node_weight_array()
-    edge_w = graph.edge_weight_array()
-    rng = np.random.default_rng(seed)
 
-    for _ in range(max(1, ctx.num_iterations)):
-        improvement = _fm_pass(
-            graph, part, node_w, edge_w, max_bw, k, ctx, rng
-        )
-        if improvement <= 0:
-            break
+    import os
+
+    native_ok = os.environ.get("KAMINPAR_TPU_NO_NATIVE_FM", "") != "1"
+    if native_ok:
+        from .. import native
+
+        # native localized BATCH FM (fm.cpp — the reference's parallel
+        # localized scheme minus threads: seeded regions grown against a
+        # delta gain overlay, best prefixes committed)
+        improvement = native.fm_refine(graph, part, k, max_bw, ctx, seed)
+        native_ok = improvement is not None
+    if not native_ok:
+        node_w = graph.node_weight_array()
+        edge_w = graph.edge_weight_array()
+        rng = np.random.default_rng(seed)
+        for _ in range(max(1, ctx.num_iterations)):
+            improvement = _fm_pass(
+                graph, part, node_w, edge_w, max_bw, k, ctx, rng
+            )
+            if improvement <= 0:
+                break
 
     padded = np.zeros(dgraph.n_pad, dtype=np.int32)
     padded[:n] = part

@@ -26,7 +26,10 @@ from kaminpar_tpu.graphs.factories import (
     ids=["grid", "star", "rmat", "isolated"],
 )
 def test_compressed_equals_csr(graph):
-    cg = compress_host_graph(graph)
+    # the "gap" codec round-trips the CSR EXACTLY; the default ("auto",
+    # v2 when native) may reorder within rows (interval members first,
+    # like the reference's interval decode) — covered by the v2 tests
+    cg = compress_host_graph(graph, codec="gap")
     assert cg.n == graph.n and cg.m == graph.m
     back = cg.decode()
     assert (back.xadj == graph.xadj).all()
@@ -71,7 +74,9 @@ def test_compressed_binary_roundtrip(tmp_path):
     back = load_graph(path)  # auto-detects the compressed container
     assert back.n == g.n and back.m == g.m
     dec = back.decode()
-    assert (dec.adjncy == g.adjncy).all()
+    # default codec (v2) may reorder within rows; compare row sets
+    assert (dec.xadj == g.xadj).all()
+    assert _row_sets(dec) == _row_sets(g)
 
 
 def test_terapart_preset_partitions_compressed(rgg2d):
@@ -104,3 +109,120 @@ def test_linear_time_kway_preset(rgg2d):
     )
     assert part.shape == (rgg2d.n,)
     assert part.min() >= 0 and part.max() < 4
+
+
+# ---------------------------------------------------------------------------
+# v2 codec: interval + streamvbyte-class residuals + varint weights
+# (native/codec2.cpp — TeraPart compressed_neighborhoods parity)
+# ---------------------------------------------------------------------------
+
+
+def _row_sets(g):
+    return [
+        sorted(g.adjncy[g.xadj[u]:g.xadj[u + 1]].tolist())
+        for u in range(g.n)
+    ]
+
+
+def test_v2_codec_roundtrip_unweighted():
+    from kaminpar_tpu import native
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    for gmaker in (
+        lambda: make_grid_graph(20, 20),  # interval-rich
+        lambda: make_rmat(1 << 10, 8_000, seed=5),
+    ):
+        g = gmaker()
+        cg = compress_host_graph(g, codec="v2")
+        assert cg.codec == "v2"
+        back = cg.decode()
+        assert back.n == g.n and back.m == g.m
+        np.testing.assert_array_equal(back.xadj, g.xadj)
+        assert _row_sets(back) == _row_sets(g)
+        # per-node decode agrees with bulk decode
+        for u in (0, 1, g.n // 2, g.n - 1):
+            np.testing.assert_array_equal(
+                cg.neighbors(u), back.adjncy[back.xadj[u]:back.xadj[u + 1]]
+            )
+
+
+def test_v2_codec_roundtrip_weighted_pairs():
+    from kaminpar_tpu import native
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    g = make_grid_graph(16, 16)
+    rng = np.random.default_rng(3)
+    g.edge_weights = rng.integers(1, 1000, g.m).astype(np.int64)
+    cg = compress_host_graph(g, codec="v2")
+    assert cg.wdata is not None
+    back = cg.decode()
+    # (neighbor, weight) multisets per row survive the emit reordering
+    for u in range(g.n):
+        orig = sorted(zip(
+            g.adjncy[g.xadj[u]:g.xadj[u + 1]].tolist(),
+            np.asarray(g.edge_weights)[g.xadj[u]:g.xadj[u + 1]].tolist(),
+        ))
+        got = sorted(zip(
+            back.adjncy[back.xadj[u]:back.xadj[u + 1]].tolist(),
+            np.asarray(back.edge_weights)[back.xadj[u]:back.xadj[u + 1]].tolist(),
+        ))
+        assert orig == got, f"row {u}"
+
+
+def test_v2_codec_beats_gap_codec_on_interval_graphs():
+    """Interval encoding must pay off where the reference's does: on
+    neighborhoods with consecutive runs (grids after degree-bucket
+    ordering, cliques)."""
+    from kaminpar_tpu import native
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.graphs.host import from_edge_list
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    # a union of cliques: every neighborhood is one long run
+    blocks, size = 16, 24
+    edges = []
+    for b in range(blocks):
+        base = b * size
+        for i in range(size):
+            for j in range(i + 1, size):
+                edges.append((base + i, base + j))
+    g = from_edge_list(blocks * size, np.array(edges))
+    v1 = compress_host_graph(g, codec="gap")
+    v2 = compress_host_graph(g, codec="v2")
+    assert v2.data.nbytes < 0.35 * v1.data.nbytes
+    assert v2.decode().m == g.m
+    assert v2.compression_ratio() > 8
+
+
+def test_compressed_binary_roundtrips_v2(tmp_path):
+    from kaminpar_tpu import native
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.io.compressed_binary import (
+        load_compressed,
+        write_compressed,
+    )
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    g = make_rmat(1 << 9, 4_000, seed=2)
+    rng = np.random.default_rng(0)
+    g.edge_weights = rng.integers(1, 50, g.m).astype(np.int64)
+    cg = compress_host_graph(g, codec="v2")
+    path = str(tmp_path / "g.npz")
+    write_compressed(path, cg)
+    lg = load_compressed(path)
+    assert lg.codec == "v2"
+    assert _row_sets(lg.decode()) == _row_sets(g)
