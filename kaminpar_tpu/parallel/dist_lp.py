@@ -354,8 +354,10 @@ def dist_lp_cluster(
 ) -> jax.Array:
     """Distributed size-constrained LP clustering (GlobalLPClusteringImpl
     analog, global_lp_clusterer.cc:54-594).  Returns i32[n_pad] cluster
-    labels, replicated.  The singleton post-passes (two-hop / isolated-node
-    clustering) currently run on the single-chip path only."""
+    labels, replicated.  The singleton post-passes (two-hop /
+    isolated-node clustering) run host-side on the replicated result —
+    see dist_singleton_postpasses (the dist driver applies them per
+    level)."""
     return _dist_lp_cluster_impl(
         graph.src.sharding.mesh, graph, jnp.asarray(max_cluster_weight),
         jnp.asarray(seed), cfg, num_iterations,
@@ -447,3 +449,116 @@ def dist_lp_refine(
         jnp.asarray(max_block_weights), jnp.asarray(seed), cfg,
         num_iterations,
     )
+
+
+def dist_singleton_postpasses(
+    host_graph,
+    labels: "np.ndarray",
+    max_cluster_weight: int,
+    threshold: float = 0.5,
+):
+    """Two-hop + isolated-node post-passes for the DIST clustering path
+    (label_propagation.h:872-1191 — the reference runs them wherever LP
+    clusters, including the distributed clusterer).  Low-degree graphs
+    under-coarsen on the mesh without them.
+
+    Operates on the replicated label array the dist clusterer returns,
+    host-side — the dist driver already holds the host graph to re-shard
+    each level, so this is one more O(m) numpy pass, not a new
+    device<->host round trip.  Mirrors the single-chip semantics: only
+    fires when the singleton fraction exceeds `threshold`
+    (lp_clusterer.cc two-hop gate); singletons sharing a FAVORED cluster
+    merge into weight-capped bins; isolated nodes pack into weight-capped
+    bins.  Bin membership is exact for arbitrary node weights: within
+    each quotient bin a capacity-respecting prefix accepts members until
+    the cap, and rejected (straddling) nodes stay singleton — the same
+    exactness rule as the device pass (ops/lp.cluster_isolated_nodes).
+    Returns the updated labels (modified copy).
+    """
+    import numpy as np
+
+    cap = max(int(max_cluster_weight), 1)
+    n = host_graph.n
+    lab = np.asarray(labels[:n], dtype=np.int64).copy()
+    node_w = host_graph.node_weight_array().astype(np.int64)
+    sizes = np.bincount(lab, minlength=n)
+    is_singleton = (lab == np.arange(n)) & (sizes[np.arange(n)] == 1)
+    if is_singleton.sum() < threshold * n:
+        out = np.asarray(labels).copy()
+        out[:n] = lab
+        return out
+
+    def _bin_merge(ids: np.ndarray, group: np.ndarray) -> None:
+        """Merge `ids` (each currently singleton) into weight-capped bins
+        WITHIN each `group` value: sub-bin by cumulative-weight quotient,
+        then accept a capacity-respecting prefix per (group, sub-bin);
+        the first accepted member leads, straddlers stay singleton."""
+        if len(ids) == 0:
+            return
+        order = np.lexsort((ids, group))
+        ids_s, grp_s = ids[order], group[order]
+        w = node_w[ids_s]
+        csum = np.cumsum(w)
+        firstg = np.ones(len(ids_s), dtype=bool)
+        firstg[1:] = grp_s[1:] != grp_s[:-1]
+        base = np.where(firstg, csum - w, 0)
+        np.maximum.accumulate(base, out=base)
+        within = csum - base  # cumulative weight inside the group
+        sub = (within - w) // cap  # quotient sub-bins
+        # prefix-accept inside each (group, sub-bin): reject straddlers
+        firstb = firstg | np.concatenate([[True], sub[1:] != sub[:-1]])
+        base_b = np.where(firstb, csum - w, 0)
+        np.maximum.accumulate(base_b, out=base_b)
+        within_b = csum - base_b
+        ok = within_b <= cap
+        # leader: first ACCEPTED member of each (group, sub-bin)
+        idx = np.arange(len(ids_s))
+        lead = np.where(firstb & ok, idx, -1)
+        np.maximum.accumulate(lead, out=lead)
+        do = ok & (lead >= 0)
+        lead_ids = ids_s[np.clip(lead, 0, len(ids_s) - 1)]
+        do &= lead_ids != ids_s
+        # reject members whose sub-bin leader was itself rejected: a
+        # leader slot is valid only if its own `ok` holds (firstb & ok
+        # produced it, so it does by construction)
+        lab[ids_s[do]] = lab[lead_ids[do]]
+
+    deg = host_graph.degrees()
+    # --- isolated nodes: pack into one global sequence of bins ----------
+    iso_ids = np.flatnonzero(is_singleton & (deg == 0))
+    _bin_merge(iso_ids, np.zeros(len(iso_ids), dtype=np.int64))
+
+    # --- two-hop: singletons grouped by FAVORED cluster -----------------
+    sing_ids = np.flatnonzero(is_singleton & (deg > 0))
+    if len(sing_ids):
+        src = host_graph.edge_sources()
+        ew = host_graph.edge_weight_array().astype(np.int64)
+        sing_mask = np.zeros(n, dtype=bool)
+        sing_mask[sing_ids] = True
+        keep = sing_mask[src]
+        s, c, w = src[keep], lab[host_graph.adjncy[keep]], ew[keep]
+        # favored cluster per singleton: argmax summed connection
+        key = s.astype(np.int64) * n + c
+        order = np.argsort(key, kind="stable")
+        key_s, s_s, c_s, w_s = key[order], s[order], c[order], w[order]
+        if len(key_s):
+            new_grp = np.empty(len(key_s), dtype=bool)
+            new_grp[0] = True
+            new_grp[1:] = key_s[1:] != key_s[:-1]
+            gid = np.cumsum(new_grp) - 1
+            g_w = np.bincount(gid, weights=w_s).astype(np.int64)
+            g_s = s_s[new_grp]
+            g_c = c_s[new_grp]
+            order2 = np.lexsort((g_w, g_s))
+            gs2 = g_s[order2]
+            last = np.empty(len(gs2), dtype=bool)
+            last[:-1] = gs2[:-1] != gs2[1:]
+            last[-1] = True
+            src_of_max = gs2[last]
+            fav_of_max = g_c[order2][last]
+            fav = fav_of_max[np.searchsorted(src_of_max, sing_ids)]
+            _bin_merge(sing_ids, fav)
+
+    out = np.asarray(labels).copy()
+    out[:n] = lab
+    return out

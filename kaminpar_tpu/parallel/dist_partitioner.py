@@ -169,6 +169,15 @@ class dKaMinPar:
                 )
                 lvl_seed = (ctx.seed * 7919 + len(levels) * 31337) & 0x7FFFFFFF
                 labels = clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
+                # singleton post-passes (two-hop + isolated packing) —
+                # the reference runs them wherever LP clusters
+                # (label_propagation.h:872-1191); without them low-degree
+                # graphs under-coarsen on the mesh
+                from .dist_lp import dist_singleton_postpasses
+
+                labels = dist_singleton_postpasses(
+                    current, np.asarray(labels), min(mcw, WMAX)
+                )
                 if current.m <= MAX_FUSED_EDGE_SLOTS:
                     # contraction on DEVICE (sort-based dedup kernel; see
                     # module docstring): only the coarse CSR is pulled

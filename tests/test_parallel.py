@@ -613,3 +613,52 @@ def test_dist_pipeline_with_forced_sharded_contraction(monkeypatch):
     np.add.at(bw, part, nw)
     cap = int((1 + eps) * np.ceil(nw.sum() / k)) + int(nw.max())
     assert (bw <= cap).all()
+
+
+def test_dist_singleton_postpasses_coarsen_low_degree_graphs():
+    """Two-hop + isolated post-passes on the dist path
+    (label_propagation.h:872-1191 analog): singletons sharing a favored
+    cluster merge, isolated nodes pack into weight-capped bins."""
+    from kaminpar_tpu.graphs.factories import make_isolated_graph, make_star
+    from kaminpar_tpu.parallel.dist_lp import dist_singleton_postpasses
+
+    # star: LP can cap-out the hub cluster, leaving leaf singletons that
+    # all favor the hub's cluster -> two-hop merges them
+    g = make_star(33)
+    labels = np.arange(64, dtype=np.int64)  # everything singleton
+    out = dist_singleton_postpasses(g, labels, max_cluster_weight=8)
+    lab = out[: g.n]
+    nclusters = len(np.unique(lab))
+    assert nclusters < g.n  # merged something
+    cw = np.zeros(g.n, dtype=np.int64)
+    np.add.at(cw, lab, g.node_weight_array())
+    assert cw.max() <= 8
+
+    # isolated nodes pack under the cap
+    gi = make_isolated_graph(12)
+    labels = np.arange(32, dtype=np.int64)
+    out = dist_singleton_postpasses(gi, labels, max_cluster_weight=4)
+    lab = out[: gi.n]
+    cw = np.zeros(gi.n, dtype=np.int64)
+    np.add.at(cw, lab, gi.node_weight_array())
+    assert cw.max() <= 4
+    assert len(np.unique(lab)) <= 4  # 12 unit nodes / cap 4 -> >= 3 bins
+
+
+def test_dist_singleton_postpasses_weighted_and_multibin():
+    """Cap exactness for non-unit weights, and multi-bin packing within a
+    favored group (both were bugs caught in review)."""
+    from kaminpar_tpu.graphs.factories import make_isolated_graph, make_star
+    from kaminpar_tpu.parallel.dist_lp import dist_singleton_postpasses
+
+    gi = make_isolated_graph(4)
+    gi.node_weights = np.full(4, 3, dtype=np.int64)
+    out = dist_singleton_postpasses(gi, np.arange(8, dtype=np.int64), 4)
+    cw = np.zeros(8, np.int64)
+    np.add.at(cw, out[:4], gi.node_weights)
+    assert cw.max() <= 4  # 3+3 > 4: no pair may form
+
+    g = make_star(20)
+    out = dist_singleton_postpasses(g, np.arange(32, dtype=np.int64), 4)
+    ncl = len(np.unique(out[: g.n]))
+    assert ncl <= 8  # leaves pack into multiple cap-4 bins, not one prefix
