@@ -52,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("-s", "--seed", type=int, default=0, help="RNG seed")
     p.add_argument(
+        "--stream-chunks", type=int, default=0, metavar="N",
+        help="generate a 'gen:' input in N streaming chunks (the KaGen "
+        "streaming mode, kaminpar-io/dist_skagen.cc: bounded generation "
+        "memory, chunking-invariant output; rmat/gnm/rgg2d only)",
+    )
+    p.add_argument(
         "-f", "--format", default="auto",
         choices=["auto", "metis", "parhip", "compressed"],
         help="input graph format",
@@ -83,9 +89,16 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     t_io = time.perf_counter()
     if args.graph.startswith("gen:"):
-        from .graphs.factories import generate
+        if args.stream_chunks > 0:
+            from .io.skagen import hostgraph_from_stream, streamed
 
-        graph = generate(args.graph)
+            graph = hostgraph_from_stream(
+                streamed(args.graph, num_chunks=args.stream_chunks)
+            )
+        else:
+            from .graphs.factories import generate
+
+            graph = generate(args.graph)
     else:
         graph = io_mod.load_graph(args.graph, fmt=args.format)
     io_s = time.perf_counter() - t_io

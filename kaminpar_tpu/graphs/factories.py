@@ -75,6 +75,17 @@ def make_matching_graph(num_pairs: int) -> HostGraph:
     return from_edge_list(2 * num_pairs, e)
 
 
+# Shared generator parameters — single source of truth for both the
+# in-process generators below and the streaming variants (io/skagen.py).
+RMAT_DEFAULT_ABC = (0.57, 0.19, 0.19)
+
+
+def rgg2d_radius(n: int, avg_degree: float) -> float:
+    """Connection radius giving ~avg_degree expected neighbors on the
+    unit square."""
+    return float(np.sqrt(avg_degree / (np.pi * max(n, 1))))
+
+
 def make_rgg2d(
     n: int, avg_degree: float = 8.0, seed: Optional[int] = None
 ) -> HostGraph:
@@ -83,7 +94,7 @@ def make_rgg2d(
     inputs of arbitrary size (stand-in for KaGen RGG2D)."""
     rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
     pts = rng.random((n, 2))
-    radius = np.sqrt(avg_degree / (np.pi * n))
+    radius = rgg2d_radius(n, avg_degree)
     # cell-grid neighbor search
     ncell = max(1, int(1.0 / radius))
     cell = (pts * ncell).astype(np.int64).clip(0, ncell - 1)
@@ -120,9 +131,9 @@ def make_rgg2d(
 def make_rmat(
     n: int,
     m: int,
-    a: float = 0.57,
-    b: float = 0.19,
-    c: float = 0.19,
+    a: float = RMAT_DEFAULT_ABC[0],
+    b: float = RMAT_DEFAULT_ABC[1],
+    c: float = RMAT_DEFAULT_ABC[2],
     seed: Optional[int] = None,
 ) -> HostGraph:
     """RMAT generator (stand-in for KaGen RMAT; BASELINE.json's scale-22
@@ -249,19 +260,27 @@ _GENERATORS = {
 }
 
 
-def generate(spec: str) -> HostGraph:
-    """Build a synthetic graph from a KaGen-style option string
-    (dKaMinPar's `-G "<type>;<key>=<value>;..."` surface,
-    kaminpar-io/dist_skagen.h): e.g. "rgg2d;n=1024;avg_degree=8",
-    "rmat;n=65536;m=1000000;seed=1", "grid3d;x=8;y=8;z=8"."""
+def parse_gen_spec(spec: str) -> tuple:
+    """Parse a KaGen-style option string (dKaMinPar's
+    `-G "<type>;<key>=<value>;..."` surface, kaminpar-io/dist_skagen.h)
+    into (name, kwargs) — shared by the in-process and streaming
+    (io/skagen.py) generator paths."""
     parts = [p for p in spec.replace("gen:", "", 1).split(";") if p]
     name = parts[0]
-    if name not in _GENERATORS:
-        raise ValueError(
-            f"unknown generator '{name}' (available: {sorted(_GENERATORS)})"
-        )
     kwargs = {}
     for p in parts[1:]:
         key, _, value = p.partition("=")
         kwargs[key.strip()] = float(value) if "." in value else int(value)
+    return name, kwargs
+
+
+def generate(spec: str) -> HostGraph:
+    """Build a synthetic graph from a KaGen-style option string: e.g.
+    "rgg2d;n=1024;avg_degree=8", "rmat;n=65536;m=1000000;seed=1",
+    "grid3d;x=8;y=8;z=8"."""
+    name, kwargs = parse_gen_spec(spec)
+    if name not in _GENERATORS:
+        raise ValueError(
+            f"unknown generator '{name}' (available: {sorted(_GENERATORS)})"
+        )
     return _GENERATORS[name](**kwargs)

@@ -28,6 +28,16 @@ def test_dcli_generator_input(capfd):
     assert rc == 0
 
 
+def test_dcli_streamed_generator_input(capfd):
+    """--stream-chunks routes gen: input through the KaGen streaming
+    analog (io/skagen.py) — same graph, bounded generation memory."""
+    rc = main(
+        ["gen:rmat;n=256;m=1024;seed=1", "-k", "2", "-n", "2", "-q",
+         "--stream-chunks", "4"]
+    )
+    assert rc == 0
+
+
 def test_dcli_errors_without_k(capfd):
     assert main([RGG]) == 1
     assert "need -k" in capfd.readouterr().err

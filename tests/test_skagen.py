@@ -1,0 +1,95 @@
+"""Streamed generation tests (kaminpar-io/dist_skagen.cc analog).
+
+The contract under test is KaGen's: the assembled graph is identical
+for ANY number of streaming chunks, and chunks cover disjoint
+contiguous vertex ranges.
+"""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs.host import validate
+from kaminpar_tpu.io.skagen import hostgraph_from_stream, streamed
+
+SPECS = [
+    "rmat;n=1024;m=8000;seed=3",
+    "gnm;n=500;m=3000;seed=5",
+    "rgg2d;n=800;avg_degree=8;seed=2",
+]
+
+
+@pytest.mark.parametrize("spec", SPECS)
+def test_chunking_invariance(spec):
+    ref = hostgraph_from_stream(streamed(spec, num_chunks=1))
+    for chunks in (3, 8):
+        g = hostgraph_from_stream(streamed(spec, num_chunks=chunks))
+        assert g.n == ref.n and g.m == ref.m
+        np.testing.assert_array_equal(g.xadj, ref.xadj)
+        np.testing.assert_array_equal(g.adjncy, ref.adjncy)
+        np.testing.assert_array_equal(
+            g.edge_weight_array(), ref.edge_weight_array()
+        )
+
+
+@pytest.mark.parametrize("spec", SPECS)
+def test_streamed_graph_is_valid(spec):
+    g = hostgraph_from_stream(streamed(spec, num_chunks=4))
+    validate(g, undirected=True)
+    assert g.n > 0 and g.m > 0
+
+
+def test_chunk_ranges_cover_disjointly():
+    sg = streamed("rmat;n=1024;m=4000;seed=1", num_chunks=7)
+    pos = 0
+    for c in range(sg.num_chunks):
+        v0, v1 = sg.chunk_range(c)
+        assert v0 == pos and v1 > v0
+        pos = v1
+    assert pos == sg.n
+
+
+def test_chunk_rows_match_assembled_graph():
+    sg = streamed("gnm;n=300;m=2000;seed=9", num_chunks=5)
+    g = hostgraph_from_stream(sg)
+    ch = sg.chunk(2)
+    v0, v1 = ch.v_begin, ch.v_end
+    for u in range(v0, v1):
+        lo, hi = ch.xadj[u - v0], ch.xadj[u - v0 + 1]
+        np.testing.assert_array_equal(np.sort(ch.adjncy[lo:hi]),
+                                      np.sort(g.neighbors(u)))
+
+
+def test_large_n_chunk_sort_key_no_overflow():
+    """Regression: with a multi-million-vertex chunk span the row sort
+    key must not wrap int64 (a power-of-two multiplier did; the key now
+    scales by n like from_edge_list's)."""
+    spec = "gnm;n=4194304;m=100000;seed=1"
+    one = hostgraph_from_stream(streamed(spec, num_chunks=1))
+    four = hostgraph_from_stream(streamed(spec, num_chunks=4))
+    validate(one, undirected=True)
+    np.testing.assert_array_equal(one.xadj, four.xadj)
+    np.testing.assert_array_equal(one.adjncy, four.adjncy)
+
+
+def test_seed_changes_graph():
+    a = hostgraph_from_stream(streamed("rmat;n=512;m=3000;seed=1", 2))
+    b = hostgraph_from_stream(streamed("rmat;n=512;m=3000;seed=2", 2))
+    assert a.m != b.m or not np.array_equal(a.adjncy, b.adjncy)
+
+
+def test_ba_has_no_streaming_form():
+    with pytest.raises(ValueError, match="streaming"):
+        streamed("ba;n=100;d=4")
+
+
+def test_partition_streamed_graph():
+    """End-to-end: the streamed graph feeds the normal pipeline."""
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    g = hostgraph_from_stream(streamed("rgg2d;n=600;avg_degree=6;seed=4", 4))
+    p = KaMinPar("fast")
+    p.set_output_level(OutputLevel.QUIET)
+    part = p.set_graph(g).compute_partition(k=4, epsilon=0.03, seed=1)
+    assert part.shape == (g.n,)
+    assert set(np.unique(part)) <= set(range(4))
