@@ -66,6 +66,36 @@ class CompressedHostGraph:
             return native.decode_v2_node(u, self.xadj, self.offsets, self.data)
         return native.decode_node(u, self.xadj, self.offsets, self.data)
 
+    def decode_range(self, v0: int, v1: int):
+        """Decode the rows of node range [v0, v1) only — the decoders
+        index their byte streams through absolute per-node offsets, so a
+        slice of (rebased xadj, offsets) decodes independently.  Returns
+        (xadj_rel i64[v1-v0+1], adjncy, edge_weights|None); peak memory
+        is the range's plain rows, which is what lets the sharded
+        ingestion path (parallel.dist_graph_from_compressed, the
+        DistributedCompressedGraph analog) stream shards."""
+        if not (0 <= v0 <= v1 <= self.n):
+            raise IndexError((v0, v1))
+        xadj_rel = self.xadj[v0 : v1 + 1] - self.xadj[v0]
+        offs = self.offsets[v0 : v1 + 1]
+        if self.codec == "v2":
+            adjncy = native.decode_v2(xadj_rel, offs, self.data)
+            ew = None
+            if self.wdata is not None:
+                ew = native.decode_v2_weights(
+                    xadj_rel, self.woffsets[v0 : v1 + 1], self.wdata
+                )
+            elif self.edge_weights is not None:
+                ew = self.edge_weights[self.xadj[v0] : self.xadj[v1]]
+        else:
+            adjncy = native.decode_gaps(xadj_rel, offs, self.data)
+            ew = (
+                None
+                if self.edge_weights is None
+                else self.edge_weights[self.xadj[v0] : self.xadj[v1]]
+            )
+        return xadj_rel, adjncy, ew
+
     def decode(self) -> HostGraph:
         """Materialize the full CSR graph."""
         if self.codec == "v2":
@@ -93,6 +123,18 @@ class CompressedHostGraph:
     @property
     def total_node_weight(self) -> int:
         return int(self.node_weight_array().sum())
+
+    @property
+    def total_edge_weight(self) -> int:
+        """Sum of edge weights without decoding the adjacency (the
+        weight stream alone is decoded when weights are compressed) —
+        lets PartitionContext.setup run on a still-compressed graph."""
+        if self.wdata is not None:
+            w = native.decode_v2_weights(self.xadj, self.woffsets, self.wdata)
+            return int(w.sum())
+        if self.edge_weights is not None:
+            return int(np.asarray(self.edge_weights, dtype=np.int64).sum())
+        return self.m
 
     def memory_bytes(self) -> int:
         total = self.xadj.nbytes + self.offsets.nbytes + self.data.nbytes

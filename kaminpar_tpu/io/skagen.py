@@ -71,6 +71,7 @@ class StreamedGraph:
         self.num_chunks = int(min(num_chunks, max(self.n, 1)))
         self.seed = int(seed)
         self.params = params
+        self._cell_counts_cache: Optional[np.ndarray] = None
 
     # -- vertex ranges ----------------------------------------------------
     def chunk_range(self, c: int) -> Tuple[int, int]:
@@ -97,9 +98,10 @@ class StreamedGraph:
 
     # -- counter-block edge generators (rmat / gnm) ----------------------
     def _edge_block(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Directed edge draws of counter block i — always generated at
-        full EDGE_BLOCK width so the RNG stream is chunking-invariant,
-        then sliced to the live range."""
+        """Directed edge draws of counter block i.  Chunking invariance
+        only needs block content to be a deterministic function of
+        (seed, block index): every consumer computes the same cnt for
+        block i, so the final partial block draws exactly cnt values."""
         m = int(self.params["m"])
         lo = i * EDGE_BLOCK
         cnt = min(EDGE_BLOCK, m - lo)
@@ -107,16 +109,16 @@ class StreamedGraph:
         if self.kind == "rmat":
             scale = self.params["scale"]
             probs = self.params["probs"]
-            u = np.zeros(EDGE_BLOCK, dtype=np.int64)
-            v = np.zeros(EDGE_BLOCK, dtype=np.int64)
+            u = np.zeros(cnt, dtype=np.int64)
+            v = np.zeros(cnt, dtype=np.int64)
             for _ in range(scale):
-                quad = rng.choice(4, size=EDGE_BLOCK, p=probs)
+                quad = rng.choice(4, size=cnt, p=probs)
                 u = (u << 1) | (quad >> 1)
                 v = (v << 1) | (quad & 1)
         else:  # gnm
-            u = rng.integers(0, self.n, EDGE_BLOCK, dtype=np.int64)
-            v = rng.integers(0, self.n, EDGE_BLOCK, dtype=np.int64)
-        return u[:cnt], v[:cnt]
+            u = rng.integers(0, self.n, cnt, dtype=np.int64)
+            v = rng.integers(0, self.n, cnt, dtype=np.int64)
+        return u, v
 
     def _edge_chunk(self, v0: int, v1: int) -> Tuple[np.ndarray, np.ndarray]:
         """All directed edges with source in [v0, v1): both directions of
@@ -141,8 +143,11 @@ class StreamedGraph:
     # -- RGG2D: deterministic cell grid ----------------------------------
     def _cell_counts(self) -> np.ndarray:
         """Points per cell via a deterministic recursive binomial split of
-        n — any chunk recomputes the same counts (O(#cells) memory; the
-        per-PE equivalent of KaGen's distributed splitting)."""
+        n — depends only on (seed, n, ncell), so it is computed once per
+        StreamedGraph and cached (O(#cells) memory; the per-PE equivalent
+        of KaGen's distributed splitting)."""
+        if self._cell_counts_cache is not None:
+            return self._cell_counts_cache
         ncell = self.params["ncell"]
         total_cells = ncell * ncell
         counts = np.zeros(total_cells, dtype=np.int64)
@@ -159,6 +164,7 @@ class StreamedGraph:
             left = int(rng.binomial(cnt, (mid - lo) / (hi - lo)))
             stack.append((lo, mid, left))
             stack.append((mid, hi, cnt - left))
+        self._cell_counts_cache = counts
         return counts
 
     def _cell_points(self, cell: int, count: int) -> np.ndarray:

@@ -9,7 +9,11 @@ control and cut reduction, `all_gather` for label/ghost synchronization.
 """
 
 from .mesh import make_mesh, make_torus_mesh, NODE_AXIS
-from .dist_graph import DistGraph, dist_graph_from_host
+from .dist_graph import (
+    DistGraph,
+    dist_graph_from_compressed,
+    dist_graph_from_host,
+)
 from .dist_lp import dist_lp_cluster, dist_lp_cluster_from, dist_lp_refine
 from .dist_metrics import dist_edge_cut
 from .dist_coloring import dist_greedy_coloring
@@ -34,6 +38,7 @@ __all__ = [
     "make_torus_mesh",
     "NODE_AXIS",
     "DistGraph",
+    "dist_graph_from_compressed",
     "dist_graph_from_host",
     "dist_lp_cluster",
     "dist_lp_cluster_from",

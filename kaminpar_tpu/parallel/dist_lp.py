@@ -456,6 +456,7 @@ def dist_singleton_postpasses(
     labels: "np.ndarray",
     max_cluster_weight: int,
     threshold: float = 0.5,
+    materialize=None,
 ):
     """Two-hop + isolated-node post-passes for the DIST clustering path
     (label_propagation.h:872-1191 — the reference runs them wherever LP
@@ -474,6 +475,12 @@ def dist_singleton_postpasses(
     the cap, and rejected (straddling) nodes stay singleton — the same
     exactness rule as the device pass (ops/lp.cluster_isolated_nodes).
     Returns the updated labels (modified copy).
+
+    `host_graph` may be a still-compressed graph (it is only asked for
+    n / node weights before the early-out); `materialize`, when given,
+    supplies the plain-CSR graph lazily the first time the passes
+    actually fire — the compressed dist ingestion path
+    (dist_partitioner) uses this so a non-firing level never decodes.
     """
     import numpy as np
 
@@ -487,6 +494,8 @@ def dist_singleton_postpasses(
         out = np.asarray(labels).copy()
         out[:n] = lab
         return out
+    if materialize is not None:
+        host_graph = materialize()
 
     def _bin_merge(ids: np.ndarray, group: np.ndarray) -> None:
         """Merge `ids` (each currently singleton) into weight-capped bins

@@ -54,7 +54,11 @@ from .dist_context import (
     create_dist_context_by_preset_name,
     create_dist_refiner,
 )
-from .dist_graph import DistGraph, dist_graph_from_host
+from .dist_graph import (
+    DistGraph,
+    dist_graph_from_compressed,
+    dist_graph_from_host,
+)
 from .dist_metrics import dist_edge_cut
 from .mesh import make_mesh
 
@@ -78,16 +82,36 @@ class dKaMinPar:
         self.ctx = ctx
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self._graph: Optional[HostGraph] = None
+        self._plain_cache: Optional[HostGraph] = None
+        self._fine_dg: Optional[DistGraph] = None
 
     def set_graph(self, graph) -> "dKaMinPar":
-        """Accepts a HostGraph or a CompressedHostGraph (decoded eagerly:
-        the distributed pipeline shards the plain CSR arrays)."""
+        """Accepts a HostGraph or a CompressedHostGraph.  A compressed
+        graph is KEPT compressed (the DistributedCompressedGraph analog,
+        kaminpar-dist/datastructures/distributed_compressed_graph.h):
+        the finest-level device ingestion streams one decoded node-range
+        shard at a time (dist_graph_from_compressed), and the plain fine
+        CSR materializes lazily only if a host-side consumer demands it
+        — in the terapart regime (kway mode, graph above the
+        single-device contraction budget, singleton post-passes not
+        firing) it never does."""
+        self._graph = graph
+        self._plain_cache = None
+        self._fine_dg = None
+        return self
+
+    def _is_compressed(self, g) -> bool:
         from ..graphs.compressed import CompressedHostGraph
 
-        if isinstance(graph, CompressedHostGraph):
-            graph = graph.decode()
-        self._graph = graph
-        return self
+        return isinstance(g, CompressedHostGraph)
+
+    def _plain(self, g) -> HostGraph:
+        """Materialize a possibly-compressed fine graph (cached)."""
+        if not self._is_compressed(g):
+            return g
+        if self._plain_cache is None:
+            self._plain_cache = g.decode()
+        return self._plain_cache
 
     def set_output_level(self, level) -> "dKaMinPar":
         """Instance-scoped output level (dkaminpar.h set_output_level
@@ -107,6 +131,8 @@ class dKaMinPar:
             node_weights=None if vwgt is None else np.asarray(vwgt),
             edge_weights=None if adjwgt is None else np.asarray(adjwgt),
         )
+        self._plain_cache = None
+        self._fine_dg = None
         return self
 
     def compute_partition(
@@ -134,11 +160,29 @@ class dKaMinPar:
             with timer.scoped_timer("dist-partitioning"):
                 partition = self._partition(graph, k)
 
-            from ..graphs.host import host_partition_metrics
+            if self._is_compressed(graph) and self._fine_dg is not None:
+                # still-compressed input: cut from the finest-level
+                # sharded graph (no CSR materialization), imbalance from
+                # node weights alone
+                full = np.zeros(self._fine_dg.n_pad, dtype=np.int32)
+                full[: graph.n] = partition
+                cut = dist_edge_cut_of(self._fine_dg, jnp.asarray(full))
+                import math as pymath
 
-            res = host_partition_metrics(graph, partition, k)
+                nw = graph.node_weight_array()
+                bw = np.zeros(k, dtype=np.int64)
+                np.add.at(bw, partition, nw)
+                # same definition as host_partition_metrics (ceil'd
+                # perfect weight) so the two RESULT paths cannot drift
+                perfect = max(1, pymath.ceil(int(nw.sum()) / k))
+                imbalance = float(bw.max() / perfect - 1.0)
+            else:
+                from ..graphs.host import host_partition_metrics
+
+                res = host_partition_metrics(self._plain(graph), partition, k)
+                cut, imbalance = res["cut"], res["imbalance"]
             log(
-                f"RESULT cut={res['cut']} imbalance={res['imbalance']:.6f} "
+                f"RESULT cut={cut} imbalance={imbalance:.6f} "
                 f"k={k} devices={self.mesh.devices.size}"
             )
         finally:
@@ -160,7 +204,13 @@ class dKaMinPar:
         threshold = max(2 * c_ctx.contraction_limit, k)
         with timer.scoped_timer("dist-coarsening"):
             while current.n > threshold:
-                dg = dist_graph_from_host(current, self.mesh)
+                if self._is_compressed(current):
+                    # still-compressed fine level: stream shards from the
+                    # compressed rows (bitwise-identical result)
+                    dg = dist_graph_from_compressed(current, self.mesh)
+                    self._fine_dg = dg
+                else:
+                    dg = dist_graph_from_host(current, self.mesh)
                 mcw = max(
                     1,
                     c_ctx.max_cluster_weight(
@@ -175,8 +225,10 @@ class dKaMinPar:
                 # graphs under-coarsen on the mesh
                 from .dist_lp import dist_singleton_postpasses
 
+                fine = current  # may be compressed; _plain caches decode
                 labels = dist_singleton_postpasses(
-                    current, np.asarray(labels), min(mcw, WMAX)
+                    current, np.asarray(labels), min(mcw, WMAX),
+                    materialize=lambda: self._plain(fine),
                 )
                 if current.m <= MAX_FUSED_EDGE_SLOTS:
                     # contraction on DEVICE (sort-based dedup kernel; see
@@ -184,7 +236,7 @@ class dKaMinPar:
                     # back, to re-shard it for the next level's 1D node
                     # distribution (the reference's migrate step,
                     # global_cluster_contraction.cc:1100+)
-                    fine_dev = device_graph_from_host(current)
+                    fine_dev = device_graph_from_host(self._plain(current))
                     lab_dev = jnp.asarray(labels)[: fine_dev.n_pad]
                     if lab_dev.shape[0] < fine_dev.n_pad:
                         lab_dev = jnp.concatenate([
@@ -254,7 +306,7 @@ class dKaMinPar:
                     # quiet the nested shm runs without leaking the
                     # process-global logger level past this scope
                     shm.set_output_level(OutputLevel.QUIET)
-                    shm.set_graph(current)
+                    shm.set_graph(self._plain(current))
                     # span-aware caps: when ip_k does not divide k the
                     # current blocks carry UNEQUAL final-block counts,
                     # and the IP must balance to those targets or the
@@ -277,7 +329,7 @@ class dKaMinPar:
                         ),
                         seed=(self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
                     )
-                    cut = self._host_cut(current, cand)
+                    cut = self._host_cut(self._plain(current), cand)
                     if best_cut is None or cut < best_cut:
                         partition, best_cut = cand, cut
             finally:
@@ -331,7 +383,7 @@ class dKaMinPar:
             from ..kaminpar import KaMinPar
 
             shm = KaMinPar(self.ctx.shm.copy())
-            partition = shm.set_graph(graph).compute_partition(
+            partition = shm.set_graph(self._plain(graph)).compute_partition(
                 k=k, epsilon=self.ctx.partition.epsilon, seed=self.ctx.seed
             )
             current_k = k
@@ -396,6 +448,7 @@ class dKaMinPar:
         from ..partitioning.deep import DeepMultilevelPartitioner
         from ..partitioning.rb import bipartition_max_block_weights, split_k
 
+        fine_host = self._plain(fine_host)  # extraction needs plain rows
         rng = np.random.default_rng(
             (self.ctx.seed * 63018038201 + len(spans)) & 0x7FFFFFFF
         )

@@ -226,3 +226,36 @@ def test_compressed_binary_roundtrips_v2(tmp_path):
     lg = load_compressed(path)
     assert lg.codec == "v2"
     assert _row_sets(lg.decode()) == _row_sets(g)
+
+
+def test_decode_range_matches_full_decode():
+    """decode_range must agree with full decode on every codec and
+    weight configuration (the shard-streaming ingestion contract)."""
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.graphs.host import HostGraph
+    from kaminpar_tpu import native
+
+    base = make_rmat(1 << 9, 4000, seed=2)
+    src = base.edge_sources()
+    lo = np.minimum(src, base.adjncy)
+    hi = np.maximum(src, base.adjncy)
+    ew = ((lo * 13 + hi * 5) % 7 + 1).astype(np.int64)
+    weighted = HostGraph(base.xadj, base.adjncy, edge_weights=ew)
+    codecs = ["gap"] + (["v2"] if native.available() else [])
+    for codec in codecs:
+        for g in (base, weighted):
+            cg = compress_host_graph(g, codec=codec)
+            full = cg.decode()
+            for v0, v1 in [(0, g.n), (0, 0), (g.n, g.n), (17, 173),
+                           (g.n // 2, g.n)]:
+                xr, adjn, w = cg.decode_range(v0, v1)
+                np.testing.assert_array_equal(
+                    xr, cg.xadj[v0:v1 + 1] - cg.xadj[v0]
+                )
+                s, e = int(cg.xadj[v0]), int(cg.xadj[v1])
+                np.testing.assert_array_equal(adjn, full.adjncy[s:e])
+                if g.edge_weights is not None:
+                    np.testing.assert_array_equal(
+                        w, full.edge_weight_array()[s:e]
+                    )

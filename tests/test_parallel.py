@@ -662,3 +662,131 @@ def test_dist_singleton_postpasses_weighted_and_multibin():
     out = dist_singleton_postpasses(g, np.arange(32, dtype=np.int64), 4)
     ncl = len(np.unique(out[: g.n]))
     assert ncl <= 8  # leaves pack into multiple cap-4 bins, not one prefix
+
+
+# -- DistributedCompressedGraph analog ---------------------------------------
+
+
+def _dist_graph_fields_equal(a, b):
+    for f in ("src", "dst", "edge_w", "node_w", "dst_local", "ghost_gid",
+              "send_idx", "recv_map"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)), err_msg=f
+        )
+    assert int(a.n) == int(b.n) and int(a.m) == int(b.m)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_dist_graph_from_compressed_matches_host(n_devices):
+    """Sharded ingestion from the compressed stream must be bitwise
+    identical to sharding the decoded graph
+    (distributed_compressed_graph.h parity contract)."""
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.parallel import dist_graph_from_compressed
+
+    g = make_rmat(1 << 10, 8000, seed=11)
+    cg = compress_host_graph(g)
+    mesh = make_mesh(n_devices)
+    a = dist_graph_from_compressed(cg, mesh)
+    b = dist_graph_from_host(cg.decode(), mesh)
+    _dist_graph_fields_equal(a, b)
+
+
+def test_dist_graph_from_compressed_weighted_edges():
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.graphs.factories import make_grid_graph
+    from kaminpar_tpu.graphs.host import HostGraph
+    from kaminpar_tpu.parallel import dist_graph_from_compressed
+
+    base = make_grid_graph(16, 16)
+    rng = np.random.default_rng(3)
+    # weight each undirected edge consistently in both directions
+    src = base.edge_sources()
+    lo = np.minimum(src, base.adjncy)
+    hi = np.maximum(src, base.adjncy)
+    ew = ((lo * 31 + hi * 7) % 9 + 1).astype(np.int64)
+    g = HostGraph(base.xadj, base.adjncy, edge_weights=ew)
+    cg = compress_host_graph(g)
+    mesh = make_mesh(4)
+    a = dist_graph_from_compressed(cg, mesh)
+    b = dist_graph_from_host(cg.decode(), mesh)
+    _dist_graph_fields_equal(a, b)
+
+
+def test_dkaminpar_partitions_compressed_via_shard_streaming(monkeypatch):
+    """dKaMinPar keeps a compressed input compressed: the finest-level
+    ingestion must go through dist_graph_from_compressed (the graph is
+    large enough to coarsen, so the branch actually runs)."""
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.parallel import dKaMinPar, dist_partitioner
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    calls = []
+    real = dist_partitioner.dist_graph_from_compressed
+    monkeypatch.setattr(
+        dist_partitioner, "dist_graph_from_compressed",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1],
+    )
+    g = make_rmat(1 << 13, 60000, seed=5)
+    cg = compress_host_graph(g)
+    solver = dKaMinPar("default", mesh=make_mesh(4))
+    solver.set_output_level(OutputLevel.QUIET)
+    part = solver.set_graph(cg).compute_partition(k=4, epsilon=0.03, seed=1)
+    assert calls, "compressed ingestion branch never ran"
+    assert part.shape == (g.n,)
+    nw = g.node_weight_array()
+    bw = np.zeros(4, dtype=np.int64)
+    np.add.at(bw, part, nw)
+    cap = (1 + 0.03) * np.ceil(nw.sum() / 4)
+    assert bw.max() <= cap
+
+
+def test_dkaminpar_compressed_kway_sharded_never_materializes(monkeypatch):
+    """In the terapart regime (kway mode + sharded contraction + no
+    singleton post-pass firing) the plain fine CSR must never exist:
+    decode() is patched to raise."""
+    from kaminpar_tpu.graphs.compressed import (
+        CompressedHostGraph,
+        compress_host_graph,
+    )
+    from kaminpar_tpu.parallel import dKaMinPar, dist_partitioner
+    from kaminpar_tpu.context import PartitioningMode
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    g = make_rmat(1 << 13, 60000, seed=5)
+    cg = compress_host_graph(g)
+    # force the sharded contraction path (graph "above" the budget)
+    monkeypatch.setattr(dist_partitioner, "MAX_FUSED_EDGE_SLOTS", 1)
+
+    def boom(self):
+        raise AssertionError("fine CSR materialized on the compressed path")
+
+    monkeypatch.setattr(CompressedHostGraph, "decode", boom)
+    solver = dKaMinPar("default", mesh=make_mesh(4))
+    solver.ctx.mode = PartitioningMode.KWAY
+    solver.set_output_level(OutputLevel.QUIET)
+    part = solver.set_graph(cg).compute_partition(k=4, epsilon=0.03, seed=1)
+    assert part.shape == (g.n,)
+    assert set(np.unique(part)) <= set(range(4))
+
+
+def test_dkaminpar_copy_graph_clears_compressed_state():
+    """Regression: copy_graph after a compressed set_graph must not
+    leave the stale compressed topology driving the finest level."""
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+    from kaminpar_tpu.parallel import dKaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    a = make_rmat(1 << 13, 60000, seed=1)
+    b = make_rmat(1 << 13, 60000, seed=2)
+    solver = dKaMinPar("default", mesh=make_mesh(2))
+    solver.set_output_level(OutputLevel.QUIET)
+    solver.set_graph(compress_host_graph(a))
+    p1 = solver.compute_partition(k=4, epsilon=0.03, seed=1)
+    solver.copy_graph(None, b.xadj, b.adjncy, adjwgt=b.edge_weights)
+    p2 = solver.compute_partition(k=4, epsilon=0.03, seed=1)
+    fresh = dKaMinPar("default", mesh=make_mesh(2))
+    fresh.set_output_level(OutputLevel.QUIET)
+    p3 = fresh.set_graph(b).compute_partition(k=4, epsilon=0.03, seed=1)
+    np.testing.assert_array_equal(p2, p3)
+    assert p1.shape == (a.n,)
