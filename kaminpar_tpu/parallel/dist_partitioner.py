@@ -106,12 +106,14 @@ class dKaMinPar:
         return isinstance(g, CompressedHostGraph)
 
     def _plain(self, g) -> HostGraph:
-        """Materialize a possibly-compressed fine graph (cached)."""
+        """Materialize a possibly-compressed fine graph (cached, keyed
+        on the source object so a different graph can never be handed
+        someone else's decode)."""
         if not self._is_compressed(g):
             return g
-        if self._plain_cache is None:
-            self._plain_cache = g.decode()
-        return self._plain_cache
+        if self._plain_cache is None or self._plain_cache[0] is not g:
+            self._plain_cache = (g, g.decode())
+        return self._plain_cache[1]
 
     def set_output_level(self, level) -> "dKaMinPar":
         """Instance-scoped output level (dkaminpar.h set_output_level
@@ -176,6 +178,9 @@ class dKaMinPar:
                 # perfect weight) so the two RESULT paths cannot drift
                 perfect = max(1, pymath.ceil(int(nw.sum()) / k))
                 imbalance = float(bw.max() / perfect - 1.0)
+                # the finest sharded arrays are only retained for this
+                # metrics call — release the device memory
+                self._fine_dg = None
             else:
                 from ..graphs.host import host_partition_metrics
 
