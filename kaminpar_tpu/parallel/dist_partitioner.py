@@ -82,7 +82,8 @@ class dKaMinPar:
         self.ctx = ctx
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self._graph: Optional[HostGraph] = None
-        self._plain_cache: Optional[HostGraph] = None
+        # (source graph, decoded HostGraph) — keyed on the source object
+        self._plain_cache: Optional[Tuple[object, HostGraph]] = None
         self._fine_dg: Optional[DistGraph] = None
 
     def set_graph(self, graph) -> "dKaMinPar":
