@@ -60,6 +60,22 @@ def _delta_slots(graph: DeviceGraph) -> int | None:
     return m_slots // 4
 
 
+def _conn_cut(
+    graph: DeviceGraph, conn: jax.Array, part: jax.Array, wdeg: jax.Array,
+    k: int,
+) -> jax.Array:
+    """Exact cut of `part` from its conn table:
+    sum over real nodes of (weighted degree - connection to own block),
+    halved (each cut edge counts at both endpoints)."""
+    is_real = jnp.arange(graph.n_pad, dtype=jnp.int32) < graph.n
+    conn_own = jnp.take_along_axis(
+        conn, jnp.clip(part, 0, k - 1)[:, None], axis=1
+    )[:, 0]
+    return jnp.sum(
+        jnp.where(is_real, wdeg - conn_own, 0).astype(ACC_DTYPE)
+    ) // 2
+
+
 def _scatter_conn_delta(
     conn: jax.Array,
     owner_c: jax.Array,
@@ -392,14 +408,7 @@ def _jet_round_close(
     from .metrics import is_feasible as feasibility
 
     if conn is not None:
-        is_real = jnp.arange(graph.n_pad, dtype=jnp.int32) < graph.n
-        conn_own = jnp.take_along_axis(
-            conn, jnp.clip(part, 0, k - 1)[:, None], axis=1
-        )[:, 0]
-        ext = jnp.sum(
-            jnp.where(is_real, wdeg - conn_own, 0).astype(ACC_DTYPE)
-        )
-        cut = ext // 2
+        cut = _conn_cut(graph, conn, part, wdeg, k)
     else:
         cut = edge_cut(graph, part)
     is_best = (cut <= best_cut) & feasibility(graph, part, max_block_weights)
@@ -421,18 +430,21 @@ def _jet_build_conn(graph: DeviceGraph, part: jax.Array, k: int):
 
 @partial(jax.jit, static_argnames=("k",))
 def _jet_init(graph: DeviceGraph, partition: jax.Array, k: int,
-              max_block_weights: jax.Array):
+              max_block_weights: jax.Array, wdeg: jax.Array):
+    """Clip the input partition, build the round-0 conn table, and derive
+    the starting cut FROM the table (one segment_sum instead of a
+    separate edge-wide cut pass — the table is needed anyway)."""
     part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
     bw = jax.ops.segment_sum(
         graph.node_w.astype(ACC_DTYPE), part0, num_segments=k
     )
     feasible = jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
+    conn = _jet_build_conn(graph, part0, k)  # nested jit inlines
+    cut = _conn_cut(graph, conn, part0, wdeg, k)
     # snapshots track the best FEASIBLE cut; an infeasible input (e.g.
     # everything in one block, cut 0) must not pin the snapshot
-    best_cut0 = jnp.where(
-        feasible, edge_cut(graph, part0), jnp.iinfo(ACC_DTYPE).max
-    )
-    return part0, best_cut0
+    best_cut0 = jnp.where(feasible, cut, jnp.iinfo(ACC_DTYPE).max)
+    return part0, best_cut0, conn
 
 
 def _jet_refine_impl(
@@ -450,8 +462,6 @@ def _jet_refine_impl(
     balancer_rounds: int,
     chunk: int = 4,
 ) -> jax.Array:
-    part, best_cut = _jet_init(graph, partition, k, max_block_weights)
-    best = part
     # static per-node weighted degree (one streaming pass per refine
     # call, via the CSR row spans): each iteration's rating table then
     # yields the visited partition's exact cut as sum(wdeg - conn_own)/2
@@ -460,6 +470,10 @@ def _jet_refine_impl(
     csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
     row_ptr = jnp.clip(graph.row_ptr, 0, graph.edge_w.shape[0])
     wdeg = csum0[row_ptr[1:]] - csum0[row_ptr[:-1]]
+    part, best_cut, conn = _jet_init(
+        graph, partition, k, max_block_weights, wdeg
+    )
+    best = part
     # scale the iteration chunk down with edge count so each launch
     # stays short (see segments.MAX_FUSED_EDGE_SLOTS)
     m_pad = graph.src.shape[0]
@@ -467,7 +481,6 @@ def _jet_refine_impl(
         chunk = 1
     elif m_pad > MAX_FUSED_EDGE_SLOTS // 2:
         chunk = min(chunk, 2)
-    conn = None
     for rnd in range(num_rounds):
         if num_rounds > 1:
             gain_temp = initial_gain_temp + (
