@@ -49,16 +49,38 @@ def test_dist_lp_cluster_valid_and_capped(n_devices):
 
 
 def test_dist_lp_cluster_agrees_across_device_counts():
+    """The reference pins dist invariants under 1/2/4 ranks on one box
+    (tests/CMakeLists.txt:114-117).  Bulk-synchronous commit order
+    differs per device count, so cluster COUNTS are compared within a
+    moderate band across 1/2/4/8 devices — and every count must respect
+    the cap and actually coarsen (the hard invariants are exact)."""
     graph = make_grid_graph(16, 16)
-    results = []
-    for nd in (1, 8):
+    cap = 32
+    counts = {}
+    for nd in (1, 2, 4, 8):
         mesh = make_mesh(nd)
         dg = dist_graph_from_host(graph, mesh)
-        labels = np.asarray(dist_lp_cluster(dg, 32, seed=3))
-        results.append(cluster_stats(graph, labels)[0])
-    # not bitwise-identical (different commit orders), but same ballpark
-    a, b = results
-    assert 0.3 * a <= b <= 3.3 * a
+        labels = np.asarray(dist_lp_cluster(dg, cap, seed=3))
+        nclusters, max_w = cluster_stats(graph, labels)
+        assert max_w <= cap, nd
+        assert nclusters < graph.n // 2, nd
+        counts[nd] = nclusters
+    lo, hi = min(counts.values()), max(counts.values())
+    # measured spread on this fixture is ~15%; 1.6x catches topology-
+    # breaking regressions while tolerating commit-order divergence
+    assert hi <= 1.6 * lo, counts
+
+
+def test_dist_lp_cluster_rerun_is_deterministic():
+    """Same mesh + same seed must be bitwise-reproducible (the dist
+    analog of the shm rerun-determinism pin in the reference's
+    endtoend tests)."""
+    graph = make_grid_graph(16, 16)
+    mesh = make_mesh(4)
+    dg = dist_graph_from_host(graph, mesh)
+    a = np.asarray(dist_lp_cluster(dg, 32, seed=3))
+    b = np.asarray(dist_lp_cluster(dg, 32, seed=3))
+    np.testing.assert_array_equal(a, b)
 
 
 def test_dist_edge_cut_matches_host():
