@@ -448,35 +448,20 @@ def _lp_cluster_impl(
     has_communities: bool,
 ) -> jax.Array:
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
-    n_pad = graph.n_pad
-    labels0 = jnp.arange(n_pad, dtype=jnp.int32)
-    weights0 = graph.node_w.astype(ACC_DTYPE)
-    active0 = jnp.ones(n_pad, dtype=bool)
     comm = communities if has_communities else None
+    labels, weights = _lp_cluster_fused_rounds(
+        graph, max_cluster_weight, seed, comm, cfg, iters
+    )
+    return _lp_cluster_postpasses_traced(
+        graph, labels, weights, max_cluster_weight, seed, cfg,
+        has_communities,
+    )
 
-    def cond(state):
-        i, _, _, _, moved = state
-        return (i < iters) & (moved != 0)
 
-    def body(state):
-        i, labels, weights, active, _ = state
-        salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
-        labels, weights, active, moved = _round_with_delta(
-            graph,
-            labels,
-            weights,
-            max_cluster_weight,
-            active,
-            salt,
-            cfg,
-            comm,
-            i,
-        )
-        return (i + 1, labels, weights, active, moved)
-
-    init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1))
-    _, labels, weights, _, _ = lax.while_loop(cond, body, init)
-
+def _lp_cluster_postpasses_traced(
+    graph, labels, weights, max_cluster_weight, seed, cfg: LPConfig,
+    has_communities: bool,
+):
     if not has_communities:
         # community-restricted clustering (v-cycles) skips the singleton
         # post-passes: they could merge across community boundaries
@@ -489,6 +474,100 @@ def _lp_cluster_impl(
                 graph, labels, weights, max_cluster_weight, seed, cfg
             )
     return labels
+
+
+_lp_cluster_postpasses = jax.jit(
+    _lp_cluster_postpasses_traced,
+    static_argnames=("cfg", "has_communities"),
+)
+
+
+def _lp_cluster_chunked(
+    graph: DeviceGraph,
+    max_cluster_weight: jax.Array,
+    seed: jax.Array,
+    comm,
+    cfg: LPConfig,
+    iters: int,
+    has_communities: bool,
+) -> jax.Array:
+    """One clustering round per launch — the TPU-worker watchdog guard
+    above the fused budget (a multi-round fused clustering loop at
+    128M-slot shapes is a multi-minute single launch that reproducibly
+    kills the worker; the Jet/LP-refine chunking already guards the
+    same failure mode).  Lives OUTSIDE jit: the convergence exit reads
+    `moved` back per round.  Visits identical states to the fused loop:
+    the python salt masked to 31 bits equals the traced int32-wraparound
+    product (bit 31 of an addend cannot reach lower sum bits), and all
+    state is integer, so results are bitwise-equal (tested)."""
+    n_pad = graph.n_pad
+    labels = jnp.arange(n_pad, dtype=jnp.int32)
+    weights = graph.node_w.astype(ACC_DTYPE)
+    active = jnp.ones(n_pad, dtype=bool)
+    for i in range(iters):
+        off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
+        salt = (jnp.asarray(seed, jnp.int32) * 131071 + off) & 0x7FFFFFFF
+        labels, weights, active, moved = _lp_cluster_round_launch(
+            graph, labels, weights, max_cluster_weight, active,
+            salt, jnp.int32(i), cfg, comm,
+        )
+        if int(moved) == 0:
+            break
+    return _lp_cluster_postpasses(
+        graph, labels, weights, max_cluster_weight, seed, cfg,
+        has_communities,
+    )
+
+
+@partial(jax.jit, static_argnames=("cfg", "has_comm"))
+def _lp_cluster_round_launch_jit(
+    graph, labels, weights, max_cluster_weight, active, salt, i,
+    cfg: LPConfig, communities, has_comm: bool,
+):
+    return _round_with_delta(
+        graph, labels, weights, max_cluster_weight, active, salt, cfg,
+        communities if has_comm else None, i,
+    )
+
+
+def _lp_cluster_round_launch(
+    graph, labels, weights, max_cluster_weight, active, salt, i,
+    cfg: LPConfig, comm,
+):
+    has_comm = comm is not None
+    # the dummy is a 1-element array (never read when has_comm is False)
+    return _lp_cluster_round_launch_jit(
+        graph, labels, weights, max_cluster_weight, active, salt, i, cfg,
+        comm if has_comm else jnp.zeros(1, dtype=jnp.int32),
+        has_comm,
+    )
+
+
+def _lp_cluster_fused_rounds(
+    graph, max_cluster_weight, seed, comm, cfg: LPConfig, iters: int
+):
+    """The fused multi-round clustering loop (one launch)."""
+    n_pad = graph.n_pad
+    labels0 = jnp.arange(n_pad, dtype=jnp.int32)
+    weights0 = graph.node_w.astype(ACC_DTYPE)
+    active0 = jnp.ones(n_pad, dtype=bool)
+
+    def cond(state):
+        i, _, _, _, moved = state
+        return (i < iters) & (moved != 0)
+
+    def body(state):
+        i, labels, weights, active, _ = state
+        salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
+        labels, weights, active, moved = _round_with_delta(
+            graph, labels, weights, max_cluster_weight, active, salt,
+            cfg, comm, i,
+        )
+        return (i + 1, labels, weights, active, moved)
+
+    init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1))
+    _, labels, weights, _, _ = lax.while_loop(cond, body, init)
+    return labels, weights
 
 
 def lp_cluster(
@@ -510,7 +589,19 @@ def lp_cluster(
 
     Returns i32[n_pad] cluster labels (values are node ids; pad slots keep
     their own id)."""
+    from .segments import MAX_FUSED_EDGE_SLOTS
+
     has_comm = communities is not None
+    iters = (
+        num_iterations if num_iterations is not None else cfg.num_iterations
+    )
+    if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
+        # watchdog guard: the dispatch must stay OUTSIDE jit — the
+        # chunked loop reads the convergence flag back per round
+        return _lp_cluster_chunked(
+            graph, max_cluster_weight, seed, communities, cfg, iters,
+            has_comm,
+        )
     if communities is None:
         communities = jnp.zeros(graph.n_pad, dtype=jnp.int32)
     return _lp_cluster_impl(

@@ -296,3 +296,32 @@ def test_delta_rounds_match_full_rounds(monkeypatch):
 
     np.testing.assert_array_equal(delta_labels, full_labels)
     np.testing.assert_array_equal(delta_part, full_part)
+
+
+def test_chunked_cluster_launches_match_fused(monkeypatch):
+    """Above MAX_FUSED_EDGE_SLOTS, LP clustering runs one round per
+    launch (the TPU-worker watchdog guard the refiners already had; a
+    fused multi-round clustering loop at 128M-slot shapes reproducibly
+    killed the worker).  All-integer state means the chunked path must
+    visit the fused path's states BITWISE."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import kaminpar_tpu.ops.lp as lp_mod
+    import kaminpar_tpu.ops.segments as seg_mod
+    from kaminpar_tpu.graphs import device_graph_from_host, factories
+    from kaminpar_tpu.ops.lp import lp_cluster
+
+    g = device_graph_from_host(factories.make_rmat(1 << 10, 8_000, seed=9))
+    cap = jnp.int32(40)
+    fused = np.asarray(lp_cluster(g, cap, jnp.int32(5)))
+    calls = []
+    real = lp_mod._lp_cluster_chunked
+    monkeypatch.setattr(
+        lp_mod, "_lp_cluster_chunked",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1],
+    )
+    monkeypatch.setattr(seg_mod, "MAX_FUSED_EDGE_SLOTS", 1024)
+    chunked = np.asarray(lp_cluster(g, cap, jnp.int32(5)))
+    assert calls, "chunked clustering branch never ran"
+    np.testing.assert_array_equal(chunked, fused)
