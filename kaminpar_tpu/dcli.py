@@ -128,7 +128,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # library prints the result, the CLI prints only timings)
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
         if args.timers:
-            print(timer.GLOBAL_TIMER.render())
+            # dist timer finalize (kaminpar-dist/timer.cc analog):
+            # min/avg/max per scope across processes — on one host the
+            # three coincide, on a real multi-host mesh they expose
+            # imbalance between hosts
+            agg = timer.aggregate_across_processes()
+            print(timer.render_aggregated(agg))
         if args.machine_timers:
             print("TIMERS " + timer.GLOBAL_TIMER.render_machine())
 

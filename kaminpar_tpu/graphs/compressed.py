@@ -49,6 +49,21 @@ class CompressedHostGraph:
     wdata: Optional[np.ndarray] = None  # u8: varint weights (v2 only)
     woffsets: Optional[np.ndarray] = None  # i64[n+1] (v2 only)
 
+    def __post_init__(self):
+        if (
+            self.codec == "v2"
+            and self.edge_weights is not None
+            and self.wdata is None
+        ):
+            # v2 decodes adjacency in EMIT order (interval members first),
+            # so weights must come from the v2 weight stream (wdata),
+            # which is written in the same order; a raw input-order
+            # edge_weights array would silently misalign
+            raise ValueError(
+                "v2-codec graphs must carry edge weights as wdata "
+                "(emit-order compressed stream), not raw edge_weights"
+            )
+
     @property
     def n(self) -> int:
         return len(self.xadj) - 1
@@ -80,13 +95,12 @@ class CompressedHostGraph:
         offs = self.offsets[v0 : v1 + 1]
         if self.codec == "v2":
             adjncy = native.decode_v2(xadj_rel, offs, self.data)
+            # __post_init__ guarantees v2 never carries raw edge_weights
             ew = None
             if self.wdata is not None:
                 ew = native.decode_v2_weights(
                     xadj_rel, self.woffsets[v0 : v1 + 1], self.wdata
                 )
-            elif self.edge_weights is not None:
-                ew = self.edge_weights[self.xadj[v0] : self.xadj[v1]]
         else:
             adjncy = native.decode_gaps(xadj_rel, offs, self.data)
             ew = (

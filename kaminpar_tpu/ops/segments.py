@@ -811,10 +811,23 @@ def packed_afterburner_gain_rows(
     with spans [start, end) per node (see expand_active_rows).  Kept
     separate from the row_ptr variant so the Jet refiner's compiled
     executables stay byte-identical."""
-    n_pad = part.shape[0]
     label_bits = max((k - 1).bit_length(), 1)
     gain_bits = 31 - 2 * label_bits
-    if gain_bits >= 15:
+
+    def _row_sums(to_u, from_u, block_v, u_is_cand):
+        contrib = jnp.where(
+            to_u == block_v,
+            edge_w,
+            jnp.where(from_u == block_v, -edge_w, 0),
+        )
+        csum = jnp.cumsum(
+            jnp.where(u_is_cand, contrib, 0).astype(ACC_DTYPE)
+        )
+        csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
+        D = contrib.shape[0]
+        return csum0[jnp.clip(end, 0, D)] - csum0[jnp.clip(start, 0, D)]
+
+    def _packed(_):
         half = jnp.int32(1 << (gain_bits - 1))
         gain_clip = jnp.clip(gain, 1 - half, half - 1) + half
         gain_field = jnp.where(candidate, gain_clip, 0)
@@ -837,8 +850,9 @@ def packed_afterburner_gain_rows(
         )
         to_u = (mu >> label_bits) & lab_mask
         from_u = mu & lab_mask
-        u_is_cand = gain_u > 0
-    else:
+        return _row_sums(to_u, from_u, block_v, gain_u > 0)
+
+    def _exact(_):
         gain_full = jnp.where(candidate, gain, INT32_MIN)
         gain_u = gain_full[owner]
         gain_v = gain_full[dst]
@@ -847,18 +861,25 @@ def packed_afterburner_gain_rows(
             (gain_v > gain_u) | ((gain_v == gain_u) & (dst < owner))
         )
         block_v = jnp.where(v_before_u, next_part[dst], part[dst])
-        to_u = next_part[owner]
-        from_u = part[owner]
-        u_is_cand = gain_u > INT32_MIN
-    contrib = jnp.where(
-        to_u == block_v,
-        edge_w,
-        jnp.where(from_u == block_v, -edge_w, 0),
+        return _row_sums(
+            next_part[owner], part[owner], block_v, gain_u > INT32_MIN
+        )
+
+    if gain_bits < 15:
+        # huge k: the packed layout has no room at all
+        return _exact(None)
+    # clip guard: the packed gain field only orders moves correctly while
+    # every candidate's |gain| fits its `gain_bits - 1` bits.  Heavy edge
+    # weights (or degrees >~16k at k=256) push gains past the clip range
+    # and silently change move SELECTION vs the exact ordering — so the
+    # regime is detected at runtime (an n-wide reduce on values already
+    # in hand) and the exact per-endpoint-gather path takes over.  Both
+    # branches compile once; only one executes per call.
+    half = jnp.int32(1 << (gain_bits - 1))
+    max_abs_gain = jnp.max(
+        jnp.where(candidate, jnp.abs(jnp.clip(gain, -2**30, 2**30)), 0)
     )
-    csum = jnp.cumsum(jnp.where(u_is_cand, contrib, 0).astype(ACC_DTYPE))
-    csum0 = jnp.concatenate([jnp.zeros(1, dtype=csum.dtype), csum])
-    D = contrib.shape[0]
-    return csum0[jnp.clip(end, 0, D)] - csum0[jnp.clip(start, 0, D)]
+    return lax.cond(max_abs_gain < half, _packed, _exact, None)
 
 
 def neighbor_any_true(

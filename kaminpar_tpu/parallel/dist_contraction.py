@@ -11,18 +11,24 @@ on one device.  Per device inside `shard_map`:
      driver already holds);
   2. locally deduplicate (cu, cv) pairs with one sort-based
      aggregate_by_key — the per-PE rating-map dedup of the reference;
-  3. MIGRATE: bucket the deduplicated rows by the owner device of cu
-     (contiguous coarse-id chunks) and exchange them with ONE static
-     [D, cap] all_to_all — the reference's sparse alltoall of coarse
-     edges;
+  3. MIGRATE: bucket the deduplicated rows by HASH(cu, cv) mod D and
+     exchange them with ONE static [D, cap] all_to_all — the
+     reference's sparse alltoall of coarse edges.  Hashing the PAIR
+     (not cu ownership chunks) is the skew defense: a star-like
+     clustering concentrates all coarse edges on one cu, but its
+     (cu, cv) pairs still spread uniformly because cv varies — no
+     single device's buckets can be flooded by one heavy coarse node
+     (the reference instead rebalances explicit node ownership,
+     global_cluster_contraction.cc:1100+; a uniform hash needs no
+     balancing pass at all);
   4. merge rows arriving from different source devices with a second
      aggregate_by_key; every (cu, cv) pair now lives exactly once, on
-     cu's owner.
+     its hash owner.
 
-The host driver assembles the per-shard results into the coarse CSR (the
-shards have disjoint, ascending cu ranges, so assembly is a concatenate)
-— the coarse graph is geometrically smaller, and the fine edge list never
-leaves its shards.
+The host driver assembles the per-shard results into the coarse CSR
+(one lexsort of coarse-sized rows — shards hold disjoint pair sets but
+interleaved cu ranges) — the coarse graph is geometrically smaller, and
+the fine edge list never leaves its shards.
 """
 
 from __future__ import annotations
@@ -42,35 +48,31 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..graphs.host import HostGraph
-from ..ops.segments import ACC_DTYPE, aggregate_by_key
+from ..ops.segments import ACC_DTYPE, aggregate_by_key, hash_u32
 from .dist_graph import DistGraph
 from .mesh import NODE_AXIS
 
-# output rows per device = OUT_FACTOR * m_loc; a device's merged coarse
-# rows exceed its fine edge shard only under extreme skew — the driver
-# checks the returned count and raises rather than truncating
+# output rows per device = OUT_FACTOR * m_loc; with hash-bucketed pairs
+# a device's merged coarse rows concentrate only if the HASH does, so
+# this is a safety net, not a skew knob — the driver checks the returned
+# count and raises rather than truncating
 OUT_FACTOR = 2
 
 # per-peer migrate bucket capacity = max(m_loc * BUCKET_SLACK // D,
 # BUCKET_MIN): O(m_loc/D) per device instead of O(m_loc) per PEER, so
 # total buffer memory stays O(m_loc * slack) — the point of sharding.
-# Skewed targets overflow-detect (count per bucket) and raise.
+# Residual overflows (count per bucket) are detected and raise.
 BUCKET_SLACK = 4
 BUCKET_MIN = 1 << 16
 
 
 @partial(jax.jit, static_argnames=("mesh",))
-def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
-                              c_n):
+def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full):
     D = int(mesh.devices.size)
     n_pad = graph.n_pad
 
-    def per_device(src_l, dst_l, ew_l, n, labels, cmap_full, c_n):
+    def per_device(src_l, dst_l, ew_l, n, labels, cmap_full):
         cap = src_l.shape[0]  # m_loc
-        # coarse-id ownership chunks over the COARSE id range [0, c_n):
-        # chunking by n_pad would send every row to device 0 (coarse ids
-        # are a small prefix of the padded fine range)
-        chunk = jnp.maximum((c_n + D - 1) // D, 1)
         # 1. coarse endpoints of the local edge shard
         lab_src = labels[jnp.clip(src_l, 0, n_pad - 1)]
         lab_dst = labels[jnp.clip(dst_l, 0, n_pad - 1)]
@@ -86,13 +88,23 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
         seg_g, key_g, w_g = aggregate_by_key(seg, jnp.where(keep, cv, big), ew_l)
         rows_valid = (seg_g >= 0) & (seg_g < big)
 
-        # 3. migrate: bucket rows by cu's owner device; rows are sorted by
-        # cu, so the target is monotone and the in-bucket position is a
-        # running index.  Bucket capacity is O(m_loc/D) (+slack), not
-        # m_loc — total send+recv memory stays O(m_loc), the point of a
-        # sharded contraction; skew overflows are detected, not truncated
+        # 3. migrate: bucket rows by hash(cu, cv) mod D — uniform across
+        # devices regardless of coarse-degree skew (see module doc); the
+        # same pair hashes identically everywhere, so duplicates still
+        # meet.  Rows are re-sorted by target so the in-bucket position
+        # is index minus the target's first index.  Bucket capacity is
+        # O(m_loc/D) (+slack), not m_loc — total send+recv memory stays
+        # O(m_loc), the point of a sharded contraction; residual
+        # overflows are detected, not truncated
         bcap = max(cap * BUCKET_SLACK // D, BUCKET_MIN)
-        tgt = jnp.where(rows_valid, seg_g // chunk, D).astype(jnp.int32)
+        pair_h = hash_u32(
+            seg_g ^ (key_g * jnp.int32(-1640531527)), 0x5C0A
+        )
+        tgt = jnp.where(rows_valid, pair_h % D, D).astype(jnp.int32)
+        tgt, seg_g, key_g, w_g = lax.sort(
+            (tgt, seg_g, key_g, w_g), num_keys=1
+        )
+        rows_valid = tgt < D
         idx = jnp.arange(cap, dtype=jnp.int32)
         start = jax.ops.segment_min(
             jnp.where(rows_valid, idx, cap), tgt, num_segments=D + 1
@@ -146,13 +158,13 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full,
         mesh=mesh,
         in_specs=(
             P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
-            P(), P(), P(), P(),
+            P(), P(), P(),
         ),
         out_specs=(P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS)),
         check_vma=False,
     )(
         graph.src, graph.dst, graph.edge_w, graph.n,
-        labels, cmap_full, c_n,
+        labels, cmap_full,
     )
 
 
@@ -176,7 +188,7 @@ def dist_contract_clustering(
 
     cu_s, cv_s, w_s, counts = _dist_contract_edges_impl(
         graph.src.sharding.mesh, graph, jnp.asarray(lab, jnp.int32),
-        jnp.asarray(cmap_full), jnp.int32(c_n),
+        jnp.asarray(cmap_full),
     )
     D = int(graph.src.sharding.mesh.devices.size)
     cu_s = np.asarray(cu_s).reshape(D, -1)
@@ -190,14 +202,16 @@ def dist_contract_clustering(
             f"merged coarse rows exceed capacity ({out_cap}); raise "
             "dist_contraction.OUT_FACTOR / BUCKET_SLACK"
         )
-    # shards hold disjoint ascending cu chunks and are (cu, cv)-sorted, so
-    # concatenation in device order is globally sorted
+    # shards hold disjoint (cu, cv) pair sets but interleaved cu ranges
+    # (hash bucketing), so canonicalize with one coarse-sized lexsort
     parts_cu = [cu_s[d, : counts[d]] for d in range(D)]
     parts_cv = [cv_s[d, : counts[d]] for d in range(D)]
     parts_w = [w_s[d, : counts[d]] for d in range(D)]
     g_cu = np.concatenate(parts_cu) if parts_cu else np.zeros(0, np.int64)
     g_cv = np.concatenate(parts_cv)
     g_w = np.concatenate(parts_w).astype(np.int64)
+    order = np.lexsort((g_cv, g_cu))
+    g_cu, g_cv, g_w = g_cu[order], g_cv[order], g_w[order]
 
     c_node_w = np.zeros(c_n, dtype=np.int64)
     np.add.at(c_node_w, cmap, np.asarray(node_w[:dg_host_n], dtype=np.int64))

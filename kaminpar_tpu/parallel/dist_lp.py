@@ -496,6 +496,10 @@ def dist_singleton_postpasses(
         return out
     if materialize is not None:
         host_graph = materialize()
+    elif not hasattr(host_graph, "edge_sources"):
+        # still-compressed graph with no materializer and the threshold
+        # fired: decode once — the passes below walk plain CSR arrays
+        host_graph = host_graph.decode()
 
     def _bin_merge(ids: np.ndarray, group: np.ndarray) -> None:
         """Merge `ids` (each currently singleton) into weight-capped bins

@@ -13,11 +13,12 @@ compute_partition + partitioning/deep_multilevel.cc):
                devices, so a single device-resident contraction replaces
                per-PE rating maps), and only the coarse CSR is pulled back
                to re-shard onto the mesh for the next level.  Graphs above
-               the single-device budget fall back to the host rebuild —
-               a stopgap until a sharded contraction with a coarse-edge
-               alltoall exists; either way coarse levels are geometrically
-               smaller and the fine-level LP rounds (the dominant cost)
-               stay fully on-device.
+               the single-device budget run the SHARDED contraction
+               (parallel/dist_contraction.py: per-shard dedup + one
+               all_to_all coarse-edge migration) so the fine edge list
+               never materializes on one device; either way coarse levels
+               are geometrically smaller and the fine-level LP rounds
+               (the dominant cost) stay fully on-device.
 
   initial      the coarsest graph is partitioned by the shared-memory
   partitioning KaMinPar pipeline — exactly the reference's scheme of

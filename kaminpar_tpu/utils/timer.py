@@ -123,3 +123,81 @@ def scoped_timer(name: str, timer: Optional[Timer] = None, sync=None):
     t = timer if timer is not None else GLOBAL_TIMER
     with t.scope(name, sync=sync):
         yield
+
+
+def aggregate_across_processes(timer: Optional[Timer] = None):
+    """Per-device timer aggregation (kaminpar-dist/timer.cc analog).
+
+    The reference finalizes its dist timer by reducing each scope's
+    elapsed time across PEs (MPI min/avg/max) so a real-mesh run exposes
+    imbalance between hosts.  The JAX analog reduces each scope across
+    *processes* (multi-host SPMD: one process per host drives its local
+    devices; per-scope wall times differ between hosts exactly like the
+    reference's per-PE times).
+
+    Returns {dotted_path: {"min": s, "avg": s, "max": s, "count": n}}.
+    On a single-process run (this dev box, the CPU test mesh) every
+    min == avg == max — the shape callers rely on is identical, so code
+    written against it works unchanged on a real multi-host mesh.
+    """
+    t = timer if timer is not None else GLOBAL_TIMER
+
+    paths: list = []
+    values: list = []
+    counts: list = []
+
+    def rec(node: TimerNode, path: str) -> None:
+        for child in node.children.values():
+            child_path = f"{path}.{child.name}" if path else child.name
+            paths.append(child_path)
+            values.append(child.elapsed)
+            counts.append(child.count)
+            rec(child, child_path)
+
+    rec(t.root, "")
+
+    import numpy as np
+
+    local = np.asarray(values, dtype=np.float64)
+    try:
+        import jax
+
+        nproc = jax.process_count()
+    except Exception:
+        nproc = 1
+    if nproc > 1 and len(local):
+        # all hosts must call this with the SAME scope tree (same code
+        # path), mirroring the reference's collective finalize()
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local)
+        ).reshape(nproc, -1)
+        mins, avgs, maxs = (
+            gathered.min(0), gathered.mean(0), gathered.max(0)
+        )
+    else:
+        mins = avgs = maxs = local
+    return {
+        p: {
+            "min": float(mins[i]),
+            "avg": float(avgs[i]),
+            "max": float(maxs[i]),
+            "count": int(counts[i]),
+        }
+        for i, p in enumerate(paths)
+    }
+
+
+def render_aggregated(agg: dict) -> str:
+    """Human-readable min/avg/max table (timer.cc's finalized output)."""
+    lines = []
+    for path, s in agg.items():
+        depth = path.count(".")
+        name = path.rsplit(".", 1)[-1]
+        lines.append(
+            f"{'  ' * (depth + 1)}{name}: min={s['min']:.4f} "
+            f"avg={s['avg']:.4f} max={s['max']:.4f} s"
+            + (f" ({s['count']}x)" if s["count"] > 1 else "")
+        )
+    return "\n".join(lines)

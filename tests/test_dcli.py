@@ -18,6 +18,9 @@ def test_dcli_partitions_file_graph(tmp_path, capfd):
     assert "RESULT cut=" in captured.err
     assert "devices=2" in captured.err
     assert "TIME io=" in captured.out
+    # -T prints the finalized dist timer: min/avg/max per scope
+    # (kaminpar-dist/timer.cc analog; one process -> min == max)
+    assert "min=" in captured.out and "max=" in captured.out
     part = np.loadtxt(out, dtype=np.int64)
     assert part.shape == (1024,)
     assert set(np.unique(part)) <= set(range(4))
@@ -52,3 +55,26 @@ def test_dcli_compressed_input(tmp_path, capfd):
     write_compressed(path, compress_host_graph(load_graph(RGG)))
     rc = main([path, "-k", "2", "-n", "2", "-f", "compressed", "-q"])
     assert rc == 0
+
+
+def test_timer_aggregation_single_process():
+    """aggregate_across_processes must expose every scope with
+    min == avg == max on a single process (the multi-host reduction
+    degenerates to the local tree)."""
+    from kaminpar_tpu.utils.timer import (
+        Timer,
+        aggregate_across_processes,
+        render_aggregated,
+    )
+
+    t = Timer()
+    with t.scope("outer"):
+        with t.scope("inner"):
+            pass
+    agg = aggregate_across_processes(t)
+    assert set(agg) == {"outer", "outer.inner"}
+    s = agg["outer"]
+    assert s["min"] == s["avg"] == s["max"] >= 0.0
+    assert s["count"] == 1
+    out = render_aggregated(agg)
+    assert "inner" in out and "min=" in out

@@ -812,3 +812,91 @@ def test_dkaminpar_copy_graph_clears_compressed_state():
     p3 = fresh.set_graph(b).compute_partition(k=4, epsilon=0.03, seed=1)
     np.testing.assert_array_equal(p2, p3)
     assert p1.shape == (a.n,)
+
+
+def test_sharded_contraction_star_skew(monkeypatch):
+    """Skew-proofing (global_cluster_contraction.cc:1100+ handles
+    arbitrary coarse-node distributions): contracting a clustering whose
+    coarse graph is a STAR — every coarse edge is incident to one hub —
+    must not overflow the migrate buckets.  Hash-bucketed pairs spread
+    the hub's rows across all devices (cv varies); the old cu-ownership
+    chunking sent every row to the hub's owner and raised.  Buckets are
+    pinched tight so concentration would overflow."""
+    from kaminpar_tpu.graphs.factories import make_star
+    from kaminpar_tpu.graphs.host import contract_clustering_host
+    from kaminpar_tpu.parallel import dist_contraction as dc_mod
+    from kaminpar_tpu.parallel.dist_contraction import (
+        dist_contract_clustering,
+    )
+
+    n = 1 << 13
+    g = make_star(n - 1)  # hub 0 + (n-1) leaves
+    mesh = make_mesh(8)
+    dg = dist_graph_from_host(g, mesh)
+    # singleton clustering: the coarse graph IS the star
+    labels = np.arange(dg.n_pad, dtype=np.int64)
+    # tight buckets: per-peer capacity ~m_loc/2 per device pair; the
+    # hub-owner flood of the old scheme (~m_loc rows/peer) would raise
+    monkeypatch.setattr(dc_mod, "BUCKET_MIN", 1 << 10)
+    dc_mod._dist_contract_edges_impl.clear_cache()
+    try:
+        coarse_d, cmap_d = dist_contract_clustering(
+            dg, g.n, g.node_weight_array(), labels
+        )
+    finally:
+        dc_mod._dist_contract_edges_impl.clear_cache()
+    coarse_h, cmap_h = contract_clustering_host(
+        g, labels[: g.n]
+    )
+    np.testing.assert_array_equal(cmap_d, cmap_h)
+    np.testing.assert_array_equal(coarse_d.xadj, coarse_h.xadj)
+    np.testing.assert_array_equal(coarse_d.adjncy, coarse_h.adjncy)
+
+
+def test_sharded_contraction_powerlaw_skew(monkeypatch):
+    """Power-law clustering sharded over 8 devices: cluster sizes follow
+    a heavy-tailed distribution (a few giant clusters absorb most
+    nodes), so a handful of coarse nodes carry most coarse edges.  Must
+    contract without the overflow escape hatch and match the host
+    contraction exactly."""
+    from kaminpar_tpu.graphs.host import contract_clustering_host
+    from kaminpar_tpu.parallel import dist_contraction as dc_mod
+    from kaminpar_tpu.parallel.dist_contraction import (
+        dist_contract_clustering,
+    )
+
+    g = make_rmat(1 << 12, 60_000, seed=5)
+    mesh = make_mesh(8)
+    dg = dist_graph_from_host(g, mesh)
+    rng = np.random.default_rng(11)
+    # zipf-ish cluster assignment: cluster c gets ~1/(c+1)^1.2 of nodes
+    ncl = 64
+    p = 1.0 / np.arange(1, ncl + 1) ** 1.2
+    cl = rng.choice(ncl, size=g.n, p=p / p.sum())
+    # labels must be leader node ids (min node of each cluster)
+    leaders = np.full(ncl, -1, dtype=np.int64)
+    for c in range(ncl):
+        members = np.flatnonzero(cl == c)
+        if len(members):
+            leaders[c] = members[0]
+    labels = np.arange(dg.n_pad, dtype=np.int64)
+    labels[: g.n] = leaders[cl]
+    monkeypatch.setattr(dc_mod, "BUCKET_MIN", 1 << 10)
+    dc_mod._dist_contract_edges_impl.clear_cache()
+    try:
+        coarse_d, cmap_d = dist_contract_clustering(
+            dg, g.n, g.node_weight_array(), labels
+        )
+    finally:
+        dc_mod._dist_contract_edges_impl.clear_cache()
+    coarse_h, cmap_h = contract_clustering_host(g, labels[: g.n])
+    np.testing.assert_array_equal(cmap_d, cmap_h)
+    np.testing.assert_array_equal(coarse_d.xadj, coarse_h.xadj)
+    for u in range(coarse_h.n):
+        lo_h, hi_h = coarse_h.xadj[u], coarse_h.xadj[u + 1]
+        lo_d, hi_d = coarse_d.xadj[u], coarse_d.xadj[u + 1]
+        h = sorted(zip(coarse_h.adjncy[lo_h:hi_h],
+                       coarse_h.edge_weight_array()[lo_h:hi_h]))
+        d = sorted(zip(coarse_d.adjncy[lo_d:hi_d],
+                       coarse_d.edge_weight_array()[lo_d:hi_d]))
+        assert h == d, f"row {u} differs"

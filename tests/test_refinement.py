@@ -251,3 +251,66 @@ def test_prune_candidates_to_budget_semantics():
     # budget monotonicity: a bigger budget keeps a superset
     keep_big = np.asarray(prune_candidates_to_budget(cand, gain, degrees, 3, 12))
     assert (keep <= keep_big).all()
+
+
+def _afterburner_pair(weight_scale: int, k: int, seed: int):
+    """Run the packed (guard-dispatched) afterburner and the exact
+    reference filter on the same inputs; return (packed, exact, cand)."""
+    from kaminpar_tpu.ops.segments import (
+        INT32_MIN,
+        afterburner_filter,
+        packed_afterburner_gain,
+    )
+
+    g = device_graph_from_host(factories.make_rmat(512, 4_000, seed=9))
+    n_pad = g.n_pad
+    rng = np.random.default_rng(seed)
+    ew = np.asarray(g.edge_w).copy()
+    real = ew > 0
+    ew[real] = rng.integers(1, weight_scale + 1, real.sum())
+    edge_w = jnp.asarray(ew)
+    part = jnp.asarray(rng.integers(0, k, n_pad).astype(np.int32))
+    cand = jnp.asarray(
+        (rng.random(n_pad) < 0.4) & (np.arange(n_pad) < int(g.n))
+    )
+    tgt = jnp.asarray(rng.integers(0, k, n_pad).astype(np.int32))
+    next_part = jnp.where(cand, tgt, part)
+    # gains scale with the edge weights, so heavy graphs push them past
+    # the packed clip range (gain_bits = 31 - 2*ceil(log2 k))
+    gain = jnp.asarray(
+        rng.integers(-3 * weight_scale, 3 * weight_scale + 1, n_pad)
+        .astype(np.int32)
+    )
+    packed = packed_afterburner_gain(
+        g.src, g.dst, edge_w, g.row_ptr, part, next_part, gain, cand, k
+    )
+    exact = afterburner_filter(
+        g.src,
+        g.dst,
+        edge_w,
+        part[g.src],
+        part[g.dst],
+        jnp.where(cand, gain, INT32_MIN),
+        next_part,
+        g.src,
+        n_pad,
+    )
+    return np.asarray(packed), np.asarray(exact), np.asarray(cand)
+
+
+def test_afterburner_clip_guard_heavy_weights():
+    """Heavy edge weights push candidate gains past the packed layout's
+    clip range (gain_bits=15 at k=256); the runtime guard must dispatch
+    the exact path, making the packed entry point agree with the exact
+    filter bit-for-bit."""
+    packed, exact, cand = _afterburner_pair(
+        weight_scale=50_000, k=256, seed=3
+    )
+    np.testing.assert_array_equal(packed[cand], exact[cand])
+
+
+def test_afterburner_packed_path_matches_exact_in_range():
+    """Below the clip range the packed path itself must equal the exact
+    filter (the guard keeps the cheap branch)."""
+    packed, exact, cand = _afterburner_pair(weight_scale=50, k=256, seed=4)
+    np.testing.assert_array_equal(packed[cand], exact[cand])
