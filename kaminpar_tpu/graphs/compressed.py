@@ -226,3 +226,113 @@ def compress_host_graph(
         edge_weights=ew,
         codec="gap",
     )
+
+
+def compressed_partition_metrics(
+    cgraph: CompressedHostGraph,
+    partition,
+    k: int,
+    chunk_nodes: int = 1 << 18,
+) -> dict:
+    """host_partition_metrics without decoding the full CSR: the cut is
+    accumulated over decoded node-range chunks (decode_range), so peak
+    host memory stays at compressed + one chunk + O(n).  Definitions
+    match graphs.host.host_partition_metrics exactly (same RESULT line
+    semantics)."""
+    partition = np.asarray(partition)
+    n = cgraph.n
+    cut = 0
+    for v0 in range(0, n, chunk_nodes):
+        v1 = min(n, v0 + chunk_nodes)
+        xr, adj, ew = cgraph.decode_range(v0, v1)
+        deg = np.diff(np.asarray(xr, dtype=np.int64))
+        src = np.repeat(np.arange(v0, v1, dtype=np.int64), deg)
+        mask = partition[src] != partition[adj]
+        cut += int(
+            mask.sum() if ew is None else np.asarray(ew)[mask].sum()
+        )
+    nw = cgraph.node_weight_array()
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, partition, nw)
+    perfect = max(1, -(-int(nw.sum()) // max(k, 1)))
+    return {
+        "cut": cut // 2,
+        "block_weights": bw,
+        "imbalance": bw.max() / perfect - 1.0 if k else 0.0,
+    }
+
+
+def compress_from_stream(sg, codec: str = "auto") -> CompressedHostGraph:
+    """Compress a streamed graph (io/skagen.StreamedGraph) chunk by chunk
+    — the full flat CSR never exists on the host (the reference's
+    builder likewise ingests neighborhoods incrementally,
+    compressed_graph_builder.h).  Peak memory: compressed streams + one
+    decoded chunk + O(n).
+
+    The per-node byte offsets of both codecs are absolute, so per-chunk
+    encodings concatenate by rebasing each chunk's offsets by the bytes
+    already written (decode_range depends on exactly this independence).
+    """
+    if codec == "auto":
+        codec = "v2" if native.available() else "gap"
+    n = sg.n
+    xadj = np.zeros(n + 1, dtype=np.int64)
+    data_parts, off_parts = [], []
+    wdata_parts, woff_parts = [], []
+    byte_base = 0
+    wbyte_base = 0
+    any_weights = False
+    for ch in sg.chunks():
+        xr = np.asarray(ch.xadj, dtype=np.int64)
+        adj = np.asarray(ch.adjncy, dtype=np.int32)
+        xadj[ch.v_begin + 1 : ch.v_end + 1] = xr[1:] - xr[:-1]
+        if codec == "v2":
+            enc = native.encode_v2(xr, adj)
+            if enc is None:
+                raise RuntimeError("v2 codec requires the native library")
+            data_c, off_c = enc
+        else:
+            data_c, off_c = native.encode_gaps(xr, adj)
+        data_parts.append(data_c)
+        off_parts.append(np.asarray(off_c, dtype=np.int64)[:-1] + byte_base)
+        byte_base += int(np.asarray(off_c)[-1])
+        w = np.asarray(ch.adjwgt)
+        if len(w) and not (w == 1).all():
+            any_weights = True
+        if codec == "v2":
+            wd, wo = native.encode_v2_weights(xr, adj, w)
+            wdata_parts.append(wd)
+            woff_parts.append(
+                np.asarray(wo, dtype=np.int64)[:-1] + wbyte_base
+            )
+            wbyte_base += int(np.asarray(wo)[-1])
+        else:
+            wdata_parts.append(w)
+    np.cumsum(xadj, out=xadj)
+    data = (
+        np.concatenate(data_parts) if data_parts
+        else np.zeros(0, dtype=np.uint8)
+    )
+    offsets = np.concatenate(
+        (off_parts if off_parts else [np.zeros(0, np.int64)])
+        + [np.asarray([byte_base], dtype=np.int64)]
+    )
+    if codec == "v2":
+        if any_weights:
+            wdata = np.concatenate(wdata_parts)
+            woffsets = np.concatenate(
+                woff_parts + [np.asarray([wbyte_base], dtype=np.int64)]
+            )
+        else:
+            wdata = woffsets = None
+        return CompressedHostGraph(
+            xadj=xadj, offsets=offsets, data=data, codec="v2",
+            wdata=wdata, woffsets=woffsets,
+        )
+    ew = np.concatenate(wdata_parts) if wdata_parts else None
+    if ew is not None and (len(ew) == 0 or (ew == 1).all()):
+        ew = None
+    return CompressedHostGraph(
+        xadj=xadj, offsets=offsets, data=data, codec="gap",
+        edge_weights=ew,
+    )

@@ -54,8 +54,8 @@ def _build() -> Optional[str]:
         ) as tmp:
             tmp_path = tmp.name
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SRCS,
-             "-o", tmp_path],
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++20", "-pthread",
+             *_SRCS, "-o", tmp_path],
             check=True,
             capture_output=True,
         )
@@ -113,7 +113,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.kmp_fm_refine.argtypes = [
         i64, p_i64, p_i32, p_i64, p_i64, i64, p_i64,
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS,WRITEABLE"),
-        i64, i64, f64, i64, i32, ctypes.c_uint64,
+        i64, i64, f64, i64, i32, ctypes.c_uint64, i64,
     ]
     # v2 codec (interval + streamvbyte-class residuals + varint weights)
     lib.kmp_encode_v2_size.restype = i64
@@ -314,13 +314,15 @@ def ml_bipartition(graph, max_block_weights, ip_ctx, seed: int):
 # ---------------------------------------------------------------------------
 
 
-def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int):
+def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
+              threads: int = 1):
     """Run the native localized batch FM on a HostGraph partition.
 
     Native counterpart of the reference's parallel localized FM scheme
     (see fm.cpp header); refines `partition` IN PLACE and returns the
     total cut improvement, or None when the native library is
-    unavailable."""
+    unavailable.  `threads` > 1 runs the reference-style worker pool
+    (NodeTracker claims + atomic gain table); 1 is bitwise-deterministic."""
     lib = get_lib()
     if lib is None or graph.n == 0 or k <= 1:
         return None
@@ -338,6 +340,7 @@ def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int):
             float(fm_ctx.alpha), int(fm_ctx.num_fruitless_moves),
             1,  # adaptive stopping (the reference's default for FM)
             int(seed) & 0xFFFFFFFFFFFFFFFF,
+            max(1, int(threads)),
         )
     )
 

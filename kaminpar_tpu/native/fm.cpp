@@ -7,17 +7,32 @@
 // speculatively against a DELTA overlay of the partition and gain table,
 // and only the best prefix of the batch's moves is committed to the
 // global state; non-moved region nodes are released for later batches.
-// This is exactly the reference's scheme minus the thread pool — batches
-// run one after another on the host (the TPU has no per-node PQ path;
-// see kaminpar_tpu/refinement/fm.py) — with the same state machinery:
-// dense (n, k) gain table (gains/sparse_gain_cache.h lineage), sparse
+//
+// Threading mirrors the reference's scheme: a pool of workers pulls seed
+// batches from the shared border queue; per-node ownership claims (the
+// NodeTracker analog, fm_refiner.cc NodeTracker) keep regions disjoint,
+// global partition/gain-table/block-weight accesses go through relaxed
+// std::atomic_ref (the reference's atomic gain cache), and commits
+// re-check the block-weight caps with fetch_add + rollback so the cap
+// is NEVER exceeded — stricter than the reference's transient
+// overshoot.  num_threads <= 1 runs the identical code on one thread
+// and visits exactly the old sequential state sequence (rerun
+// determinism for tests and 1-CPU hosts).  Stale gains from concurrent
+// commits are tolerated exactly like the reference tolerates them: the
+// delta overlay re-checks gains before applying, and the global table
+// stays exact because every update is an exact integer fetch_add.
+//
+// Dense (n, k) gain table (gains/sparse_gain_cache.h lineage), sparse
 // delta map, adaptive (Osipov-Sanders) or simple stopping.
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <queue>
+#include <thread>
 #include <tuple>
 #include <unordered_map>
 #include <vector>
@@ -38,6 +53,8 @@ struct Rng {
   uint32_t tie() { return (uint32_t)(next() >> 32); }
 };
 
+constexpr auto kRelaxed = std::memory_order_relaxed;
+
 struct Ctx {
   int64_t n, k;
   const int64_t* xadj;
@@ -49,11 +66,24 @@ struct Ctx {
   std::vector<int64_t> conn;  // dense (n, k) connection table
   std::vector<int64_t> bw;    // global block weights
 
-  int64_t conn_at(int64_t u, int64_t b) const { return conn[u * k + b]; }
+  // relaxed-atomic views of the shared state (plain loads/stores when
+  // single-threaded; the values are identical either way)
+  int64_t conn_at(int64_t u, int64_t b) const {
+    return std::atomic_ref(const_cast<int64_t&>(conn[u * k + b]))
+        .load(kRelaxed);
+  }
+  int32_t part_at(int64_t u) const {
+    return std::atomic_ref(const_cast<int32_t&>(part[u])).load(kRelaxed);
+  }
+  int64_t bw_at(int64_t b) const {
+    return std::atomic_ref(const_cast<int64_t&>(bw[b])).load(kRelaxed);
+  }
 };
 
-// node states within a pass
-enum : uint8_t { FREE = 0, IN_REGION = 1, MOVED = 2 };
+// per-node ownership within a pass (NodeTracker analog):
+// kFree = claimable, kMoved = committed this pass, else owning batch id
+constexpr int32_t kFree = -1;
+constexpr int32_t kMoved = -2;
 
 void build_conn(Ctx& c) {
   std::fill(c.conn.begin(), c.conn.end(), 0);
@@ -90,23 +120,29 @@ struct Delta {
   int64_t* row(int64_t u) {
     auto [it, fresh] = slot.try_emplace(u, (int32_t)blocks.size());
     if (fresh) {
-      rows.insert(rows.end(), c->conn.begin() + u * c->k,
-                  c->conn.begin() + (u + 1) * c->k);
-      blocks.push_back(c->part[u]);
+      const size_t base = rows.size();
+      rows.resize(base + c->k);
+      for (int64_t b = 0; b < c->k; ++b)
+        rows[base + b] = c->conn_at(u, b);
+      blocks.push_back(c->part_at(u));
     }
     return rows.data() + (int64_t)it->second * c->k;
   }
   int32_t block(int64_t u) const {
     auto it = slot.find(u);
-    return it == slot.end() ? c->part[u] : blocks[it->second];
+    return it == slot.end() ? c->part_at(u) : blocks[it->second];
   }
-  // read-only row view (global when untouched)
-  const int64_t* row_view(int64_t u) const {
+  // row view: the arena row when touched, else a temp copy of the
+  // global row (atomic loads — the global row may be concurrently
+  // updated by other batches' commits)
+  const int64_t* row_view(int64_t u, int64_t* scratch) const {
     auto it = slot.find(u);
-    return it == slot.end() ? c->conn.data() + u * c->k
-                            : rows.data() + (int64_t)it->second * c->k;
+    if (it != slot.end())
+      return rows.data() + (int64_t)it->second * c->k;
+    for (int64_t b = 0; b < c->k; ++b) scratch[b] = c->conn_at(u, b);
+    return scratch;
   }
-  int64_t weight(int64_t b) const { return c->bw[b] + bw_delta[b]; }
+  int64_t weight(int64_t b) const { return c->bw_at(b) + bw_delta[b]; }
   // tentatively move u from -> to, updating neighbor rows
   void move(int64_t u, int32_t from, int32_t to) {
     row(u);  // materialize so the block override has a slot
@@ -124,10 +160,11 @@ struct Delta {
 
 // best feasible move of u under the delta view: (gain, target) or
 // (INT64_MIN, -1)
-std::pair<int64_t, int32_t> best_move(const Delta& d, int64_t u, Rng& rng) {
+std::pair<int64_t, int32_t> best_move(const Delta& d, int64_t u, Rng& rng,
+                                      int64_t* scratch) {
   const Ctx& c = *d.c;
   const int32_t b = d.block(u);
-  const int64_t* r = d.row_view(u);
+  const int64_t* r = d.row_view(u, scratch);
   const int64_t own = r[b];
   int64_t best_gain = INT64_MIN;
   int32_t best_t = -1;
@@ -151,16 +188,29 @@ std::pair<int64_t, int32_t> best_move(const Delta& d, int64_t u, Rng& rng) {
   return {best_gain, best_t};
 }
 
-// commit a move to the GLOBAL state
-void commit_move(Ctx& c, int64_t u, int32_t from, int32_t to) {
-  c.part[u] = to;
-  c.bw[from] -= c.node_w[u];
-  c.bw[to] += c.node_w[u];
+// commit a move to the GLOBAL state with a cap re-check: concurrent
+// batches may have filled the target block since the delta check, so
+// reserve the weight first and roll back on overshoot.  Returns false
+// (and leaves the state untouched) when the target no longer fits —
+// the block-weight cap is never exceeded, even transiently beyond this
+// one reservation.
+bool commit_move(Ctx& c, int64_t u, int32_t from, int32_t to) {
+  const int64_t w = c.node_w[u];
+  std::atomic_ref bw_to(c.bw[to]);
+  if (bw_to.fetch_add(w, kRelaxed) + w > c.max_bw[to]) {
+    bw_to.fetch_sub(w, kRelaxed);
+    return false;
+  }
+  std::atomic_ref(c.bw[from]).fetch_sub(w, kRelaxed);
+  std::atomic_ref(c.part[u]).store(to, kRelaxed);
   for (int64_t e = c.xadj[u]; e < c.xadj[u + 1]; ++e) {
     const int32_t v = c.adjncy[e];
-    c.conn[(int64_t)v * c.k + from] -= c.edge_w[e];
-    c.conn[(int64_t)v * c.k + to] += c.edge_w[e];
+    std::atomic_ref(c.conn[(int64_t)v * c.k + from])
+        .fetch_sub(c.edge_w[e], kRelaxed);
+    std::atomic_ref(c.conn[(int64_t)v * c.k + to])
+        .fetch_add(c.edge_w[e], kRelaxed);
   }
+  return true;
 }
 
 struct Move {
@@ -170,25 +220,32 @@ struct Move {
 };
 
 // one localized batch (LocalizedFMRefiner::run_batch); returns committed
-// gain
-int64_t run_batch(Ctx& c, Delta& d, std::vector<uint8_t>& state,
-                  const std::vector<int64_t>& seeds, double alpha,
-                  int64_t num_fruitless, int use_adaptive, Rng& rng) {
+// gain.  `owner` claims keep concurrent regions disjoint.
+int64_t run_batch(Ctx& c, Delta& d, std::atomic<int32_t>* owner,
+                  int32_t my_id, const std::vector<int64_t>& seeds,
+                  double alpha, int64_t num_fruitless, int use_adaptive,
+                  Rng& rng, std::vector<int64_t>& scratch) {
   d.clear();
   using Entry = std::tuple<int64_t, uint32_t, int64_t, int32_t>;
   std::priority_queue<Entry> pq;
   std::vector<int64_t> touched;
 
+  auto claim = [&](int64_t u) {
+    int32_t expect = kFree;
+    return owner[u].compare_exchange_strong(expect, my_id, kRelaxed);
+  };
   auto push = [&](int64_t u) {
-    auto [g, t] = best_move(d, u, rng);
+    auto [g, t] = best_move(d, u, rng, scratch.data());
     if (t >= 0) pq.push({g, rng.tie(), u, t});
   };
   for (int64_t s : seeds) {
-    if (state[s] == FREE) {
-      state[s] = IN_REGION;
-      touched.push_back(s);
-      push(s);
-    }
+    // seeds arrive pre-claimed by the seed poller
+    touched.push_back(s);
+    push(s);
+  }
+  if (pq.empty()) {
+    for (int64_t u : touched) owner[u].store(kFree, kRelaxed);
+    return 0;
   }
 
   std::vector<Move> moves;
@@ -202,11 +259,11 @@ int64_t run_batch(Ctx& c, Delta& d, std::vector<uint8_t>& state,
   while (!pq.empty() && moves.size() < max_moves) {
     auto [g, tie, u, t] = pq.top();
     pq.pop();
-    if (state[u] == MOVED) continue;
+    if (owner[u].load(kRelaxed) != my_id) continue;  // lost to a commit
     // stale check: gains shift as the region moves.  Re-queue only on a
     // GAIN change — the target may legitimately differ on ties (random
     // tie-break per query), and re-queuing on target alone could cycle
-    auto [g2, t2] = best_move(d, u, rng);
+    auto [g2, t2] = best_move(d, u, rng, scratch.data());
     if (t2 < 0) continue;
     if (g2 != g) {
       pq.push({g2, rng.tie(), u, t2});
@@ -221,14 +278,16 @@ int64_t run_batch(Ctx& c, Delta& d, std::vector<uint8_t>& state,
       best = cur;
       best_len = moves.size();
     }
-    // expand: adjacent FREE nodes join the region
+    // expand: adjacent unclaimed nodes join the region
     for (int64_t e = c.xadj[u]; e < c.xadj[u + 1]; ++e) {
       const int32_t v = c.adjncy[e];
-      if (state[v] == FREE) {
-        state[v] = IN_REGION;
-        touched.push_back(v);
-        push(v);
-      } else if (state[v] == IN_REGION) {
+      const int32_t o = owner[v].load(kRelaxed);
+      if (o == kFree) {
+        if (claim(v)) {
+          touched.push_back(v);
+          push(v);
+        }
+      } else if (o == my_id) {
         push(v);
       }
     }
@@ -250,14 +309,19 @@ int64_t run_batch(Ctx& c, Delta& d, std::vector<uint8_t>& state,
     }
   }
 
-  // commit the best prefix globally; release the rest
-  for (size_t i = 0; i < best_len; ++i) {
-    commit_move(c, moves[i].u, moves[i].from, moves[i].to);
-    state[moves[i].u] = MOVED;
+  // commit the best prefix globally; release the rest.  A cap re-check
+  // failure aborts the remainder of the prefix (the delta gains beyond
+  // a skipped move are no longer meaningful).
+  int64_t committed_gain = 0;
+  size_t i = 0;
+  for (; i < best_len; ++i) {
+    if (!commit_move(c, moves[i].u, moves[i].from, moves[i].to)) break;
+    owner[moves[i].u].store(kMoved, kRelaxed);
+    committed_gain += moves[i].gain;
   }
   for (int64_t u : touched)
-    if (state[u] == IN_REGION) state[u] = FREE;
-  return best;
+    if (owner[u].load(kRelaxed) == my_id) owner[u].store(kFree, kRelaxed);
+  return committed_gain;
 }
 
 }  // namespace
@@ -267,7 +331,7 @@ extern "C" int64_t kmp_fm_refine(
     const int64_t* node_w, const int64_t* edge_w, int64_t k,
     const int64_t* max_bw, int32_t* part, int64_t num_iterations,
     int64_t num_seed_nodes, double alpha, int64_t num_fruitless_moves,
-    int32_t use_adaptive, uint64_t seed) {
+    int32_t use_adaptive, uint64_t seed, int64_t num_threads) {
   if (n <= 0 || k <= 1) return 0;
   // dense (n, k) table: refuse absurd sizes (large-k uses other refiners)
   if (n * k > (int64_t)3e8) return 0;
@@ -277,11 +341,13 @@ extern "C" int64_t kmp_fm_refine(
   Rng rng(seed);
   build_conn(c);
 
+  const int64_t T = std::max<int64_t>(1, num_threads);
+  std::unique_ptr<std::atomic<int32_t>[]> owner(
+      new std::atomic<int32_t>[n]);
+
   int64_t total = 0;
   int64_t first_pass_gain = 0;
-  std::vector<uint8_t> state(n);
   std::vector<int64_t> border;
-  std::vector<int64_t> seeds;
   for (int64_t pass = 0; pass < std::max<int64_t>(1, num_iterations);
        ++pass) {
     // border nodes: nonzero external connection
@@ -296,28 +362,59 @@ extern "C" int64_t kmp_fm_refine(
     for (int64_t i = (int64_t)border.size() - 1; i > 0; --i)
       std::swap(border[i], border[(int64_t)(rng.next() % (uint64_t)(i + 1))]);
 
-    std::fill(state.begin(), state.end(), FREE);
-    Delta d(c);
-    int64_t pass_gain = 0;
-    size_t head = 0;
+    for (int64_t u = 0; u < n; ++u) owner[u].store(kFree, kRelaxed);
     const int64_t nseeds = std::max<int64_t>(1, num_seed_nodes);
-    while (head < border.size()) {
-      seeds.clear();
-      while (head < border.size() && (int64_t)seeds.size() < nseeds) {
-        const int64_t u = border[head++];
-        if (state[u] == FREE) seeds.push_back(u);
+    std::atomic<size_t> head{0};
+    std::atomic<int64_t> pass_gain{0};
+    std::atomic<int32_t> next_batch_id{0};
+
+    auto worker = [&](int64_t tid) {
+      Delta d(c);
+      Rng wrng(seed ^ (0x9E3779B9ULL * (uint64_t)(pass * T + tid + 1)));
+      // thread 0 on a single-thread run reuses the pass RNG so the
+      // sequential state sequence matches the pre-threading code
+      Rng& r = (T == 1) ? rng : wrng;
+      std::vector<int64_t> scratch(k);
+      std::vector<int64_t> seeds;
+      for (;;) {
+        // allocate the batch id FIRST so seed claims are uniquely
+        // tagged from the start (a provisional shared tag could make a
+        // foreign region adopt the seed)
+        const int32_t my_id = next_batch_id.fetch_add(1, kRelaxed) + 1;
+        seeds.clear();
+        while ((int64_t)seeds.size() < nseeds) {
+          const size_t i = head.fetch_add(1, kRelaxed);
+          if (i >= border.size()) break;
+          const int64_t u = border[i];
+          int32_t expect = kFree;
+          if (owner[u].compare_exchange_strong(expect, my_id, kRelaxed))
+            seeds.push_back(u);
+        }
+        if (seeds.empty()) break;
+        pass_gain.fetch_add(
+            run_batch(c, d, owner.get(), my_id, seeds, alpha,
+                      num_fruitless_moves, use_adaptive, r, scratch),
+            kRelaxed);
       }
-      if (seeds.empty()) break;
-      pass_gain += run_batch(c, d, state, seeds, alpha,
-                             num_fruitless_moves, use_adaptive, rng);
+    };
+
+    if (T == 1) {
+      worker(0);
+    } else {
+      std::vector<std::thread> pool;
+      pool.reserve(T);
+      for (int64_t t = 0; t < T; ++t) pool.emplace_back(worker, t);
+      for (auto& th : pool) th.join();
     }
-    total += pass_gain;
-    if (pass_gain <= 0) break;
+
+    const int64_t pg = pass_gain.load(kRelaxed);
+    total += pg;
+    if (pg <= 0) break;
     // improvement abortion (initial_fm_refiner improvement_abortion
     // lineage): later passes chase diminishing returns at full pass cost
     if (pass == 0)
-      first_pass_gain = pass_gain;
-    else if (pass_gain * 20 < first_pass_gain)
+      first_pass_gain = pg;
+    else if (pg * 20 < first_pass_gain)
       break;
   }
   return total;

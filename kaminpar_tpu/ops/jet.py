@@ -76,23 +76,21 @@ def _conn_cut(
     ) // 2
 
 
-def _scatter_conn_delta(
+def _scatter_conn_delta_cols(
     conn: jax.Array,
-    owner_c: jax.Array,
+    old_b: jax.Array,
+    new_b: jax.Array,
     dst_b: jax.Array,
     w_b: jax.Array,
-    part_before: jax.Array,
-    part_after: jax.Array,
     k: int,
     n_pad: int,
 ) -> jax.Array:
     """Apply a bulk-move delta to the dense (n, k) connection table from
-    prepared row buffers: for each edge (u, v) with u moved a->b,
+    prepared per-slot columns: for each edge (u, v) with u moved a->b,
     conn[v, a] -= w and conn[v, b] += w.  Exact integer arithmetic — the
     table stays bitwise equal to a full rebuild.  Callers zero w_b on
-    edges whose owner did not move."""
-    old_b = part_before[owner_c]
-    new_b = part_after[owner_c]
+    edges whose owner did not move; `old_b`/`new_b` are the owner's
+    before/after blocks PER SLOT (already gathered by the caller)."""
     flat_old = dst_b * k + jnp.clip(old_b, 0, k - 1)
     flat_new = dst_b * k + jnp.clip(new_b, 0, k - 1)
     flat_conn = conn.reshape(-1)
@@ -110,17 +108,22 @@ def _conn_update_rows(
     dslots: int,
 ) -> jax.Array:
     """Expand the changed nodes' CSR rows and apply the conn-table delta
-    (see _scatter_conn_delta)."""
+    (see _scatter_conn_delta_cols).  The owner's before/after blocks ride
+    ONE gather, bit-packed as before * k + after (both < k, so the
+    product stays far inside int32)."""
     n_pad = graph.n_pad
     changed = part_before != part_after
-    owner_c, owner_key, edge_id, valid, start, end = expand_active_rows(
+    owner_c, _, edge_id, valid, start, end = expand_active_rows(
         graph.row_ptr, graph.degrees, changed, dslots
     )
     eid = jnp.clip(edge_id, 0, graph.src.shape[0] - 1)
     dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
     w_b = jnp.where(valid, graph.edge_w[eid], 0).astype(ACC_DTYPE)
-    return _scatter_conn_delta(
-        conn, owner_c, dst_b, w_b, part_before, part_after, k, n_pad
+    pb_c = jnp.clip(part_before, 0, k - 1)
+    pa_c = jnp.clip(part_after, 0, k - 1)
+    pba = (pb_c * k + pa_c)[owner_c]
+    return _scatter_conn_delta_cols(
+        conn, pba // k, pba % k, dst_b, w_b, k, n_pad
     )
 
 
@@ -184,8 +187,9 @@ def _jet_iteration(
     )
 
     # ---- filter: afterburner (jet_refiner.cc:133-170) ----
-    # packed metadata + streaming row sums; see
-    # segments.packed_afterburner_gain (shared with LP refinement).
+    # bit-packed endpoint metadata + streaming row sums, with a runtime
+    # clip-range guard; see segments.packed_afterburner_gain_rows
+    # (shared with LP refinement).
     # Only edges of CANDIDATE rows contribute to the filter.  On large
     # graphs the candidate set is first PRUNED to the best-gain subset
     # whose rows fit the delta buffer (two-stage candidate pruning), so
@@ -197,7 +201,7 @@ def _jet_iteration(
             graph.src, graph.dst, graph.edge_w, graph.row_ptr,
             part, next_part, gain, candidate, k,
         )
-        owner_c = dst_b = w_b = None
+        owner_c = dst_b = w_b = from_u = to_u = None
     else:
         candidate = prune_candidates_to_budget(
             candidate, gain, graph.degrees, salt ^ 0x5BD1E995, dslots
@@ -209,7 +213,9 @@ def _jet_iteration(
         eid = jnp.clip(edge_id, 0, graph.src.shape[0] - 1)
         dst_b = jnp.where(valid, graph.dst[eid], n_pad - 1)
         w_b = jnp.where(valid, graph.edge_w[eid], 0)
-        adj_gain = packed_afterburner_gain_rows(
+        # bit-packed endpoint metadata: one gather per endpoint; the
+        # owner's (from, to) blocks come back for the conn-delta reuse
+        adj_gain, from_u, to_u = packed_afterburner_gain_rows(
             owner_c, dst_b, w_b, start, end,
             part, next_part, gain, candidate, k,
         )
@@ -243,13 +249,15 @@ def _jet_iteration(
     else:
         # accepted movers are a subset of the pruned candidate set, whose
         # rows the afterburner ALREADY expanded and gathered — the conn
-        # update reuses (owner_c, dst_b, w_b) directly instead of
-        # re-running expand_active_rows + two edge gathers (measured
-        # 1.14 s -> ~0.4 s per iteration at 33.5M slots).  Edges of
-        # rejected candidates contribute weight 0.
-        w_m = jnp.where(accept[owner_c], w_b, 0).astype(ACC_DTYPE)
-        jet_conn = _scatter_conn_delta(
-            conn, owner_c, dst_b, w_m, part, new_part, k, n_pad
+        # update reuses (owner_c, dst_b, w_b) and the (from, to) block
+        # columns the afterburner returned; the only new irregular op is
+        # the accept gather.  Edges of rejected candidates contribute
+        # weight 0.
+        acc_o = accept[owner_c]
+        w_m = jnp.where(acc_o, w_b, 0).astype(ACC_DTYPE)
+        new_b = jnp.where(acc_o, to_u, from_u)
+        jet_conn = _scatter_conn_delta_cols(
+            conn, from_u, new_b, dst_b, w_m, k, n_pad
         )
 
     # ---- rebalance (jet_refiner.cc:185-187) ----

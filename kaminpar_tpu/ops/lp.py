@@ -309,14 +309,16 @@ def lp_round(
         # without it bulk-sync LP refinement can DOUBLE the cut.
         # `wants` is deliberately NOT masked: filtered/unsampled nodes
         # must stay in the convergence count and the active set.
-        # Packed metadata keeps this at TWO edge-wide gathers (the naive
-        # per-endpoint gathers were ~10x a Jet iteration at equal shape).
+        # Row-packed (n, 3) tables keep this at TWO edge-wide gathers
+        # with EXACT gains (the naive six per-endpoint scalar gathers
+        # were ~10x a Jet iteration at equal shape; gathers are charged
+        # per index, so the 3-wide rows ride along free).
         candidate = target >= 0
         next_lab = jnp.where(candidate, target, labels)
         if rows is not None:
             # candidates are active, so every candidate's full row is in
             # the buffer — the filter shrinks to buffer width
-            adj_gain = packed_afterburner_gain_rows(
+            adj_gain, _, _ = packed_afterburner_gain_rows(
                 owner_c, dst_b, w_b, start, end,
                 labels, next_lab, gain, candidate, C,
             )
@@ -693,7 +695,6 @@ def _lp_refine_fused(
         graph.node_w.astype(ACC_DTYPE), part0, num_segments=k
     )
     active0 = jnp.ones(n_pad, dtype=bool)
-
     def cond(state):
         i, _, _, _, moved = state
         return (i < iters) & (moved != 0)

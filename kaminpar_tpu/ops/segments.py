@@ -591,7 +591,7 @@ def expand_active_rows(
     degrees: jax.Array,
     active: jax.Array,
     num_slots: int,
-) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+):
     """Compact the CSR rows of active nodes into a `num_slots` buffer.
 
     The delta-round primitive: after the first LP/Jet round only a small
@@ -599,20 +599,23 @@ def expand_active_rows(
     edge-wide op costs ~10-15 ns per SLOT regardless of how many slots
     matter.  This lays the active nodes' rows head-to-tail into a fixed
     small buffer so every downstream pass scales with the active-edge
-    count, not m.  O(n) streaming + one n-wide scatter + two buffer-wide
-    gathers; no edge-wide ops.
+    count, not m.
 
-    Returns (owner, owner_key, edge_id, valid, start, end):
-      owner    i32[num_slots]  owning node of each slot (undefined before
-                               the first active row — mask with `valid`)
+    Cost: O(n) streaming + one n-wide scatter + ONE buffer-wide gather —
+    the edge id falls out of a single gather of the PRE-SUBTRACTED
+    (row_ptr - start) array (edge_id = diff[owner] + slot), instead of
+    separate row_ptr[owner] and start[owner] gathers.  Do NOT be tempted
+    to widen this into (n, r) row tables: TPU pads the minor dimension
+    to 128 lanes, so materialized small-r tables cost 128/r x the memory
+    and bandwidth (measured OOM at the 33.5M-edge shape), and XLA
+    un-fuses stacked-table gathers back into scalar gathers anyway.
+
+    Returns (owner_c, owner_key, edge_id, valid, start, end):
+      owner_c  i32[num_slots]  owning node of each slot (clipped)
       owner_key i32[num_slots] owner for valid slots, n_pad for pad slots
-                               (sorts pad slots to the end, keeps spans)
       edge_id  i32[num_slots]  index into the edge arrays (clip before use)
       valid    bool[num_slots]
       start/end i32[n_pad]     each ACTIVE node's row span in the buffer
-    The caller must check `total = end[-1] <= num_slots` BEFORE using the
-    result (overflowing rows are truncated, so an overflowed buffer is
-    unusable — fall back to a full round).
     """
     n_pad = degrees.shape[0]
     act = active & (degrees > 0)
@@ -627,12 +630,11 @@ def expand_active_rows(
         .at[pos]
         .max(jnp.where(do, node_ids, -1), mode="drop")
     )
-    # start offsets are monotone in node id, so a running max forward-
-    # fills each row's owner into all of its slots
     owner = lax.cummax(owner0)
     slot = jnp.arange(num_slots, dtype=jnp.int32)
     owner_c = jnp.clip(owner, 0, n_pad - 1)
-    edge_id = row_ptr[owner_c] + (slot - start[owner_c])
+    diff = row_ptr[:-1].astype(jnp.int32) - start
+    edge_id = diff[owner_c] + slot
     valid = (owner >= 0) & (slot < end[n_pad - 1])
     owner_key = jnp.where(valid, owner_c, n_pad)
     return owner_c, owner_key, edge_id, valid, start, end
@@ -773,14 +775,18 @@ def packed_afterburner_gain(
     implementation gathers gain/part/next_part for both endpoints of every
     edge (six edge-wide gathers — irregular gathers are charged per index
     on TPU and dominate the round).  Here the three per-node values are
-    packed into ONE int32 per node, so each endpoint costs a single
-    gather; the per-node contribution sum is a streaming cumsum + CSR
+    BIT-PACKED into ONE int32 per node, so each endpoint costs a single
+    gather.  (n, r) row tables are NOT an alternative: TPU pads the minor
+    dimension to 128 lanes — a materialized (m, 2) table is a 64x
+    memory/bandwidth blowup (measured OOM at 33.5M edges) and XLA
+    un-fuses in-loop stacked-table gathers back into scalar gathers.
+    The per-node contribution sum is a streaming cumsum + CSR
     row-boundary diff (src must be CSR-sorted), not a scatter.
 
-    The gain field is clipped to `31 - 2*ceil(log2 k)` bits — it only
-    drives the heuristic who-moves-first ordering; callers account cuts
-    with exact weights.  For huge k (< 15 gain bits) the packed layout
-    runs out of room and the function falls back to separate gathers.
+    The gain field is clipped to `31 - 2*ceil(log2 k)` bits; a runtime
+    guard detects when any candidate |gain| exceeds the range (heavy
+    edge weights) and dispatches the exact per-endpoint-gather fallback,
+    so move SELECTION never silently diverges from the exact ordering.
 
     Returns adj_gain[n_pad]; entries for non-candidates are the plain
     neighborhood sum with no candidate mask applied to themselves (mask
@@ -789,10 +795,11 @@ def packed_afterburner_gain(
     variant: a CSR edge list is a row buffer with owner=src and spans
     [row_ptr[i], row_ptr[i+1]).
     """
-    return packed_afterburner_gain_rows(
+    adj, _, _ = packed_afterburner_gain_rows(
         src, dst, edge_w, row_ptr[:-1], row_ptr[1:],
         part, next_part, gain, candidate, k,
     )
+    return adj
 
 
 def packed_afterburner_gain_rows(
@@ -806,11 +813,14 @@ def packed_afterburner_gain_rows(
     gain: jax.Array,
     candidate: jax.Array,
     k: int,
-) -> jax.Array:
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """packed_afterburner_gain over a row buffer: slots grouped by owner
-    with spans [start, end) per node (see expand_active_rows).  Kept
-    separate from the row_ptr variant so the Jet refiner's compiled
-    executables stay byte-identical."""
+    with spans [start, end) per node (see expand_active_rows).
+
+    Returns (adj_gain[n_pad], from_u[slots], to_u[slots]): the owner's
+    current and tentative blocks PER SLOT fall out of the endpoint
+    gathers either branch takes, so the Jet conn-table delta reuses them
+    without further irregular ops."""
     label_bits = max((k - 1).bit_length(), 1)
     gain_bits = 31 - 2 * label_bits
 
@@ -850,7 +860,7 @@ def packed_afterburner_gain_rows(
         )
         to_u = (mu >> label_bits) & lab_mask
         from_u = mu & lab_mask
-        return _row_sums(to_u, from_u, block_v, gain_u > 0)
+        return _row_sums(to_u, from_u, block_v, gain_u > 0), from_u, to_u
 
     def _exact(_):
         gain_full = jnp.where(candidate, gain, INT32_MIN)
@@ -861,8 +871,12 @@ def packed_afterburner_gain_rows(
             (gain_v > gain_u) | ((gain_v == gain_u) & (dst < owner))
         )
         block_v = jnp.where(v_before_u, next_part[dst], part[dst])
-        return _row_sums(
-            next_part[owner], part[owner], block_v, gain_u > INT32_MIN
+        from_u = part[owner]
+        to_u = next_part[owner]
+        return (
+            _row_sums(to_u, from_u, block_v, gain_u > INT32_MIN),
+            from_u,
+            to_u,
         )
 
     if gain_bits < 15:
@@ -873,8 +887,8 @@ def packed_afterburner_gain_rows(
     # weights (or degrees >~16k at k=256) push gains past the clip range
     # and silently change move SELECTION vs the exact ordering — so the
     # regime is detected at runtime (an n-wide reduce on values already
-    # in hand) and the exact per-endpoint-gather path takes over.  Both
-    # branches compile once; only one executes per call.
+    # in hand) and the exact path takes over.  Both branches compile
+    # once; only one executes per call.
     half = jnp.int32(1 << (gain_bits - 1))
     max_abs_gain = jnp.max(
         jnp.where(candidate, jnp.abs(jnp.clip(gain, -2**30, 2**30)), 0)

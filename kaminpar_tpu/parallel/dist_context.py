@@ -71,6 +71,13 @@ class DistContext:
     lp_num_iterations: int = 5
     clp_num_iterations: int = 5
     hem_rounds: int = 5
+    # mesh-subgroup replication (deep_multilevel.cc:79-153 + replicator.cc
+    # replicate_graph / distribute_best_partition analog): once the graph
+    # drops below this many nodes PER DEVICE, G replicas coarsen
+    # independently on D/G-device subgroups as one block-diagonal union
+    # (parallel/replication.py) and the best replica's partition is kept.
+    # 0 disables (coarse levels then idle most of the mesh).
+    replication_min_nodes_per_device: int = 2048
 
     # convenience passthroughs used by the driver
     @property

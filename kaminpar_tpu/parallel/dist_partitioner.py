@@ -86,6 +86,8 @@ class dKaMinPar:
         # (source graph, decoded HostGraph) — keyed on the source object
         self._plain_cache: Optional[Tuple[object, HostGraph]] = None
         self._fine_dg: Optional[DistGraph] = None
+        # set by _replicated_phase when mesh-subgroup replication fires
+        self._replication_info: Optional[dict] = None
 
     def set_graph(self, graph) -> "dKaMinPar":
         """Accepts a HostGraph or a CompressedHostGraph.  A compressed
@@ -205,12 +207,21 @@ class dKaMinPar:
         clusterer = create_dist_clusterer(ctx)
         refiner = create_dist_refiner(ctx)
 
+        from ..context import PartitioningMode
+
+        deep = self.ctx.mode == PartitioningMode.DEEP
+
         # coarsening (deep_multilevel.cc:75-118 analog)
         levels: List[Tuple[DistGraph, np.ndarray, HostGraph]] = []
         current = graph
         threshold = max(2 * c_ctx.contraction_limit, k)
         with timer.scoped_timer("dist-coarsening"):
             while current.n > threshold:
+                if deep and self._replication_factor(current.n) > 1:
+                    # the graph is too small to keep every device busy:
+                    # hand over to the mesh-subgroup replication phase
+                    # (deep_multilevel.cc:79-153 analog) below
+                    break
                 if self._is_compressed(current):
                     # still-compressed fine level: stream shards from the
                     # compressed rows (bitwise-identical result)
@@ -237,52 +248,41 @@ class dKaMinPar:
                     current, np.asarray(labels), min(mcw, WMAX),
                     materialize=lambda: self._plain(fine),
                 )
-                if current.m <= MAX_FUSED_EDGE_SLOTS:
-                    # contraction on DEVICE (sort-based dedup kernel; see
-                    # module docstring): only the coarse CSR is pulled
-                    # back, to re-shard it for the next level's 1D node
-                    # distribution (the reference's migrate step,
-                    # global_cluster_contraction.cc:1100+)
-                    fine_dev = device_graph_from_host(self._plain(current))
-                    lab_dev = jnp.asarray(labels)[: fine_dev.n_pad]
-                    if lab_dev.shape[0] < fine_dev.n_pad:
-                        lab_dev = jnp.concatenate([
-                            lab_dev,
-                            jnp.arange(lab_dev.shape[0], fine_dev.n_pad,
-                                       dtype=jnp.int32),
-                        ])
-                    coarse_dev, c_n, _c_m = contract_clustering(
-                        fine_dev, lab_dev
-                    )
-                    if c_n >= (1.0 - c_ctx.convergence_threshold) * current.n:
-                        break
-                    cmap = np.asarray(coarse_dev.cmap)[: current.n]
-                    coarse = host_graph_from_device(coarse_dev.graph)
-                else:
-                    # beyond the single-device budget: SHARDED contraction
-                    # (per-shard dedup + coarse-edge migrate all_to_all,
-                    # parallel/dist_contraction.py — the
-                    # global_cluster_contraction.cc:1100+ analog); the
-                    # fine edge list never leaves its shards
-                    coarse, cmap = dist_contract_clustering(
-                        dg, current.n, current.node_weight_array(),
-                        np.asarray(labels),
-                    )
-                    if coarse.n >= (
-                        1.0 - c_ctx.convergence_threshold
-                    ) * current.n:
-                        break
+                contracted = self._contract_level(current, dg, labels)
+                if contracted is None:  # converged
+                    break
+                coarse, cmap = contracted
                 levels.append((dg, cmap, current))
                 current = coarse
+
+        # mesh-subgroup replication (deep_multilevel.cc:79-153 +
+        # replicator.cc analog): the graph is too small for the whole
+        # mesh, so G replicas coarsen + IP + refine independently on
+        # D/G-device subgroups (one block-diagonal union graph — see
+        # parallel/replication.py) and the best replica's partition
+        # continues into the main uncoarsening below
+        replicated = False
+        if (
+            deep
+            and current.n > threshold
+            and self._replication_factor(current.n) > 1
+        ):
+            with timer.scoped_timer("dist-replicated-coarsening"):
+                # a compressed input can reach this point un-decoded (the
+                # loop breaks before the streaming branch); the union
+                # builder needs plain CSR rows
+                partition, ip_k = self._replicated_phase(
+                    self._plain(current), k, clusterer, threshold
+                )
+            replicated = True
 
         # DEEP mode partitions the coarsest at a reduced k' and doubles k
         # on the mesh during uncoarsening; KWAY partitions at full k.
         # With no dist levels there is nothing to double over — the shm
         # IP result IS the final partition, so it must run at full k.
-        from ..context import PartitioningMode
-
-        deep = self.ctx.mode == PartitioningMode.DEEP
-        if deep and levels:
+        if replicated:
+            pass
+        elif deep and levels:
             from ..partitioning.deep import compute_k_for_n
 
             ip_k = max(2, min(k, compute_k_for_n(current.n, self.ctx.shm)))
@@ -294,53 +294,23 @@ class dKaMinPar:
         # reference replicates the coarsest graph onto every PE, runs shm
         # KaMinPar per PE with that PE's seed, and keeps the best cut
         # (replicate_graph_everywhere + distribute_best_partition,
-        # kaminpar-dist/partitioning/deep_multilevel.cc:125-176).  One
-        # host plays all PEs: independent seeded runs with best-cut
-        # selection are the mesh-subgroup replication analog — each
-        # replica coarsens the handed-over graph further through its own
-        # shm hierarchy, like the reference's independent PE subgroups.
-        with timer.scoped_timer("dist-initial-partitioning"):
-            from ..kaminpar import KaMinPar
-            from ..utils.logger import OutputLevel, output_level, set_output_level
-
-            num_replicas = max(1, min(self.mesh.devices.size, 4))
-            outer_level = output_level()
-            partition = None
-            best_cut = None
-            try:
+        # kaminpar-dist/partitioning/deep_multilevel.cc:125-176).  When
+        # the mesh-subgroup replication phase ran, each replica already
+        # carried its own IP and the best partition was selected there;
+        # otherwise one host plays all PEs with independent seeded runs.
+        if not replicated:
+            with timer.scoped_timer("dist-initial-partitioning"):
+                num_replicas = max(1, min(self.mesh.devices.size, 4))
+                partition = None
+                best_cut = None
                 for r in range(num_replicas):
-                    shm = KaMinPar(self.ctx.shm.copy())
-                    # quiet the nested shm runs without leaking the
-                    # process-global logger level past this scope
-                    shm.set_output_level(OutputLevel.QUIET)
-                    shm.set_graph(self._plain(current))
-                    # span-aware caps: when ip_k does not divide k the
-                    # current blocks carry UNEQUAL final-block counts,
-                    # and the IP must balance to those targets or the
-                    # first refinement inherits systematic overloads
-                    p_ = self.ctx.partition
-                    ip_caps = np.array(
-                        [
-                            p_.total_max_block_weights(
-                                first, first + count
-                            )
-                            for first, count in spans
-                        ],
-                        dtype=np.int64,
-                    )
-                    cand = shm.compute_partition(
-                        k=ip_k,
-                        epsilon=self.ctx.partition.epsilon,
-                        max_block_weights=(
-                            None if ip_k == k else ip_caps
-                        ),
-                        seed=(self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
+                    cand = self._shm_ip(
+                        self._plain(current), ip_k, k, spans,
+                        (self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
                     )
                     cut = self._host_cut(self._plain(current), cand)
                     if best_cut is None or cut < best_cut:
                         partition, best_cut = cand, cut
-            finally:
-                set_output_level(outer_level)
 
         # uncoarsening + distributed refinement (deep_multilevel.cc:181+):
         # project up, refine at the current k, and in DEEP mode extend the
@@ -374,8 +344,16 @@ class dKaMinPar:
                             spans, seed ^ (0x9E37 + current_k), level,
                         )
         # final extensions to k (finest level)
-        if deep and levels:
-            dg, _, fine_host = levels[0]
+        if deep and (levels or replicated) and current_k < k:
+            if levels:
+                dg, _, fine_host = levels[0]
+            else:
+                # replication fired at the input level: no dist levels
+                # exist, but the split-level graph (= the input) still
+                # extends on the mesh — the shm fallback below would
+                # discard the replicated phase's partition
+                fine_host = self._plain(current)
+                dg = dist_graph_from_host(fine_host, self.mesh)
             while current_k < k:
                 partition, spans, current_k = self._extend_on_mesh(
                     fine_host, partition, spans
@@ -397,6 +375,223 @@ class dKaMinPar:
         return partition
 
     # -- deep-mode helpers -------------------------------------------------
+
+    def _shm_ip(self, host, ip_k, k, spans, seed) -> np.ndarray:
+        """One seeded shm-KaMinPar run on a coarsest(-replica) graph with
+        span-aware caps (when ip_k does not divide k the current blocks
+        carry UNEQUAL final-block counts, and the IP must balance to
+        those targets or the first refinement inherits systematic
+        overloads).  Quiet, without leaking the global logger level."""
+        from ..kaminpar import KaMinPar
+        from ..utils.logger import OutputLevel, output_level, set_output_level
+
+        outer_level = output_level()
+        try:
+            shm = KaMinPar(self.ctx.shm.copy())
+            shm.set_output_level(OutputLevel.QUIET)
+            shm.set_graph(host)
+            p_ = self.ctx.partition
+            ip_caps = np.array(
+                [
+                    p_.total_max_block_weights(first, first + count)
+                    for first, count in spans
+                ],
+                dtype=np.int64,
+            )
+            return shm.compute_partition(
+                k=ip_k,
+                epsilon=self.ctx.partition.epsilon,
+                max_block_weights=(None if ip_k == k else ip_caps),
+                seed=seed,
+            )
+        finally:
+            set_output_level(outer_level)
+
+    def _replication_factor(self, n: int) -> int:
+        from .replication import choose_replication_factor
+
+        return choose_replication_factor(
+            n,
+            int(self.mesh.devices.size),
+            int(getattr(self.ctx, "replication_min_nodes_per_device", 0)),
+        )
+
+    def _contract_level(self, current: HostGraph, dg, labels):
+        """Contract one coarsening level; returns (coarse, cmap) or None
+        when the clustering converged (coarse nearly as big as fine)."""
+        c_ctx = self.ctx.coarsening
+        if current.m <= MAX_FUSED_EDGE_SLOTS:
+            # contraction on DEVICE (sort-based dedup kernel; see module
+            # docstring): only the coarse CSR is pulled back, to re-shard
+            # it for the next level's 1D node distribution (the
+            # reference's migrate step, global_cluster_contraction.cc:1100+)
+            fine_dev = device_graph_from_host(self._plain(current))
+            lab_dev = jnp.asarray(labels)[: fine_dev.n_pad]
+            if lab_dev.shape[0] < fine_dev.n_pad:
+                lab_dev = jnp.concatenate([
+                    lab_dev,
+                    jnp.arange(lab_dev.shape[0], fine_dev.n_pad,
+                               dtype=jnp.int32),
+                ])
+            coarse_dev, c_n, _c_m = contract_clustering(fine_dev, lab_dev)
+            if c_n >= (1.0 - c_ctx.convergence_threshold) * current.n:
+                return None
+            cmap = np.asarray(coarse_dev.cmap)[: current.n]
+            coarse = host_graph_from_device(coarse_dev.graph)
+        else:
+            # beyond the single-device budget: SHARDED contraction
+            # (per-shard dedup + coarse-edge migrate all_to_all,
+            # parallel/dist_contraction.py — the
+            # global_cluster_contraction.cc:1100+ analog); the fine edge
+            # list never leaves its shards
+            coarse, cmap = dist_contract_clustering(
+                dg, current.n, current.node_weight_array(),
+                np.asarray(labels),
+            )
+            if coarse.n >= (1.0 - c_ctx.convergence_threshold) * current.n:
+                return None
+        return coarse, cmap
+
+    def _replicated_phase(
+        self, split_host: HostGraph, k: int, clusterer, threshold: int,
+    ):
+        """Coarsen G replicas of `split_host` as one block-diagonal union
+        over the mesh, IP each replica, refine the replica hierarchies in
+        lockstep union launches, and return the best replica's partition
+        at the split level (deep_multilevel.cc:79-153 +
+        replicator.cc:26-34; see parallel/replication.py for why a union
+        graph realizes PE-subgroup splitting on a device mesh).
+
+        Returns (partition i32[split_host.n] in [0, ip_k), ip_k)."""
+        from ..partitioning.deep import compute_k_for_n
+        from .dist_lp import dist_singleton_postpasses
+        from .replication import (
+            best_replica_partition,
+            replica_bounds_after_contraction,
+            slice_replica,
+            union_graph,
+        )
+
+        ctx = self.ctx
+        c_ctx = ctx.coarsening
+        n_split = split_host.n
+        G = self._replication_factor(n_split)
+        # the partition re-enters the main uncoarsening at the split
+        # level, so it must carry the k that level supports — each
+        # replica's internal shm deep pipeline builds up to ip_k exactly
+        # like a reference PE subgroup does
+        ip_k = max(2, min(k, compute_k_for_n(n_split, ctx.shm)))
+        spans = self._initial_spans(ip_k, k)
+        union = union_graph(split_host, G)
+        bounds = [g * n_split for g in range(G + 1)]
+        self._replication_info = {
+            "G": G, "split_n": n_split, "ip_k": ip_k,
+        }
+
+        # --- coarsen the union until every replica reaches the IP size;
+        # replicas diverge through id-keyed hashing (the id offset is the
+        # per-replica seed)
+        u_levels = []
+        current, cur_bounds = union, bounds
+        while max(
+            cur_bounds[g + 1] - cur_bounds[g] for g in range(G)
+        ) > threshold:
+            dg = dist_graph_from_host(current, self.mesh)
+            n_rep = max(
+                cur_bounds[g + 1] - cur_bounds[g] for g in range(G)
+            )
+            # per-REPLICA size keeps the cluster-weight cap identical to
+            # the unreplicated semantics (clusters never span replicas)
+            mcw = max(
+                1,
+                c_ctx.max_cluster_weight(
+                    n_rep, ctx.partition.total_node_weight, ctx.partition
+                ),
+            )
+            lvl_seed = (
+                ctx.seed * 7919 + (9601 + len(u_levels)) * 31337
+            ) & 0x7FFFFFFF
+            labels = np.array(
+                clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
+            )
+            # singleton post-passes must not merge across replicas (the
+            # isolated-node bins are global) — run them per component
+            for g in range(G):
+                lo, hi = cur_bounds[g], cur_bounds[g + 1]
+                sub = slice_replica(current, lo, hi)
+                sub_lab = labels[lo:hi] - lo
+                labels[lo:hi] = lo + dist_singleton_postpasses(
+                    sub, sub_lab, min(mcw, WMAX)
+                )
+            contracted = self._contract_level(current, dg, labels)
+            if contracted is None:
+                break
+            coarse, cmap = contracted
+            u_levels.append((dg, cmap, current))
+            cur_bounds = replica_bounds_after_contraction(cmap, cur_bounds)
+            current = coarse
+
+        # --- per-replica IP (each subgroup's seeded shm run) ------------
+        union_part = np.zeros(current.n, dtype=np.int32)
+        for g in range(G):
+            lo, hi = cur_bounds[g], cur_bounds[g + 1]
+            sub = slice_replica(current, lo, hi)
+            cand = self._shm_ip(
+                sub, ip_k, k, spans,
+                (ctx.seed * 31 + g * 7907) & 0x7FFFFFFF,
+            )
+            union_part[lo:hi] = cand.astype(np.int32) + g * ip_k
+
+        # --- uncoarsen the replica hierarchies in lockstep: one union
+        # refinement per level with per-replica block-id ranges and tiled
+        # caps, so every subgroup refines its own replica simultaneously.
+        # POSITIVE-GAIN LP only: a foreign replica's block always has
+        # connection 0 (components are disjoint), so strictly-improving
+        # moves can never cross replicas — balancers/Jet could (they
+        # accept zero-connection moves for balance) and would corrupt
+        # the per-replica block-id ranges
+        from ..ops.segments import pad_k_bucket
+        from .dist_lp import dist_lp_refine
+
+        base_caps = np.asarray(self._span_caps(spans))
+        k_u, union_caps, _ = pad_k_bucket(
+            G * ip_k, jnp.asarray(np.tile(base_caps, G))
+        )
+        for level_idx, (dg, cmap, fine_host) in enumerate(
+            reversed(u_levels)
+        ):
+            union_part = union_part[cmap]
+            full = np.zeros(dg.n_pad, dtype=np.int32)
+            full[: fine_host.n] = union_part
+            seed = (ctx.seed * 50411 + level_idx * 73) & 0x7FFFFFFF
+            refined = dist_lp_refine(
+                dg, jnp.asarray(full), k_u, union_caps, seed,
+                num_iterations=ctx.lp_num_iterations,
+            )
+            union_part = np.asarray(refined)[: fine_host.n]
+        # defensive: every node must still carry a block of ITS replica
+        rep_of_node = np.repeat(np.arange(G), n_split)
+        if not (
+            (union_part >= rep_of_node * ip_k)
+            & (union_part < (rep_of_node + 1) * ip_k)
+        ).all():
+            raise AssertionError(
+                "union refinement moved a node across replicas"
+            )
+
+        # --- keep the best replica (distribute_best_partition analog) --
+        part, g_best, cut = best_replica_partition(
+            split_host, union_part, G, ip_k
+        )
+        self._replication_info.update(
+            {"levels": len(u_levels), "best_replica": g_best, "cut": cut}
+        )
+        log(
+            f"replicated coarsening: G={G} replicas x "
+            f"{int(self.mesh.devices.size) // G} devices, "
+            f"{len(u_levels)} levels, best replica {g_best} cut {cut}"
+        )
+        return part.astype(np.int32), ip_k
 
     def _initial_spans(self, current_k: int, final_k: int):
         """Block spans (first final block, count) for the current blocks —

@@ -77,7 +77,17 @@ class DeepMultilevelPartitioner:
         from . import debug
 
         with timer.scoped_timer("device-upload"):
-            dgraph = device_graph_from_host(graph)
+            from ..graphs.compressed import CompressedHostGraph
+
+            if isinstance(graph, CompressedHostGraph):
+                # TeraPart compute parity: stream the decode chunk-by-
+                # chunk to the device — the flat CSR never exists on the
+                # host (graphs/csr.device_graph_from_compressed)
+                from ..graphs.csr import device_graph_from_compressed
+
+                dgraph = device_graph_from_compressed(graph)
+            else:
+                dgraph = device_graph_from_host(graph)
 
         # --- coarsen (deep_multilevel.cc:69-183) ---
         coarsener = Coarsener(ctx, dgraph, graph.n)
@@ -433,8 +443,10 @@ class DeepMultilevelPartitioner:
             bipartitioner = InitialMultilevelBipartitioner(
                 ctx.initial_partitioning
             )
-            sub_parts = []
+            sub_parts: List = []
             next_id = 0
+            pool_jobs: List[Tuple[int, HostGraph, np.ndarray, int]] = []
+            workers = max(1, int(ctx.parallel.num_workers))
             for b, span in enumerate(spans):
                 # split only while we have not reached next_k blocks overall
                 if span.count > 1:
@@ -444,7 +456,19 @@ class DeepMultilevelPartitioner:
                     )
                     if sub.n >= ctx.partitioning.device_bipartition_threshold:
                         bp = self._device_bipartition(sub, max_w, rng)
+                    elif workers > 1:
+                        # per-block seeds are PRE-DRAWN so the result is
+                        # identical for any worker-pool size (the
+                        # reference's per-PE seed discipline,
+                        # initial_bipartitioner_worker_pool.h:42)
+                        pool_jobs.append(
+                            (len(sub_parts), sub, max_w,
+                             int(rng.integers(0, 2**31 - 1)))
+                        )
+                        bp = None
                     else:
+                        # single worker: draw from the shared stream —
+                        # bitwise-identical to the pre-pool code path
                         bp = bipartitioner.bipartition(sub, max_w, rng)
                     k0, k1 = split_k(span.count)
                     new_ids_base.append((next_id, next_id + 1))
@@ -457,6 +481,40 @@ class DeepMultilevelPartitioner:
                     new_spans.append(span)
                     sub_parts.append(None)
                     next_id += 1
+
+            # host-pool bipartitions: independent per block — run them on
+            # a worker pool (the native bipartitioner releases the GIL
+            # for the duration of the C call, so threads scale on real
+            # multi-core hosts; this dev box has ONE logical CPU).
+            # Each job gets its OWN bipartitioner (the pool's adaptive
+            # per-algorithm stats are not thread-safe) and the global
+            # timer is quiesced for the pool phase (its scope stack is
+            # shared; the outer extend-partition scope still captures
+            # the wall time).
+            def run_job(job):
+                idx, sub, max_w, s = job
+                bip = InitialMultilevelBipartitioner(
+                    ctx.initial_partitioning
+                )
+                return idx, bip.bipartition(
+                    sub, max_w, np.random.default_rng(s)
+                )
+
+            if len(pool_jobs) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                was_enabled = timer.GLOBAL_TIMER.enabled
+                timer.GLOBAL_TIMER.enabled = False
+                try:
+                    with ThreadPoolExecutor(max_workers=workers) as pool:
+                        for idx, bp in pool.map(run_job, pool_jobs):
+                            sub_parts[idx] = bp
+                finally:
+                    timer.GLOBAL_TIMER.enabled = was_enabled
+            else:
+                for job in pool_jobs:
+                    idx, bp = run_job(job)
+                    sub_parts[idx] = bp
 
             new_part = np.zeros(host.n, dtype=np.int32)
             for b, span in enumerate(spans):

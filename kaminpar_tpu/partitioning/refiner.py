@@ -152,6 +152,10 @@ class RefinerPipeline:
                         max_block_weights[: self.k],
                         self.ctx.refinement.fm,
                         seed=seed + i,
+                        # reference-style worker pool (fm_refiner.cc:48);
+                        # 1 on this dev box (one logical CPU) keeps runs
+                        # bitwise-deterministic
+                        threads=self.ctx.parallel.num_workers,
                     )
             else:
                 log_warning(f"unknown refinement algorithm: {algorithm}")

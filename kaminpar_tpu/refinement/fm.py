@@ -36,6 +36,7 @@ def fm_refine_host(
     max_block_weights,
     ctx: FMRefinementContext,
     seed: int = 0,
+    threads: int = 1,
 ):
     """Refine a device partition with host FM; returns a device partition.
 
@@ -60,7 +61,9 @@ def fm_refine_host(
         # native localized BATCH FM (fm.cpp — the reference's parallel
         # localized scheme minus threads: seeded regions grown against a
         # delta gain overlay, best prefixes committed)
-        improvement = native.fm_refine(graph, part, k, max_bw, ctx, seed)
+        improvement = native.fm_refine(
+            graph, part, k, max_bw, ctx, seed, threads=threads
+        )
         native_ok = improvement is not None
     if not native_ok:
         node_w = graph.node_weight_array()

@@ -37,6 +37,19 @@ def _seed():
     yield
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test modules.
+
+    The suite compiles thousands of CPU executables in one process; the
+    accumulated JIT state eventually segfaulted XLA's CPU compiler mid-
+    suite (reproducible at the same test, absent when the same tests run
+    in a fresh process).  Clearing per module keeps the live-executable
+    population bounded at a small recompile cost."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def rgg2d():
     from kaminpar_tpu.io import load_graph

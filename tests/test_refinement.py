@@ -314,3 +314,47 @@ def test_afterburner_packed_path_matches_exact_in_range():
     filter (the guard keeps the cheap branch)."""
     packed, exact, cand = _afterburner_pair(weight_scale=50, k=256, seed=4)
     np.testing.assert_array_equal(packed[cand], exact[cand])
+
+
+def test_fm_threaded_pool_feasible_and_improves():
+    """The threaded native FM (NodeTracker claims + atomic gain table)
+    must keep the caps and improve the cut; threads=1 must reproduce the
+    sequential result bitwise (same rng discipline)."""
+    import os
+
+    if os.environ.get("KAMINPAR_TPU_NO_NATIVE_FM", "") == "1":
+        import pytest
+
+        pytest.skip("native FM disabled")
+    from kaminpar_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("no native lib")
+
+    g = factories.make_rmat(1 << 11, 24_000, seed=8)
+    dg = device_graph_from_host(g)
+    k = 8
+    nw = np.asarray(dg.node_w)[: int(dg.n)]
+    cap = jnp.full(k, int(1.1 * np.ceil(nw.sum() / k)), dtype=jnp.int32)
+    rng = np.random.default_rng(2)
+    p0 = np.zeros(dg.n_pad, np.int32)
+    p0[: int(dg.n)] = rng.integers(0, k, int(dg.n))
+    p0 = jnp.asarray(p0)
+    from kaminpar_tpu.ops.metrics import edge_cut
+
+    cut0 = int(edge_cut(dg, p0))
+    ctx = FMRefinementContext()
+
+    seq1 = np.asarray(fm_refine_host(dg, p0, k, cap, ctx, seed=5, threads=1))
+    seq2 = np.asarray(fm_refine_host(dg, p0, k, cap, ctx, seed=5, threads=1))
+    np.testing.assert_array_equal(seq1, seq2)  # deterministic
+
+    for threads in (1, 2, 4):
+        out = fm_refine_host(dg, p0, k, cap, ctx, seed=5, threads=threads)
+        labels = np.asarray(out)[: int(dg.n)]
+        bw = np.bincount(labels, weights=nw, minlength=k)
+        assert bw.max() <= int(cap[0]), (threads, bw.max())
+        cut = int(edge_cut(dg, out))
+        assert cut < cut0, (threads, cut, cut0)
