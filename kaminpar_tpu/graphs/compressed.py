@@ -336,3 +336,68 @@ def compress_from_stream(sg, codec: str = "auto") -> CompressedHostGraph:
         xadj=xadj, offsets=offsets, data=data, codec="gap",
         edge_weights=ew,
     )
+
+
+def extract_core_compressed(
+    cgraph: CompressedHostGraph, chunk_nodes: int = 1 << 18
+):
+    """Compressed-to-compressed isolated-node extraction
+    (kaminpar.cc:392-404 without ever materializing the flat CSR).
+
+    Streams decoded node-range chunks, drops degree-0 rows, remaps
+    neighbor ids through the monotone core numbering, re-sorts each row
+    (v2 decodes in emit order; the encoders need ascending rows) and
+    re-encodes — peak memory stays at compressed + one chunk + O(n).
+
+    Returns (core CompressedHostGraph, core_ids, iso_ids): source node
+    ids of the core (in order — the core numbering is their rank) and of
+    the isolated nodes."""
+    deg = cgraph.degrees()
+    iso = deg == 0
+    core_ids = np.flatnonzero(~iso)
+    iso_ids = np.flatnonzero(iso)
+    n_core = len(core_ids)
+    new_id = (np.cumsum(~iso) - 1).astype(np.int64)
+
+    class _CoreStream:
+        n = n_core
+
+        def chunks(self):
+            from ..io.skagen import GraphChunk
+
+            for v0 in range(0, cgraph.n, chunk_nodes):
+                v1 = min(cgraph.n, v0 + chunk_nodes)
+                keep = ~iso[v0:v1]
+                if not keep.any():
+                    continue
+                xr, adj, ew = cgraph.decode_range(v0, v1)
+                xr = np.asarray(xr, dtype=np.int64)
+                dslice = np.diff(xr)
+                adj2 = new_id[np.asarray(adj, dtype=np.int64)]
+                row = np.repeat(np.arange(v1 - v0), dslice)
+                order = np.lexsort((adj2, row))
+                adj2 = adj2[order].astype(np.int32)
+                w = (
+                    np.ones(len(adj2), dtype=np.int64)
+                    if ew is None
+                    else np.asarray(ew, dtype=np.int64)[order]
+                )
+                # xadj of the kept rows only (isolated rows are empty, so
+                # the edge stream is untouched by dropping them)
+                kept_deg = dslice[keep]
+                cxadj = np.concatenate(
+                    [[0], np.cumsum(kept_deg)]
+                ).astype(np.int64)
+                first_core = int(new_id[v0 + int(np.argmax(keep))])
+                yield GraphChunk(
+                    v_begin=first_core,
+                    v_end=first_core + int(keep.sum()),
+                    xadj=cxadj,
+                    adjncy=adj2,
+                    adjwgt=w,
+                )
+
+    core = compress_from_stream(_CoreStream(), codec=cgraph.codec)
+    if cgraph.node_weights is not None:
+        core.node_weights = np.asarray(cgraph.node_weights)[core_ids]
+    return core, core_ids, iso_ids

@@ -169,6 +169,32 @@ class KaMinPar:
                 if (
                     num_isolated
                     and graph.n > num_isolated
+                    and still_compressed
+                ):
+                    # compressed twin of the decoded branch below: the
+                    # core graph is extracted compressed-to-compressed
+                    # (chunk-streamed re-encode, graphs/compressed.py)
+                    # and isolated nodes refill blocks by headroom —
+                    # skipping this cost 28% cut at k=128 (isolated
+                    # weight distorts coarsening and balance)
+                    from .graphs.compressed import extract_core_compressed
+                    from .graphs.host import NodePermutation
+
+                    core_cg, core_ids, iso_ids = extract_core_compressed(
+                        graph
+                    )
+                    part_core = self._partition_core(core_cg, ctx)
+                    new_to_old = np.concatenate([core_ids, iso_ids])
+                    old_to_new = np.empty(graph.n, dtype=np.int64)
+                    old_to_new[new_to_old] = np.arange(graph.n)
+                    partition = self._reintegrate_isolated(
+                        graph, core_cg,
+                        NodePermutation(old_to_new, new_to_old),
+                        num_isolated, part_core,
+                    )
+                elif (
+                    num_isolated
+                    and graph.n > num_isolated
                     and not still_compressed
                 ):
                     core, perm, _ = remove_isolated_nodes(graph)

@@ -840,7 +840,10 @@ def packed_afterburner_gain_rows(
     def _packed(_):
         half = jnp.int32(1 << (gain_bits - 1))
         gain_clip = jnp.clip(gain, 1 - half, half - 1) + half
-        gain_field = jnp.where(candidate, gain_clip, 0)
+        # the clipped field fits its bit budget by construction; force
+        # int32 so 64-bit weight builds produce the same meta dtype as
+        # the exact branch's label columns (lax.cond requires it)
+        gain_field = jnp.where(candidate, gain_clip, 0).astype(jnp.int32)
         meta = (
             (gain_field << (2 * label_bits))
             | (next_part << label_bits)

@@ -79,6 +79,10 @@ class DeepMultilevelPartitioner:
         with timer.scoped_timer("device-upload"):
             from ..graphs.compressed import CompressedHostGraph
 
+            # streamed inputs keep the host footprint at compressed +
+            # O(n); the extend path must then avoid full-graph readbacks
+            # (see _extend_partition)
+            self._streamed_input = isinstance(graph, CompressedHostGraph)
             if isinstance(graph, CompressedHostGraph):
                 # TeraPart compute parity: stream the decode chunk-by-
                 # chunk to the device — the flat CSR never exists on the
@@ -350,10 +354,15 @@ class DeepMultilevelPartitioner:
         readback is cheap and whose numpy extraction needs no extra
         device programs.  So does the large-k regime: with hundreds of
         small blocks, per-block device programs would pay the ~87 ms
-        launch floor per block — one readback + native bipartitions win."""
+        launch floor per block — one readback + native bipartitions win.
+        STREAMED (compressed) inputs raise the span limit to 128: the
+        host readback would blow the compressed-mode memory contract
+        (peak RSS tracked 8.4 GB at k=128 through this path), and the
+        extra per-block launch floors are what TeraPart parity costs."""
+        span_limit = 128 if getattr(self, "_streamed_input", False) else 64
         if (
             dgraph.m_pad >= DEVICE_EXTEND_MIN_EDGE_SLOTS
-            and len(spans) <= 64
+            and len(spans) <= span_limit
         ):
             return self._extend_partition_device(
                 dgraph, partition, spans, next_k, rng

@@ -68,8 +68,9 @@ def run(name: str, preset: str, n: int, m: int, gen_seed: int, k: int,
         )
         from kaminpar_tpu.io import load_compressed
 
+        # np.savez appends .npz to extensionless-or-foreign suffixes
         path = os.path.join(tempfile.gettempdir(),
-                            f"rmat_{n}_{m}_{gen_seed}.kcg")
+                            f"rmat_{n}_{m}_{gen_seed}.kcg.npz")
         if not os.path.exists(path):
             code = (
                 "import sys; sys.path.insert(0, %r)\n"
@@ -79,7 +80,7 @@ def run(name: str, preset: str, n: int, m: int, gen_seed: int, k: int,
                 "write_compressed(%r, compress_host_graph("
                 "make_rmat(%d, %d, seed=%d)))\n"
             ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 path, n, m, gen_seed)
+                 path[: -len(".npz")], n, m, gen_seed)
             subprocess.run([sys.executable, "-c", code], check=True)
         cg = load_compressed(path)
         entry["codec"] = cg.codec

@@ -352,15 +352,17 @@ def test_compressed_compute_partition_no_decode():
 
 
 def test_compressed_compute_with_isolated_nodes_no_decode():
-    """Isolated nodes must NOT force a decode: the device pipeline
-    places them (LP isolated packing + balancers) instead of the host
-    pre-pass.  Partition must stay feasible."""
+    """Isolated nodes must NOT force a decode: the core graph is
+    extracted compressed-to-compressed (chunk-streamed re-encode) and
+    isolated nodes refill blocks by headroom — same semantics as the
+    decoded path, so the cut must MATCH the decoded-input run."""
     import kaminpar_tpu as ktp
     from kaminpar_tpu.graphs.compressed import (
         compress_host_graph,
         compressed_partition_metrics,
     )
     from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.graphs.host import host_partition_metrics
 
     host = make_rmat(1 << 12, 60_000, seed=4)  # has isolated nodes
     assert int((host.degrees() == 0).sum()) > 0
@@ -374,3 +376,80 @@ def test_compressed_compute_with_isolated_nodes_no_decode():
     nw = host.node_weight_array()
     cap = (1 + eps) * np.ceil(nw.sum() / k)
     assert m["block_weights"].max() <= cap
+
+    ph = ktp.KaMinPar("default").set_graph(host).compute_partition(
+        k=k, epsilon=eps, seed=1
+    )
+    mh = host_partition_metrics(host, ph, k)
+    assert m["cut"] == mh["cut"], (m["cut"], mh["cut"])
+
+
+def test_extract_core_compressed_roundtrip():
+    """Compressed core extraction must equal remove_isolated_nodes on
+    the decoded graph (same rows, remapped ids, per-row sorted)."""
+    from kaminpar_tpu.graphs.compressed import (
+        compress_host_graph,
+        extract_core_compressed,
+    )
+    from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.graphs.host import remove_isolated_nodes
+
+    host = make_rmat(1 << 10, 6_000, seed=2)
+    assert int((host.degrees() == 0).sum()) > 0
+    cg = compress_host_graph(host)
+    core_cg, core_ids, iso_ids = extract_core_compressed(
+        cg, chunk_nodes=100
+    )
+    core_ref, perm, _ = remove_isolated_nodes(host)
+    dec = core_cg.decode()
+    assert dec.n == core_ref.n and dec.m == core_ref.m
+    np.testing.assert_array_equal(dec.xadj, core_ref.xadj)
+    # per-row neighbor sets match (order may differ: re-encode sorts)
+    for u in range(dec.n):
+        a = sorted(dec.adjncy[dec.xadj[u]:dec.xadj[u + 1]])
+        b = sorted(core_ref.adjncy[core_ref.xadj[u]:core_ref.xadj[u + 1]])
+        assert a == b, u
+    assert len(core_ids) + len(iso_ids) == host.n
+
+
+def test_extract_core_compressed_weighted_roundtrip():
+    """Weighted twin of the core-extraction roundtrip: edge weights must
+    survive the per-row re-sort + re-encode (the v2 emit-order hazard)
+    and node weights must subset to the core."""
+    from kaminpar_tpu.graphs.compressed import (
+        compress_host_graph,
+        extract_core_compressed,
+    )
+    from kaminpar_tpu.graphs.factories import make_rmat
+    from kaminpar_tpu.graphs.host import remove_isolated_nodes
+
+    host = make_rmat(1 << 10, 6_000, seed=2)
+    rng = np.random.default_rng(7)
+    ew = host.edge_weight_array().copy()
+    # make_rmat graphs carry multiplicity weights; scramble further, but
+    # keep the symmetric invariant w(u,v) == w(v,u) via a canonical key
+    src = host.edge_sources()
+    lo = np.minimum(src, host.adjncy)
+    hi = np.maximum(src, host.adjncy)
+    ew = 1 + ((lo * 7919 + hi * 104729) % 97).astype(np.int64)
+    host.edge_weights = ew
+    host.node_weights = rng.integers(1, 9, host.n).astype(np.int64)
+    assert int((host.degrees() == 0).sum()) > 0
+    cg = compress_host_graph(host)
+    core_cg, core_ids, iso_ids = extract_core_compressed(
+        cg, chunk_nodes=100
+    )
+    core_ref, _, _ = remove_isolated_nodes(host)
+    dec = core_cg.decode()
+    np.testing.assert_array_equal(dec.xadj, core_ref.xadj)
+    np.testing.assert_array_equal(
+        np.asarray(core_cg.node_weights), core_ref.node_weight_array()
+    )
+    dw = dec.edge_weight_array()
+    rw = core_ref.edge_weight_array()
+    for u in range(dec.n):
+        a = sorted(zip(dec.adjncy[dec.xadj[u]:dec.xadj[u + 1]],
+                       dw[dec.xadj[u]:dec.xadj[u + 1]]))
+        b = sorted(zip(core_ref.adjncy[core_ref.xadj[u]:core_ref.xadj[u + 1]],
+                       rw[core_ref.xadj[u]:core_ref.xadj[u + 1]]))
+        assert a == b, u
