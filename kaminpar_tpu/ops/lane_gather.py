@@ -1,0 +1,280 @@
+"""Static-index gather at streaming speed via Mosaic's lane-wise
+``tpu.dynamic_gather``.
+
+The pipeline's hot irregular op is ``table[idx]`` with ``idx`` an
+edge-wide index array (``labels[dst]`` in LP rating, block lookups in
+Jet).  XLA lowers that gather index-serially on TPU: ~12.5 ns per index,
+0.1% of HBM peak (scripts/microbench_gather.py, docs/performance.md) —
+the round-4 speed floor.
+
+Mosaic (JAX >= 0.9) *does* lower one gather shape to hardware:
+``jnp.take_along_axis(x, q, axis=0)`` on 2D operands of identical shape
+becomes ``tpu.dynamic_gather``:
+
+    out[s, l] = x[q[s, l], l]          # per-LANE gather across sublanes
+
+Element (s, l) can only read column l.  A general gather therefore
+needs indices routed to their *native lane* (``idx % 128``) first —
+normally a per-call reshuffle as expensive as the gather itself.  Two
+properties of this pipeline break the deadlock:
+
+  1. The index arrays are STATIC per graph level (CSR topology does not
+     change between LP/Jet rounds; only the table — labels, blocks —
+     changes).  The routing can be planned ONCE per level and reused by
+     every round.
+  2. The consumers are ORDER-AGNOSTIC: the sort2 rating engine re-sorts
+     (owner, label, weight) triples anyway and the dense engine
+     segment-sums them, so gathered values never need to return to edge
+     order.  Static co-arrays (src, edge_w) are routed once at plan
+     build and ride along.
+
+``build_gather_plan`` sorts the indices by (table chunk, lane) on
+device, pads each lane's run to a common per-chunk height, and records
+(a) ``q``: the in-chunk row each routed slot reads, (b) ``inv``: the
+original position each routed slot serves (-1 for pad).  ``lane_gather``
+then streams the table chunk-by-chunk through VMEM with a
+scalar-prefetched chunk id per grid tile; per round it moves
+8 B/element instead of paying the 12.5 ns/element XLA loop.
+
+Reference anchor: the op this accelerates is the neighbor-label lookup
+of the reference's LP loop (kaminpar-shm/label_propagation.h:1682) and
+Jet's block lookups (kaminpar-shm/refinement/jet/jet_refiner.cc).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.math import ceil_div, round_up
+
+L = 128  # TPU lane count — the native minor dimension of every table
+
+# Rows per table chunk: 4096x128 int32 = 2 MiB.  With the (S, 128)
+# q/out blocks double-buffered by the pallas pipeline this stays well
+# inside the ~16 MiB VMEM budget.
+DEFAULT_CHUNK_ROWS = 4096
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class GatherPlan:
+    """Static routing plan for gathers from a fixed index array.
+
+    Leaves (device arrays):
+      q          i32[H, 128]   in-chunk source row per routed slot
+      tile_chunk i32[H // S]   table chunk id per grid tile
+      inv        i32[H * 128]  original index position per routed slot
+                               (-1 for pad slots)
+    Static:
+      S       rows per table chunk (grid tile height)
+      C       number of table chunks
+      H       routed rows (multiple of S)
+      m       original index count
+      n_rows  table rows (table_len // 128)
+    """
+
+    q: jax.Array
+    tile_chunk: jax.Array
+    inv: jax.Array
+    S: int
+    C: int
+    H: int
+    m: int
+    n_rows: int
+
+    def tree_flatten(self):
+        return (
+            (self.q, self.tile_chunk, self.inv),
+            (self.S, self.C, self.H, self.m, self.n_rows),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        return cls(*leaves, *aux)
+
+    @property
+    def num_slots(self) -> int:
+        return self.H * L
+
+
+@functools.partial(jax.jit, static_argnames=("sl",))
+def _sort_by_key(idx, sl):
+    """Sort positions by (chunk, lane) key; return key_s, pos_s, qloc_s."""
+    m = idx.shape[0]
+    lane = idx % L
+    chunk = idx // (sl * L)
+    qloc = (idx // L) % sl
+    key = chunk * L + lane
+    pos = jnp.arange(m, dtype=jnp.int32)
+    return lax.sort((key, pos, qloc), num_keys=1)
+
+
+@functools.partial(jax.jit, static_argnames=("H",))
+def _scatter_plan(key_s, pos_s, qloc_s, chunk_start, region_off, H):
+    """Place sorted entries at their padded routed slots."""
+    m = key_s.shape[0]
+    iota = jnp.arange(m, dtype=jnp.int32)
+    prev = jnp.concatenate([jnp.array([-1], key_s.dtype), key_s[:-1]])
+    grp_start = key_s != prev
+    rank = iota - lax.cummax(jnp.where(grp_start, iota, 0))
+    lane_s = key_s % L
+    # expand the (C,) region offsets to the m sorted slots without an
+    # m-wide gather: drop each chunk's offset at its first sorted
+    # position (a C-element scatter; empty chunks share a position, so
+    # .max keeps the largest = the live one) and forward-fill by cummax
+    marks = (
+        jnp.zeros(m, dtype=jnp.int32)
+        .at[chunk_start]
+        .max(region_off, mode="drop")
+    )
+    row = lax.cummax(marks) + rank
+    slot = row * L + lane_s
+    q = (
+        jnp.zeros(H * L, dtype=jnp.int32)
+        .at[slot]
+        .set(qloc_s, mode="drop")
+        .reshape(H, L)
+    )
+    inv = (
+        jnp.full(H * L, -1, dtype=jnp.int32).at[slot].set(pos_s, mode="drop")
+    )
+    return q, inv
+
+
+def build_gather_plan(
+    idx,
+    table_len: int,
+    chunk_rows: int = DEFAULT_CHUNK_ROWS,
+) -> GatherPlan:
+    """Plan lane-routed gathers from the static index array ``idx``.
+
+    ``table_len`` must be a multiple of 128 (device arrays are padded
+    to lane multiples already).  Values of ``idx`` must lie in
+    [0, table_len).  Not jittable (the routed height depends on the
+    lane-count histogram), but cheap: one m-wide sort, two m-wide
+    scatters, and a 1 KiB histogram readback — amortized over every
+    round at the level.
+    """
+    if table_len % L:
+        raise ValueError(f"table_len {table_len} not a multiple of {L}")
+    n_rows = table_len // L
+    S = min(round_up(n_rows, 8), chunk_rows)
+    C = ceil_div(n_rows, S)
+    idx = jnp.asarray(idx, dtype=jnp.int32)
+    m = idx.shape[0]
+    key_s, pos_s, qloc_s = _sort_by_key(idx, S)
+
+    # per-(chunk, lane) counts via boundary search on the sorted keys
+    bounds = np.asarray(
+        jnp.searchsorted(key_s, jnp.arange(C * L + 1, dtype=jnp.int32))
+    )
+    if m and (int(bounds[0]) != 0 or int(bounds[-1]) != m):
+        raise ValueError(
+            f"indices out of range [0, {table_len}): the sorted key "
+            f"histogram covers [{int(bounds[0])}, {int(bounds[-1])}) of "
+            f"{m} entries"
+        )
+    counts = (bounds[1:] - bounds[:-1]).reshape(C, L)
+    h_c = [round_up(max(int(counts[c].max()), 1), S) for c in range(C)]
+    region_off = np.concatenate([[0], np.cumsum(h_c)[:-1]]).astype(np.int32)
+    chunk_start = bounds[: C * L : L].astype(np.int32)
+    H = int(sum(h_c))
+
+    q, inv = _scatter_plan(
+        key_s,
+        pos_s,
+        qloc_s,
+        jnp.asarray(chunk_start),
+        jnp.asarray(region_off),
+        H,
+    )
+    tiles = []
+    for c in range(C):
+        tiles.extend([c] * (h_c[c] // S))
+    return GatherPlan(
+        q=q,
+        tile_chunk=jnp.asarray(tiles, dtype=jnp.int32),
+        inv=inv,
+        S=S,
+        C=C,
+        H=H,
+        m=m,
+        n_rows=n_rows,
+    )
+
+
+def route_codata(plan: GatherPlan, arr, fill):
+    """Route a static edge-order co-array into the plan's slot order.
+
+    Done once per level per array (an ordinary XLA gather); the result
+    is reused by every round.  Pad slots get ``fill``.
+    """
+    arr = jnp.asarray(arr)
+    safe = jnp.clip(plan.inv, 0, max(plan.m - 1, 0))
+    return jnp.where(plan.inv >= 0, arr[safe], fill)
+
+
+def _gather_kernel(tile_chunk_ref, table_ref, q_ref, out_ref):
+    del tile_chunk_ref  # consumed by the index maps
+    out_ref[...] = jnp.take_along_axis(table_ref[...], q_ref[...], axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def lane_gather(table, plan: GatherPlan, interpret: bool = False):
+    """Gather ``table[idx]`` in the plan's routed slot order.
+
+    ``table`` is the flat i32[table_len] array (e.g. labels).  Returns
+    i32[H * 128]; slot j serves original index position plan.inv[j]
+    (-1 slots are pads).  Use ``route_codata`` at plan build to align
+    per-edge companions.
+    """
+    S, C, H = plan.S, plan.C, plan.H
+    tab = table.astype(jnp.int32)
+    pad = C * S * L - tab.shape[0]
+    if pad:
+        tab = jnp.concatenate([tab, jnp.zeros(pad, jnp.int32)])
+    tab3 = tab.reshape(C, S, L)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(H // S,),
+        in_specs=[
+            pl.BlockSpec((None, S, L), lambda t, tc: (tc[t], 0, 0)),
+            pl.BlockSpec((S, L), lambda t, tc: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((S, L), lambda t, tc: (t, 0)),
+    )
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((H, L), jnp.int32),
+        interpret=interpret,
+    )(plan.tile_chunk, tab3, plan.q)
+    return out.reshape(H * L)
+
+
+@functools.lru_cache(maxsize=1)
+def lane_gather_supported() -> bool:
+    """One-time probe: does this backend compile + correctly run the
+    dynamic_gather kernel on a multi-vreg (cross-sublane) table?"""
+    try:
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return False
+        n = 16 * L
+        rng = np.random.RandomState(0)
+        idx = rng.randint(0, n, 4096).astype(np.int32)
+        table = rng.randint(0, 1 << 30, n).astype(np.int32)
+        plan = build_gather_plan(jnp.asarray(idx), n)
+        got = np.asarray(lane_gather(jnp.asarray(table), plan))
+        inv = np.asarray(plan.inv)
+        ok = inv >= 0
+        return bool(np.array_equal(got[ok], table[idx[inv[ok]]]))
+    except Exception:  # pragma: no cover - backend specific
+        return False

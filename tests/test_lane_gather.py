@@ -1,0 +1,89 @@
+"""Lane-routed gather plan + Pallas kernel (ops/lane_gather.py).
+
+On CPU the kernel runs in interpreter mode; the on-device Mosaic
+lowering is probed separately by lane_gather_supported() and measured
+by scripts/microbench_gather.py.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaminpar_tpu.ops.lane_gather import (
+    L,
+    build_gather_plan,
+    lane_gather,
+    route_codata,
+)
+
+
+def _check_plan(idx, table_len, chunk_rows=None):
+    kwargs = {} if chunk_rows is None else {"chunk_rows": chunk_rows}
+    plan = build_gather_plan(jnp.asarray(idx), table_len, **kwargs)
+    rng = np.random.RandomState(7)
+    table = rng.randint(0, 1 << 30, table_len).astype(np.int32)
+    got = np.asarray(lane_gather(jnp.asarray(table), plan, interpret=True))
+    inv = np.asarray(plan.inv)
+
+    # every original position is served by exactly one routed slot
+    served = inv[inv >= 0]
+    assert sorted(served.tolist()) == list(range(len(idx)))
+    # routed slots carry the right table values
+    ok = inv >= 0
+    np.testing.assert_array_equal(got[ok], table[idx[inv[ok]]])
+    return plan, got
+
+
+def test_single_chunk_small():
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, 1024, 300).astype(np.int32)
+    plan, _ = _check_plan(idx, 1024)
+    assert plan.C == 1
+    assert plan.H % plan.S == 0
+
+
+def test_multi_chunk():
+    rng = np.random.RandomState(1)
+    idx = rng.randint(0, 64 * L, 1000).astype(np.int32)
+    plan, _ = _check_plan(idx, 64 * L, chunk_rows=16)
+    assert plan.C == 4
+
+
+def test_skewed_lanes():
+    # all indices hit the same lane — worst-case padding, still correct
+    idx = (np.arange(200, dtype=np.int32) % 5) * L + 3
+    plan, _ = _check_plan(idx, 8 * L)
+    assert plan.H * L >= 200
+
+
+def test_duplicate_and_boundary_indices():
+    idx = np.array([0, 0, 1023, 1023, 512, 0], dtype=np.int32)
+    _check_plan(idx, 1024)
+
+
+def test_route_codata_alignment():
+    rng = np.random.RandomState(3)
+    table_len = 16 * L
+    m = 500
+    idx = rng.randint(0, table_len, m).astype(np.int32)
+    co = rng.randint(0, 1 << 20, m).astype(np.int32)
+    plan = build_gather_plan(jnp.asarray(idx), table_len)
+    co_r = np.asarray(route_codata(plan, jnp.asarray(co), -7))
+    inv = np.asarray(plan.inv)
+    ok = inv >= 0
+    np.testing.assert_array_equal(co_r[ok], co[inv[ok]])
+    assert (co_r[~ok] == -7).all()
+
+
+def test_plan_rejects_unaligned_table():
+    with pytest.raises(ValueError):
+        build_gather_plan(jnp.zeros(4, jnp.int32), 100)
+
+
+def test_plan_rejects_out_of_range_indices():
+    with pytest.raises(ValueError):
+        build_gather_plan(jnp.array([-1, 5], jnp.int32), 1024)
+    with pytest.raises(ValueError):
+        build_gather_plan(
+            jnp.array([5, 64 * L * 2], jnp.int32), 64 * L, chunk_rows=64
+        )
