@@ -86,6 +86,39 @@ def rgg2d_radius(n: int, avg_degree: float) -> float:
     return float(np.sqrt(avg_degree / (np.pi * max(n, 1))))
 
 
+def rgg3d_radius(n: int, avg_degree: float) -> float:
+    """Connection radius giving ~avg_degree expected neighbors in the
+    unit cube."""
+    return float((avg_degree / (4.0 / 3.0 * np.pi * max(n, 1))) ** (1.0 / 3.0))
+
+
+def make_delaunay(n: int, seed: Optional[int] = None) -> HostGraph:
+    """Delaunay triangulation of n uniform random points on the unit
+    square (the KaGen RDG2D analog) — the real-topology graph class the
+    reference's quality claims are evaluated on (Walshaw/KaGen meshes)."""
+    from scipy.spatial import Delaunay  # baked into the image
+
+    rng = np.random.default_rng(seed if seed is not None else rng_mod.get_seed())
+    pts = rng.random((n, 2))
+    tri = Delaunay(pts)
+    s = tri.simplices
+    e = np.concatenate([s[:, [0, 1]], s[:, [1, 2]], s[:, [0, 2]]])
+    e = np.unique(np.sort(e, axis=1), axis=0)
+    return from_edge_list(n, e.astype(np.int64))
+
+
+def make_fe_grid(rows: int, cols: int) -> HostGraph:
+    """Triangulated structured grid: each unit cell split into two
+    triangles, so interior nodes have degree 6 — an fe_ocean-class
+    finite-element mesh stand-in (planar, bounded degree, small
+    separators) built deterministically without external mesh files."""
+    ids = np.arange(rows * cols, dtype=np.int64).reshape(rows, cols)
+    right = np.stack([ids[:, :-1].ravel(), ids[:, 1:].ravel()], axis=1)
+    down = np.stack([ids[:-1, :].ravel(), ids[1:, :].ravel()], axis=1)
+    diag = np.stack([ids[:-1, :-1].ravel(), ids[1:, 1:].ravel()], axis=1)
+    return from_edge_list(rows * cols, np.concatenate([right, down, diag]))
+
+
 def make_rgg2d(
     n: int, avg_degree: float = 8.0, seed: Optional[int] = None
 ) -> HostGraph:
@@ -257,6 +290,8 @@ _GENERATORS = {
     "ba": make_ba,
     "grid2d": lambda rows, cols: make_grid_graph(rows, cols),
     "grid3d": make_grid3d,
+    "delaunay": make_delaunay,
+    "fegrid": make_fe_grid,
 }
 
 

@@ -86,11 +86,15 @@ class StreamedGraph:
         v0, v1 = self.chunk_range(c)
         if self.kind in ("rmat", "gnm"):
             src, dst = self._edge_chunk(v0, v1)
-        elif self.kind == "rgg2d":
-            src, dst = self._rgg2d_chunk(v0, v1)
+        elif self.kind in ("rgg2d", "rgg3d"):
+            src, dst = self._rgg_chunk(v0, v1)
         else:  # pragma: no cover - guarded by streamed()
             raise ValueError(self.kind)
         return _rows_from_directed(v0, v1, self.n, src, dst)
+
+    @property
+    def _dim(self) -> int:
+        return 3 if self.kind == "rgg3d" else 2
 
     def chunks(self) -> Iterator[GraphChunk]:
         for c in range(self.num_chunks):
@@ -140,7 +144,7 @@ class StreamedGraph:
             return z, z
         return np.concatenate(srcs), np.concatenate(dsts)
 
-    # -- RGG2D: deterministic cell grid ----------------------------------
+    # -- RGG2D/RGG3D: deterministic cell grid ----------------------------
     def _cell_counts(self) -> np.ndarray:
         """Points per cell via a deterministic recursive binomial split of
         n — depends only on (seed, n, ncell), so it is computed once per
@@ -149,7 +153,7 @@ class StreamedGraph:
         if self._cell_counts_cache is not None:
             return self._cell_counts_cache
         ncell = self.params["ncell"]
-        total_cells = ncell * ncell
+        total_cells = ncell ** self._dim
         counts = np.zeros(total_cells, dtype=np.int64)
         stack = [(0, total_cells, self.n)]
         while stack:
@@ -167,19 +171,40 @@ class StreamedGraph:
         self._cell_counts_cache = counts
         return counts
 
+    def _cell_coords(self, cell: int) -> Tuple[int, ...]:
+        """Decode a flat cell id into grid coordinates (row-major: the 2D
+        decode matches the original divmod(cell, ncell) layout)."""
+        ncell = self.params["ncell"]
+        coords = []
+        for _ in range(self._dim):
+            cell, c = divmod(cell, ncell)
+            coords.append(c)
+        return tuple(reversed(coords))
+
     def _cell_points(self, cell: int, count: int) -> np.ndarray:
         ncell = self.params["ncell"]
-        cx, cy = divmod(cell, ncell)
         rng = _block_rng(self.seed, 3, cell)
-        pts = rng.random((count, 2))
-        return (pts + np.array([cx, cy])) / ncell
+        pts = rng.random((count, self._dim))
+        return (pts + np.array(self._cell_coords(cell))) / ncell
 
-    def _rgg2d_chunk(self, v0: int, v1: int) -> Tuple[np.ndarray, np.ndarray]:
+    def _neighbor_cells(self, cell: int):
+        from itertools import product
+
+        ncell = self.params["ncell"]
+        coords = self._cell_coords(cell)
+        for deltas in product((-1, 0, 1), repeat=self._dim):
+            nb = [c + d for c, d in zip(coords, deltas)]
+            if all(0 <= c < ncell for c in nb):
+                flat = 0
+                for c in nb:
+                    flat = flat * ncell + c
+                yield flat
+
+    def _rgg_chunk(self, v0: int, v1: int) -> Tuple[np.ndarray, np.ndarray]:
         """Directed edges with source in [v0, v1).  Vertex ids are
         cell-major (prefix sums of the deterministic cell counts); only
-        the cells overlapping the range plus their 8-neighborhoods are
-        regenerated."""
-        ncell = self.params["ncell"]
+        the cells overlapping the range plus their 3^dim-neighborhoods
+        are regenerated."""
         radius = self.params["radius"]
         counts = self._cell_counts()
         starts = np.zeros(len(counts) + 1, dtype=np.int64)
@@ -192,12 +217,7 @@ class StreamedGraph:
         # regenerate owned + neighbor cells once
         need = set()
         for cell in own_cells:
-            cx, cy = divmod(int(cell), ncell)
-            for dx in (-1, 0, 1):
-                for dy in (-1, 0, 1):
-                    nx, ny = cx + dx, cy + dy
-                    if 0 <= nx < ncell and 0 <= ny < ncell:
-                        need.add(nx * ncell + ny)
+            need.update(self._neighbor_cells(int(cell)))
         pts = {c: self._cell_points(c, int(counts[c])) for c in sorted(need)}
         r2 = radius * radius
         srcs, dsts = [], []
@@ -209,25 +229,19 @@ class StreamedGraph:
             a_sel = (a_ids >= v0) & (a_ids < v1)
             if not a_sel.any():
                 continue
-            cx, cy = divmod(int(cell), ncell)
-            for dx in (-1, 0, 1):
-                for dy in (-1, 0, 1):
-                    nx, ny = cx + dx, cy + dy
-                    if not (0 <= nx < ncell and 0 <= ny < ncell):
-                        continue
-                    b_cell = nx * ncell + ny
-                    b_pts = pts[b_cell]
-                    if len(b_pts) == 0:
-                        continue
-                    b_ids = starts[b_cell] + np.arange(
-                        len(b_pts), dtype=np.int64
-                    )
-                    d2 = ((a_pts[:, None, :] - b_pts[None, :, :]) ** 2).sum(-1)
-                    ii, jj = np.nonzero(d2 <= r2)
-                    keep = a_sel[ii] & (a_ids[ii] != b_ids[jj])
-                    if keep.any():
-                        srcs.append(a_ids[ii][keep])
-                        dsts.append(b_ids[jj][keep])
+            for b_cell in self._neighbor_cells(int(cell)):
+                b_pts = pts[b_cell]
+                if len(b_pts) == 0:
+                    continue
+                b_ids = starts[b_cell] + np.arange(
+                    len(b_pts), dtype=np.int64
+                )
+                d2 = ((a_pts[:, None, :] - b_pts[None, :, :]) ** 2).sum(-1)
+                ii, jj = np.nonzero(d2 <= r2)
+                keep = a_sel[ii] & (a_ids[ii] != b_ids[jj])
+                if keep.any():
+                    srcs.append(a_ids[ii][keep])
+                    dsts.append(b_ids[jj][keep])
         if not srcs:
             z = np.zeros(0, dtype=np.int64)
             return z, z
@@ -272,6 +286,7 @@ def streamed(spec: str, num_chunks: int = 8,
         RMAT_DEFAULT_ABC,
         parse_gen_spec,
         rgg2d_radius,
+        rgg3d_radius,
     )
 
     kind, kw = parse_gen_spec(spec)
@@ -297,10 +312,13 @@ def streamed(spec: str, num_chunks: int = 8,
     elif kind == "rgg2d":
         radius = rgg2d_radius(n, float(kw.pop("avg_degree", 8.0)))
         params = {"radius": radius, "ncell": max(1, int(1.0 / radius))}
+    elif kind == "rgg3d":
+        radius = rgg3d_radius(n, float(kw.pop("avg_degree", 8.0)))
+        params = {"radius": radius, "ncell": max(1, int(1.0 / radius))}
     else:
         raise ValueError(
             f"generator '{kind}' has no streaming form "
-            "(available: rmat, gnm, rgg2d)"
+            "(available: rmat, gnm, rgg2d, rgg3d)"
         )
     if kw:
         raise ValueError(f"unknown option(s) for {kind}: {sorted(kw)}")

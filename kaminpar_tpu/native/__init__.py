@@ -115,6 +115,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS,WRITEABLE"),
         i64, i64, f64, i64, i32, ctypes.c_uint64, i64,
     ]
+    lib.kmp_fm_refine_sparse.restype = i64
+    lib.kmp_fm_refine_sparse.argtypes = lib.kmp_fm_refine.argtypes
     # v2 codec (interval + streamvbyte-class residuals + varint weights)
     lib.kmp_encode_v2_size.restype = i64
     lib.kmp_encode_v2_size.argtypes = [i64, p_i64, p_i32, p_i64]
@@ -315,14 +317,20 @@ def ml_bipartition(graph, max_block_weights, ip_ctx, seed: int):
 
 
 def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
-              threads: int = 1):
+              threads: int = 1, force_sparse: bool = False):
     """Run the native localized batch FM on a HostGraph partition.
 
     Native counterpart of the reference's parallel localized FM scheme
     (see fm.cpp header); refines `partition` IN PLACE and returns the
     total cut improvement, or None when the native library is
     unavailable.  `threads` > 1 runs the reference-style worker pool
-    (NodeTracker claims + atomic gain table); 1 is bitwise-deterministic."""
+    (NodeTracker claims + atomic gain table); 1 is bitwise-deterministic.
+
+    Above the dense-table size limit the native side automatically
+    switches to the sparse compact-hashing gain cache
+    (compact_hashing_gain_cache.h:34 analog, O(m) memory), so FM stays
+    active at large k.  `force_sparse` exercises that path at any k
+    (tests)."""
     lib = get_lib()
     if lib is None or graph.n == 0 or k <= 1:
         return None
@@ -332,8 +340,9 @@ def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
     edge_w = np.ascontiguousarray(graph.edge_weight_array(), dtype=np.int64)
     max_bw = np.ascontiguousarray(max_block_weights, dtype=np.int64)
     assert partition.dtype == np.int32 and partition.flags.c_contiguous
+    fn = lib.kmp_fm_refine_sparse if force_sparse else lib.kmp_fm_refine
     return int(
-        lib.kmp_fm_refine(
+        fn(
             graph.n, xadj, adjncy, node_w, edge_w, int(k), max_bw,
             partition,
             int(fm_ctx.num_iterations), int(fm_ctx.num_seed_nodes),

@@ -52,6 +52,11 @@ from .segments import (
 # extra programs (mirrors ops/lp.DELTA_MIN_EDGE_SLOTS).
 DELTA_MIN_EDGE_SLOTS = 1 << 22
 
+# Largest dense (n_pad, k) conn table Jet will materialize (int32
+# entries; 2^28 = 1 GiB).  Above it jet_refine degrades to LP
+# refinement rounds (see entry point).
+JET_DENSE_MAX_ENTRIES = 1 << 28
+
 
 def _delta_slots(graph: DeviceGraph) -> int | None:
     m_slots = graph.src.shape[0]
@@ -561,6 +566,24 @@ def jet_refine(
 ) -> jax.Array:
     """Jet refinement entry point; picks coarse/fine temperatures by level
     (jet_refiner.cc:40-49: every level except the finest counts as coarse)."""
+    if graph.n_pad * k > JET_DENSE_MAX_ENTRIES:
+        # huge k: the dense (n, k) conn table Jet's incremental machinery
+        # rides would not fit HBM (16 GB at n=1M, k=4096).  Degrade to
+        # bulk-synchronous LP refinement rounds — the sort2 rating engine
+        # is k-independent and the afterburner keeps gains exact — so the
+        # strong preset completes at any k instead of OOMing (the
+        # reference's large-k configs likewise swap refiner strategy,
+        # gains/compact_hashing_gain_cache.h:34 lineage).
+        from .lp import LPConfig, lp_refine
+
+        cfg = LPConfig(
+            num_iterations=8,
+            participation=1.0,
+            allow_tie_moves=False,
+            use_active_set=True,
+            refinement=True,
+        )
+        return lp_refine(graph, partition, k, max_block_weights, seed, cfg)
     is_coarse = level > 0
     if is_coarse:
         rounds = ctx.num_rounds_on_coarse_level

@@ -170,20 +170,27 @@ def build_gather_plan(
     C = ceil_div(n_rows, S)
     idx = jnp.asarray(idx, dtype=jnp.int32)
     m = idx.shape[0]
+    if m:
+        lo, hi = int(jnp.min(idx)), int(jnp.max(idx))
+        if lo < 0 or hi >= table_len:
+            raise ValueError(
+                f"indices out of range [0, {table_len}): found "
+                f"[{lo}, {hi}]"
+            )
     key_s, pos_s, qloc_s = _sort_by_key(idx, S)
 
     # per-(chunk, lane) counts via boundary search on the sorted keys
     bounds = np.asarray(
         jnp.searchsorted(key_s, jnp.arange(C * L + 1, dtype=jnp.int32))
     )
-    if m and (int(bounds[0]) != 0 or int(bounds[-1]) != m):
-        raise ValueError(
-            f"indices out of range [0, {table_len}): the sorted key "
-            f"histogram covers [{int(bounds[0])}, {int(bounds[-1])}) of "
-            f"{m} entries"
-        )
     counts = (bounds[1:] - bounds[:-1]).reshape(C, L)
-    h_c = [round_up(max(int(counts[c].max()), 1), S) for c in range(C)]
+    # untouched chunks get NO region (no tile, no table-chunk stream)
+    h_c = [
+        0 if counts[c].max() == 0 else round_up(int(counts[c].max()), S)
+        for c in range(C)
+    ]
+    if sum(h_c) == 0:
+        h_c[0] = S  # degenerate m=0 plan: one all-pad tile
     region_off = np.concatenate([[0], np.cumsum(h_c)[:-1]]).astype(np.int32)
     chunk_start = bounds[: C * L : L].astype(np.int32)
     H = int(sum(h_c))
@@ -196,9 +203,9 @@ def build_gather_plan(
         jnp.asarray(region_off),
         H,
     )
-    tiles = []
+    tiles: list[int] = []
     for c in range(C):
-        tiles.extend([c] * (h_c[c] // S))
+        tiles.extend([c] * (h_c[c] // S))  # empty chunks contribute none
     return GatherPlan(
         q=q,
         tile_chunk=jnp.asarray(tiles, dtype=jnp.int32),

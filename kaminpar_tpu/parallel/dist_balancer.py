@@ -47,7 +47,7 @@ from ..ops.segments import (
     connection_to_label,
 )
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS, halo_exchange
+from .mesh import account_collective, NODE_AXIS, halo_exchange
 
 # Per-device candidate budget per round (the per-PE PQ size).  Small
 # enough that the gathered tuple set stays KBs; the round loop batches
@@ -214,6 +214,7 @@ def _dist_node_balance_impl(mesh, graph, partition, k, cap, seed, max_rounds):
             (jnp.int32(0), part_l0, ghost0, jnp.int32(1), jnp.array(True)),
         )
         # ONE O(n) gather at loop exit
+        account_collective("all_gather(partition)", part_l.size * 4)
         return lax.all_gather(part_l, NODE_AXIS, tiled=True)
 
     return _shard_map(

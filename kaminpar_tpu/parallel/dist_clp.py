@@ -50,7 +50,7 @@ from ..ops.segments import (
 )
 from .dist_coloring import dist_greedy_coloring
 from .dist_graph import DistGraph
-from .mesh import NODE_AXIS, halo_exchange, throttled_local_capacity
+from .mesh import account_collective, NODE_AXIS, halo_exchange, throttled_local_capacity
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "num_iterations"))
@@ -148,6 +148,7 @@ def _dist_clp_impl(
             0, num_iterations, iter_body, (part_l0, ghost0, bw0)
         )
         # ONE O(n) gather at loop exit
+        account_collective("all_gather(partition)", part_l.size * 4)
         return lax.all_gather(part_l, NODE_AXIS, tiled=True)
 
     return _shard_map(

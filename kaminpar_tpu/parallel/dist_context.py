@@ -49,6 +49,14 @@ class DistRefinementAlgorithm(str, enum.Enum):
     CLUSTER_BALANCER = "cluster-balancer"
 
 
+class DistInitialPartitioningAlgorithm(str, enum.Enum):
+    """kaminpar-dist factories.cc:72-88 initial partitioner dispatch."""
+
+    KAMINPAR = "kaminpar"
+    RANDOM = "random"
+    MTKAHYPAR = "mtkahypar"
+
+
 @dataclass
 class DistContext:
     """dKaMinPar configuration (include/kaminpar-dist/dkaminpar.h Context
@@ -68,6 +76,9 @@ class DistContext:
         ]
     )
     jet: JetRefinementContext = field(default_factory=JetRefinementContext)
+    initial_partitioning: DistInitialPartitioningAlgorithm = (
+        DistInitialPartitioningAlgorithm.KAMINPAR
+    )
     lp_num_iterations: int = 5
     clp_num_iterations: int = 5
     hem_rounds: int = 5

@@ -128,6 +128,12 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full):
         send_cu = to_buckets(seg_g, jnp.int32(-1))
         send_cv = to_buckets(key_g, jnp.int32(-1))
         send_w = to_buckets(w_g, jnp.zeros((), ACC_DTYPE))
+        from .mesh import account_collective
+
+        account_collective(
+            "all_to_all(contraction-edges)",
+            sum(b.size * b.dtype.itemsize for b in (send_cu, send_cv, send_w)),
+        )
         recv_cu = lax.all_to_all(send_cu, NODE_AXIS, 0, 0, tiled=True)
         recv_cv = lax.all_to_all(send_cv, NODE_AXIS, 0, 0, tiled=True)
         recv_w = lax.all_to_all(send_w, NODE_AXIS, 0, 0, tiled=True)

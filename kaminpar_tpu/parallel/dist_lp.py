@@ -240,9 +240,13 @@ def _dist_lp_round(
         )
         ghost_moved = None
 
-    delta = lax.psum(
-        move_weight_delta(labels_l, target_l, accept_l, nw_l, C), NODE_AXIS
+    from .mesh import account_collective
+
+    delta_l = move_weight_delta(labels_l, target_l, accept_l, nw_l, C)
+    account_collective(
+        "psum(weight-delta)", delta_l.size * delta_l.dtype.itemsize
     )
+    delta = lax.psum(delta_l, NODE_AXIS)
     new_weights = (weights.astype(ACC_DTYPE) + delta).astype(weights.dtype)
 
     # -- active set (label_propagation.h:507-513 analog) -----------------
@@ -255,6 +259,7 @@ def _dist_lp_round(
     else:
         new_active_l = jnp.ones_like(active_l)
 
+    account_collective("psum(convergence)", 4)
     num_wanting = lax.psum(jnp.sum(wants.astype(jnp.int32)), NODE_AXIS)
     return new_labels_l, new_ghost_lab, new_weights, new_active_l, num_wanting
 
@@ -312,6 +317,9 @@ def _dist_lp_loop(
         _, labels_l, _, _, _, _ = lax.while_loop(cond, body, init)
         # ONE O(n) gather at loop exit — the per-round collectives above
         # are all O(interface)
+        from .mesh import account_collective
+
+        account_collective("all_gather(labels)", labels_l.size * 4)
         return lax.all_gather(labels_l, NODE_AXIS, tiled=True)
 
     mapped = _shard_map(

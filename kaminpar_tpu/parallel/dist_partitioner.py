@@ -236,7 +236,10 @@ class dKaMinPar:
                     ),
                 )
                 lvl_seed = (ctx.seed * 7919 + len(levels) * 31337) & 0x7FFFFFFF
-                labels = clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
+                from .mesh import comm_phase
+
+                with comm_phase(f"coarsening-L{len(levels)}"):
+                    labels = clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
                 # singleton post-passes (two-hop + isolated packing) —
                 # the reference runs them wherever LP clusters
                 # (label_propagation.h:872-1191); without them low-degree
@@ -304,7 +307,7 @@ class dKaMinPar:
                 partition = None
                 best_cut = None
                 for r in range(num_replicas):
-                    cand = self._shm_ip(
+                    cand = self._initial_partition(
                         self._plain(current), ip_k, k, spans,
                         (self.ctx.seed * 31 + r * 7907) & 0x7FFFFFFF,
                     )
@@ -375,6 +378,42 @@ class dKaMinPar:
         return partition
 
     # -- deep-mode helpers -------------------------------------------------
+
+    def _initial_partition(self, host, ip_k, k, spans, seed) -> np.ndarray:
+        """Coarsest-graph initial partitioner dispatch (the
+        create_initial_partitioner seam, kaminpar-dist/factories.cc:72-88:
+        KAMINPAR / MTKAHYPAR / RANDOM)."""
+        from .dist_context import DistInitialPartitioningAlgorithm as Alg
+
+        algo = getattr(
+            self.ctx, "initial_partitioning", Alg.KAMINPAR
+        )
+        if algo == Alg.RANDOM:
+            # random_initial_partitioner.cc: uniform block per node; any
+            # imbalance is left to the balancers/refiners downstream
+            rng = np.random.RandomState(seed & 0x7FFFFFFF)
+            return rng.randint(0, ip_k, host.n).astype(np.int32)
+        if algo == Alg.MTKAHYPAR:
+            # mtkahypar_initial_partitioner.cc — gated on the external
+            # package exactly like the refinement adapter
+            from ..refinement.mtkahypar import (
+                mtkahypar_available,
+                mtkahypar_refine_host,
+            )
+
+            if not mtkahypar_available():
+                raise RuntimeError(
+                    "initial_partitioning=mtkahypar requires the external "
+                    "'mtkahypar' package (the analog of building the "
+                    "reference with KAMINPAR_BUILD_WITH_MTKAHYPAR)"
+                )
+            rng = np.random.RandomState(seed & 0x7FFFFFFF)
+            start = rng.randint(0, ip_k, host.n).astype(np.int32)
+            return mtkahypar_refine_host(
+                host, start, ip_k,
+                epsilon=self.ctx.partition.epsilon, seed=seed,
+            ).astype(np.int32)
+        return self._shm_ip(host, ip_k, k, spans, seed)
 
     def _shm_ip(self, host, ip_k, k, spans, seed) -> np.ndarray:
         """One seeded shm-KaMinPar run on a coarsest(-replica) graph with
@@ -511,9 +550,12 @@ class dKaMinPar:
             lvl_seed = (
                 ctx.seed * 7919 + (9601 + len(u_levels)) * 31337
             ) & 0x7FFFFFFF
-            labels = np.array(
-                clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
-            )
+            from .mesh import comm_phase
+
+            with comm_phase(f"replicated-coarsening-L{len(u_levels)}"):
+                labels = np.array(
+                    clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
+                )
             # singleton post-passes must not merge across replicas (the
             # isolated-node bins are global) — run them per component
             for g in range(G):
@@ -531,7 +573,12 @@ class dKaMinPar:
             cur_bounds = replica_bounds_after_contraction(cmap, cur_bounds)
             current = coarse
 
-        # --- per-replica IP (each subgroup's seeded shm run) ------------
+        # --- per-replica IP (each subgroup's seeded shm run).  Always the
+        # KAMINPAR algorithm here regardless of ctx.initial_partitioning:
+        # the union refinement that follows is positive-gain LP only (see
+        # below — balancers could cross replicas), so a balance-ignorant
+        # RANDOM start could never be repaired before the best-replica
+        # cut comparison, which requires comparably feasible candidates.
         union_part = np.zeros(current.n, dtype=np.int32)
         for g in range(G):
             lo, hi = cur_bounds[g], cur_bounds[g + 1]
@@ -627,12 +674,15 @@ class dKaMinPar:
         self, refiner, dg, fine_host, partition, current_k, spans, seed,
         level,
     ) -> np.ndarray:
+        from .mesh import comm_phase
+
         full = np.zeros(dg.n_pad, dtype=np.int32)
         full[: fine_host.n] = partition
-        refined = refiner(
-            dg, jnp.asarray(full), current_k, self._span_caps(spans),
-            seed, level=level,
-        )
+        with comm_phase(f"refinement-L{level}-k{current_k}"):
+            refined = refiner(
+                dg, jnp.asarray(full), current_k, self._span_caps(spans),
+                seed, level=level,
+            )
         return np.asarray(refined)[: fine_host.n]
 
     def _extend_on_mesh(self, fine_host: HostGraph, partition, spans):

@@ -96,10 +96,13 @@ class DeepMultilevelPartitioner:
         # --- coarsen (deep_multilevel.cc:69-183) ---
         coarsener = Coarsener(ctx, dgraph, graph.n)
         threshold = max(2 * ctx.coarsening.contraction_limit, 2)
+        from ..utils.heap_profiler import sample_device_memory
+
         with timer.scoped_timer("coarsening"):
             while coarsener.current_n > threshold:
                 if not coarsener.coarsen():
                     break
+                sample_device_memory()  # per-level live-HBM peak
                 log_progress(
                     f"deep coarsening level {coarsener.level}: "
                     f"n={coarsener.current_n}"
@@ -153,6 +156,7 @@ class DeepMultilevelPartitioner:
             )
             while not coarsener.empty():
                 fine_graph, partition = coarsener.uncoarsen(partition)
+                sample_device_memory()  # per-level live-HBM peak
                 level -= 1
                 partition, spans, current_k = self._extend_and_refine(
                     fine_graph,

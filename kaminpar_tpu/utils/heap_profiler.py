@@ -27,6 +27,7 @@ class HeapNode:
     name: str
     peak_host_bytes: int = 0
     peak_device_bytes: int = 0
+    live_device_bytes: int = 0
     count: int = 0
     children: Dict[str, "HeapNode"] = field(default_factory=dict)
 
@@ -80,6 +81,37 @@ def _device_peak_bytes() -> int:
     return 0
 
 
+def _live_device_bytes() -> int:
+    """Sum of all live device-buffer sizes right now, via jax.live_arrays().
+
+    Unlike the backend's lifetime high-water mark this is a *current*
+    figure, so per-phase peaks can be measured even after an earlier
+    phase set a larger process-wide peak — the number the compressed-mode
+    memory contract (TeraPart, arXiv 2410.19119) is stated in.  Only
+    persistent buffers are visible; intermediates inside a single jitted
+    program are not (XLA frees them before the launch returns)."""
+    try:
+        import jax
+
+        return sum(int(x.nbytes) for x in jax.live_arrays())
+    except Exception:
+        return 0
+
+
+def sample_device_memory() -> int:
+    """Record the current live-HBM figure into every OPEN scope.
+
+    Call at phase boundaries (between device launches); returns the
+    sampled byte count.  Scope entry/exit sample automatically, so this
+    is only needed to catch peaks in the middle of a long scope."""
+    if not _enabled:
+        return 0
+    live = _live_device_bytes()
+    for node in _stack[1:]:
+        node.live_device_bytes = max(node.live_device_bytes, live)
+    return live
+
+
 @contextmanager
 def scoped_heap_profiler(name: str):
     """SCOPED_HEAP_PROFILER analog.
@@ -97,6 +129,7 @@ def scoped_heap_profiler(name: str):
     _stack.append(node)
     cur0, peak0 = tracemalloc.get_traced_memory()
     dev_peak0 = _device_peak_bytes()
+    node.live_device_bytes = max(node.live_device_bytes, _live_device_bytes())
     try:
         yield
     finally:
@@ -105,6 +138,9 @@ def scoped_heap_profiler(name: str):
             node.peak_host_bytes = max(node.peak_host_bytes, peak1 - cur0)
         node.peak_device_bytes = max(
             node.peak_device_bytes, _device_peak_bytes() - dev_peak0
+        )
+        node.live_device_bytes = max(
+            node.live_device_bytes, _live_device_bytes()
         )
         node.count += 1
         _stack.pop()
@@ -138,6 +174,8 @@ def render() -> str:
                 if node.peak_device_bytes
                 else ""
             )
+            if node.live_device_bytes:
+                extra += f", live HBM {_fmt(node.live_device_bytes)}"
             lines.append(
                 f"{'  ' * depth}{node.name}: peak {_fmt(node.peak_host_bytes)}"
                 f"{extra}"

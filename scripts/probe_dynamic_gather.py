@@ -129,6 +129,53 @@ def bench_xla_baseline(log_m, log_n):
     )
 
 
+def bench_lane_gather(log_m, log_n):
+    """The real module: plan build + routed gather at the hot-op shape."""
+    sys.path.insert(0, "/root/repo")
+    from kaminpar_tpu.ops.lane_gather import build_gather_plan, lane_gather
+
+    M, N = 1 << log_m, 1 << log_n
+    rng = np.random.RandomState(3)
+    idx = jnp.asarray(rng.randint(0, N, M).astype(np.int32))
+    table = jnp.asarray(rng.randint(0, 1 << 30, N).astype(np.int32))
+    t0 = time.perf_counter()
+    plan = build_gather_plan(idx, N)
+    int(jnp.sum(plan.q.reshape(-1)[:1]))
+    plan_cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    plan = build_gather_plan(idx, N)
+    int(jnp.sum(plan.q.reshape(-1)[:1]))
+    plan_warm = time.perf_counter() - t0
+    out = lane_gather(table, plan)
+    got = np.asarray(out)
+    inv = np.asarray(plan.inv)
+    ok = inv >= 0
+    correct = bool(
+        np.array_equal(got[ok], np.asarray(table)[np.asarray(idx)[inv[ok]]])
+    )
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out = lane_gather(table, plan)
+        int(jnp.sum(out[:1]))
+        best = min(best, time.perf_counter() - t0)
+    print(
+        json.dumps(
+            {
+                "probe": f"lane_gather_module_M2^{log_m}_N2^{log_n}",
+                "correct": correct,
+                "ms": round(best * 1e3, 2),
+                "ns_per_index": round(best * 1e9 / M, 3),
+                "routed_slots": plan.num_slots,
+                "pad_overhead": round(plan.num_slots / M - 1, 3),
+                "plan_build_cold_s": round(plan_cold, 2),
+                "plan_build_warm_s": round(plan_warm, 3),
+            }
+        ),
+        flush=True,
+    )
+
+
 def main():
     on_cpu = jax.devices()[0].platform == "cpu"
     print(f"platform: {jax.devices()[0].platform}", flush=True)
@@ -144,6 +191,8 @@ def main():
     bench_xla_baseline(24, 20)
     for S in (512, 2048, 8192):
         bench(S, 24)
+    bench_lane_gather(24, 20)
+    bench_lane_gather(24, 22)
 
 
 if __name__ == "__main__":

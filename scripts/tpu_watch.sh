@@ -1,0 +1,15 @@
+#!/bin/bash
+# Retry the TPU probe until the tunnel grants a chip; log everything.
+LOG=/tmp/tpu_watch.log
+echo "=== watcher start $(date) ===" >> $LOG
+for i in $(seq 1 100); do
+  echo "--- attempt $i $(date) ---" >> $LOG
+  python /root/repo/scripts/probe_dynamic_gather.py >> $LOG 2>&1
+  rc=$?
+  echo "--- attempt $i exit $rc $(date) ---" >> $LOG
+  if [ $rc -eq 0 ] && grep -q ns_per_index $LOG; then
+    echo "=== SUCCESS $(date) ===" >> $LOG
+    exit 0
+  fi
+  sleep 120
+done

@@ -80,6 +80,28 @@ def test_plan_rejects_unaligned_table():
         build_gather_plan(jnp.zeros(4, jnp.int32), 100)
 
 
+def test_untouched_chunks_get_no_tiles():
+    # indices confined to one of 4 chunks: the plan must not stream the
+    # other 3 table chunks at all
+    rng = np.random.RandomState(5)
+    chunk_rows = 16
+    idx = (chunk_rows * L + rng.randint(0, chunk_rows * L, 200)).astype(
+        np.int32
+    )  # all in chunk 1
+    plan, _ = _check_plan(idx, 64 * L, chunk_rows=chunk_rows)
+    assert plan.C == 4
+    assert set(np.asarray(plan.tile_chunk).tolist()) == {1}
+
+
+def test_empty_plan_is_valid():
+    plan = build_gather_plan(jnp.zeros(0, jnp.int32), 1024)
+    rng = np.random.RandomState(0)
+    table = jnp.asarray(rng.randint(0, 100, 1024).astype(np.int32))
+    out = np.asarray(lane_gather(table, plan, interpret=True))
+    assert (np.asarray(plan.inv) == -1).all()
+    assert out.shape[0] == plan.num_slots
+
+
 def test_plan_rejects_out_of_range_indices():
     with pytest.raises(ValueError):
         build_gather_plan(jnp.array([-1, 5], jnp.int32), 1024)

@@ -322,6 +322,69 @@ def test_dist_presets_and_factories():
         assert ctx.shm is not None
 
 
+def test_dist_random_initial_partitioning():
+    """RANDOM dist IP variant (kaminpar-dist/factories.cc:72-88): the
+    coarsest graph gets uniform random blocks; balancers + refiners must
+    still deliver a feasible partition."""
+    from kaminpar_tpu.parallel import dKaMinPar, create_dist_context_by_preset_name
+    from kaminpar_tpu.parallel.dist_context import (
+        DistInitialPartitioningAlgorithm,
+    )
+
+    ctx = create_dist_context_by_preset_name("default")
+    ctx.initial_partitioning = DistInitialPartitioningAlgorithm.RANDOM
+    # force the leveled path (coarsen + per-level refinement): the full
+    # refiner list incl. balancers is what repairs the random start's
+    # imbalance, exactly as in the reference's dist deep pipeline
+    ctx.shm.coarsening.contraction_limit = 50
+    ctx.replication_min_nodes_per_device = 0
+    graph = make_grid_graph(32, 32)
+    k = 4
+    part = (
+        dKaMinPar(ctx, n_devices=4)
+        .set_graph(graph)
+        .compute_partition(k=k, epsilon=0.03, seed=1)
+    )
+    assert part.shape == (graph.n,)
+    nw = graph.node_weight_array()
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, part, nw)
+    assert bw.max() <= np.ceil(1.03 * nw.sum() / k) + 1
+    assert len(np.unique(part)) == k
+
+
+def test_comm_accounting_table():
+    """Collective accounting: a dist LP run inside a comm_phase scope
+    registers halo/psum traffic; the table renders per-phase lines."""
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.parallel import (
+        dist_graph_from_host,
+        dist_lp_cluster,
+        make_mesh,
+    )
+    from kaminpar_tpu.parallel.mesh import (
+        comm_phase,
+        comm_table,
+        reset_comm_log,
+    )
+
+    reset_comm_log()
+    mesh = make_mesh(4)
+    # unusual size so this call traces fresh (trace-time accounting sees
+    # nothing on a jit cache hit from an earlier test's identical shapes)
+    host = make_grid_graph(18, 18)
+    graph = dist_graph_from_host(host, mesh)
+    with comm_phase("test-lp"):
+        labels = dist_lp_cluster(graph, 16, seed=5)
+    assert labels.shape[0] >= host.n
+    table = comm_table()
+    assert "test-lp" in table
+    assert "all_to_all(halo)" in table
+    reset_comm_log()
+    assert "no collectives" in comm_table()
+
+
 def test_dkaminpar_strong_preset_end_to_end():
     from kaminpar_tpu.parallel import dKaMinPar
 
@@ -408,27 +471,10 @@ def test_dist_cluster_balancer_moves_whole_clusters_when_needed():
     assert bw.max() <= cap
 
 
-def test_snake_flatten_is_hamiltonian_path():
-    """Consecutive entries of the snake order are always grid neighbors
-    (the placement property that lets ring collectives ride ICI links,
-    grid_alltoall.h analog)."""
-    import numpy as np
-
-    from kaminpar_tpu.parallel.mesh import snake_flatten
-
-    for rows, cols in [(2, 4), (3, 3), (4, 2), (1, 5)]:
-        grid = np.arange(rows * cols).reshape(rows, cols)
-        pos = {int(v): (r, c) for r in range(rows) for c in range(cols)
-               for v in [grid[r, c]]}
-        flat = snake_flatten(grid)
-        assert sorted(flat.tolist()) == list(range(rows * cols))
-        for a, b in zip(flat[:-1], flat[1:]):
-            (r1, c1), (r2, c2) = pos[int(a)], pos[int(b)]
-            assert abs(r1 - r2) + abs(c1 - c2) == 1, (rows, cols, a, b)
-
-
 def test_torus_mesh_runs_dist_pipeline():
-    """make_torus_mesh is a drop-in 1D node axis for every dist kernel."""
+    """A true (2, 4) 2D mesh is a drop-in for every dist kernel: all
+    collectives name both axes and jax flattens them row-major (the
+    grid-alltoall analog, kaminpar-mpi/grid_alltoall.h:1-45)."""
     import numpy as np
 
     from kaminpar_tpu.graphs.factories import make_grid_graph
@@ -440,7 +486,7 @@ def test_torus_mesh_runs_dist_pipeline():
     )
 
     mesh = make_torus_mesh(2, 4)
-    assert mesh.devices.shape == (8,)
+    assert mesh.devices.shape == (2, 4)
     assert len({d.id for d in mesh.devices.flat}) == 8
     host = make_grid_graph(8, 8)
     graph = dist_graph_from_host(host, mesh)

@@ -15,6 +15,7 @@ SPECS = [
     "rmat;n=1024;m=8000;seed=3",
     "gnm;n=500;m=3000;seed=5",
     "rgg2d;n=800;avg_degree=8;seed=2",
+    "rgg3d;n=700;avg_degree=8;seed=4",
 ]
 
 
@@ -93,3 +94,30 @@ def test_partition_streamed_graph():
     part = p.set_graph(g).compute_partition(k=4, epsilon=0.03, seed=1)
     assert part.shape == (g.n,)
     assert set(np.unique(part)) <= set(range(4))
+
+
+def test_rgg3d_average_degree_in_range():
+    g = hostgraph_from_stream(
+        streamed("rgg3d;n=4000;avg_degree=8;seed=1", num_chunks=4)
+    )
+    avg = g.m / g.n  # HostGraph.m counts directed entries
+    assert 5 < avg < 11, avg  # ~8 expected; cube boundary thins it
+
+
+def test_delaunay_and_fe_grid_factories():
+    from kaminpar_tpu.graphs.factories import make_delaunay, make_fe_grid
+
+    d = make_delaunay(500, seed=3)
+    validate(d, undirected=True)
+    # planar triangulation: undirected edges (m/2) <= 3n - 6, avg deg > 4
+    assert d.m // 2 <= 3 * d.n - 6
+    assert d.m / d.n > 4
+
+    f = make_fe_grid(20, 30)
+    validate(f, undirected=True)
+    assert f.n == 600
+    # interior nodes of the triangulated grid have degree 6
+    degs = f.degrees()
+    assert degs.max() == 6
+    expected_undirected = (20 * 29) + (30 * 19) + (19 * 29)
+    assert f.m == 2 * expected_undirected

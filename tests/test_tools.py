@@ -77,6 +77,9 @@ def test_heap_profiler_and_statistics(capfd):
         assert rc == 0
         out = capfd.readouterr().out
         assert "partitioning: peak" in out
+        # live-HBM tracking (device-buffer peak via jax.live_arrays
+        # sampling at level boundaries) — works on every backend
+        assert "live HBM" in out
         assert "STATS" in out
         assert "cut_after_jet" in out  # default refiner is Jet
     finally:
