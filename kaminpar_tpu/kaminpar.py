@@ -109,7 +109,11 @@ class KaMinPar:
         if self._graph is None:
             raise RuntimeError("no graph set; call set_graph() first")
         from .graphs.compressed import CompressedHostGraph
+        from .ops.lane_gather import clear_plan_cache
 
+        # previous runs' routed-gather plans pin O(m) device memory and
+        # belong to freed graphs — drop them before building new levels
+        clear_plan_cache()
         graph = self._graph
         if isinstance(graph, CompressedHostGraph) and self._must_decode(
             graph

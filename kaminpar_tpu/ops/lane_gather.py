@@ -267,6 +267,110 @@ def lane_gather(table, plan: GatherPlan, interpret: bool = False):
     return out.reshape(H * L)
 
 
+# ---------------------------------------------------------------------------
+# Per-level edge-plan pack + cache
+# ---------------------------------------------------------------------------
+#
+# The hot consumers (LP rating, Jet conn build) gather labels at
+# graph.dst with co-data (src, edge_w) riding along.  One plan per graph
+# level serves every round of LP clustering, LP refinement, and Jet at
+# that level; the deep-multilevel driver revisits the same DeviceGraph
+# objects during uncoarsening, so plans are cached by the identity of
+# the level's dst array.
+
+# routed slots used by the current jit trace run in interpreter mode
+# when this is set (CPU tests of the integration)
+INTERPRET = False
+
+# plan building pays one m-wide sort + two m-wide scatters; below this
+# many edge slots the per-round XLA gather is cheap enough that the
+# plan never pays for itself (matches ops/lp.DELTA_MIN_EDGE_SLOTS).
+MIN_EDGE_SLOTS = 1 << 22
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class EdgePlans:
+    """Routed views of a level's static edge arrays."""
+
+    plan: GatherPlan
+    owner_key: jax.Array  # i32[H*128] src per routed slot (pad: n_pad-1)
+    src_idx: jax.Array    # i32[H*128] src clipped for label lookups
+    edge_w: jax.Array     # i32[H*128] edge weight per routed slot (pad: 0)
+
+    def tree_flatten(self):
+        return ((self.plan, self.owner_key, self.src_idx, self.edge_w), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, leaves):
+        del aux
+        return cls(*leaves)
+
+
+# key -> (dst_array, EdgePlans).  The dst array itself is stored and
+# identity-checked on every hit: holding the reference prevents Python
+# id recycling from ever matching a DIFFERENT topology's array, and the
+# `is` check makes the id-based key safe even across cache clears.
+# Entries pin device memory (O(m) per level), so the cache is small and
+# the partitioner clears it at every compute_partition entry.
+_PLAN_CACHE: dict = {}
+_PLAN_CACHE_MAX = 4
+
+
+def clear_plan_cache() -> None:
+    _PLAN_CACHE.clear()
+
+
+def edge_plans(graph) -> EdgePlans:
+    """The routed edge views of a DeviceGraph level (cached)."""
+    key = (id(graph.dst), graph.dst.shape[0], graph.n_pad)
+    hit = _PLAN_CACHE.get(key)
+    if hit is not None and hit[0] is graph.dst:
+        return hit[1]
+    plan = build_gather_plan(graph.dst, graph.n_pad)
+    n_pad = graph.n_pad
+    owner_key = route_codata(plan, graph.src, n_pad - 1)
+    pack = EdgePlans(
+        plan=plan,
+        owner_key=owner_key,
+        src_idx=jnp.clip(owner_key, 0, n_pad - 1),
+        edge_w=route_codata(plan, graph.edge_w, 0),
+    )
+    if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+    _PLAN_CACHE[key] = (graph.dst, pack)
+    return pack
+
+
+def routed_block_ratings(plans: EdgePlans, labels, k: int, n_pad: int):
+    """Dense (n_pad, k) rating table via the lane-routed block lookup —
+    the routed twin of segments.dense_block_ratings (segment_sum is
+    slot-order-agnostic; pad slots carry owner n_pad-1, weight 0)."""
+    from .segments import ACC_DTYPE
+
+    lab_c = jnp.clip(labels, 0, k - 1)
+    nb_r = lane_gather(lab_c, plans.plan, interpret=INTERPRET)
+    flat = plans.src_idx * k + jnp.clip(nb_r, 0, k - 1)
+    return jax.ops.segment_sum(
+        plans.edge_w.astype(ACC_DTYPE), flat, num_segments=n_pad * k
+    ).reshape(n_pad, k)
+
+
+def maybe_edge_plans(graph):
+    """EdgePlans for the level, or None when routing would not pay:
+    backend without the Mosaic kernel, small levels, or opted out via
+    KAMINPAR_TPU_LANE_GATHER=0."""
+    import os
+
+    if os.environ.get("KAMINPAR_TPU_LANE_GATHER", "") == "0":
+        return None
+    if graph.dst.shape[0] < MIN_EDGE_SLOTS:
+        return None
+    if not lane_gather_supported():
+        return None
+    return edge_plans(graph)
+
+
 @functools.lru_cache(maxsize=1)
 def lane_gather_supported() -> bool:
     """One-time probe: does this backend compile + correctly run the

@@ -153,6 +153,7 @@ def lp_round(
     cfg: LPConfig,
     communities: jax.Array | None = None,
     rows=None,
+    plans=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One bulk-synchronous LP round.
 
@@ -211,15 +212,35 @@ def lp_round(
         # flowing — the reads are n-wide gathers, essentially free.
         avg_degree = graph.m_pad / max(C, 1)
         K = cfg.topk if avg_degree <= 32 else max(cfg.topk, 16)
-        nb = jnp.where(valid, labels[dst_b], -1) if rows is not None else (
-            labels[dst_b]
-        )
-        own_slot = labels[owner_c]
-        topk = rating_topk_rows(owner_key, nb, w_b, end, deg_eff, salt, K)
-        labs = topk[0::2]
-        vals = topk[1::2]
-        own = labels
-        w_cur = connection_to_own_rows(nb, w_b, own_slot, start, end)
+        if plans is not None and rows is None:
+            from .lane_gather import INTERPRET, lane_gather
+
+            # lane-routed full round: labels[dst] via the Pallas
+            # dynamic_gather kernel (streaming speed) in the plan's slot
+            # order; the rating sort re-groups by owner anyway, and the
+            # own-connection rides sort1 as a 4th operand, so nothing
+            # ever returns to CSR order (ops/lane_gather.py rationale)
+            nb_r = lane_gather(labels, plans.plan, interpret=INTERPRET)
+            own_rt = labels[plans.src_idx]
+            w_own_r = jnp.where(nb_r == own_rt, plans.edge_w, 0)
+            topk, w_cur = rating_topk_rows(
+                plans.owner_key, nb_r, plans.edge_w,
+                graph.row_ptr[1:], graph.degrees, salt, K,
+                w_own=w_own_r,
+            )
+            labs = topk[0::2]
+            vals = topk[1::2]
+            own = labels
+        else:
+            nb = jnp.where(valid, labels[dst_b], -1) if rows is not None else (
+                labels[dst_b]
+            )
+            own_slot = labels[owner_c]
+            topk = rating_topk_rows(owner_key, nb, w_b, end, deg_eff, salt, K)
+            labs = topk[0::2]
+            vals = topk[1::2]
+            own = labels
+            w_cur = connection_to_own_rows(nb, w_b, own_slot, start, end)
 
         def fits(lab):
             lab_c = jnp.clip(lab, 0, C - 1)
@@ -242,7 +263,12 @@ def lp_round(
             best = jnp.where(ok, lab_j, best)
             best_w = jnp.where(ok, val_j, best_w)
     elif engine == "dense":
-        conn = dense_block_ratings(owner_c, dst_b, w_b, labels, n_pad, C)
+        if plans is not None and rows is None:
+            from .lane_gather import routed_block_ratings
+
+            conn = routed_block_ratings(plans, labels, C, n_pad)
+        else:
+            conn = dense_block_ratings(owner_c, dst_b, w_b, labels, n_pad, C)
         best, best_w, w_cur = best_from_dense(
             conn, labels, cluster_weights, graph.node_w, cap, salt,
             communities=communities,
@@ -401,6 +427,8 @@ def _round_with_delta(
     cfg: LPConfig,
     communities: jax.Array | None,
     i: jax.Array,
+
+    plans=None,
 ):
     """One LP round, delta-dispatched: after the first round, when the
     active nodes' rows fit the m_pad/4 buffer, run the round on the
@@ -415,7 +443,7 @@ def _round_with_delta(
     if dslots is None:
         return lp_round(
             graph, labels, weights, max_cluster_weight, active, salt, cfg,
-            communities=communities,
+            communities=communities, plans=plans,
         )
     deg = graph.degrees
 
@@ -431,7 +459,7 @@ def _round_with_delta(
         labels, weights, active = op
         return lp_round(
             graph, labels, weights, max_cluster_weight, active, salt, cfg,
-            communities=communities,
+            communities=communities, plans=plans,
         )
 
     total = jnp.sum(jnp.where(active & (deg > 0), deg, 0), dtype=jnp.int32)
@@ -448,11 +476,12 @@ def _lp_cluster_impl(
     cfg: LPConfig,
     num_iterations: int | None,
     has_communities: bool,
+    plans=None,
 ) -> jax.Array:
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     comm = communities if has_communities else None
     labels, weights = _lp_cluster_fused_rounds(
-        graph, max_cluster_weight, seed, comm, cfg, iters
+        graph, max_cluster_weight, seed, comm, cfg, iters, plans
     )
     return _lp_cluster_postpasses_traced(
         graph, labels, weights, max_cluster_weight, seed, cfg,
@@ -492,6 +521,7 @@ def _lp_cluster_chunked(
     cfg: LPConfig,
     iters: int,
     has_communities: bool,
+    plans=None,
 ) -> jax.Array:
     """One clustering round per launch — the TPU-worker watchdog guard
     above the fused budget (a multi-round fused clustering loop at
@@ -511,7 +541,7 @@ def _lp_cluster_chunked(
         salt = (jnp.asarray(seed, jnp.int32) * 131071 + off) & 0x7FFFFFFF
         labels, weights, active, moved = _lp_cluster_round_launch(
             graph, labels, weights, max_cluster_weight, active,
-            salt, jnp.int32(i), cfg, comm,
+            salt, jnp.int32(i), cfg, comm, plans,
         )
         if int(moved) == 0:
             break
@@ -524,29 +554,30 @@ def _lp_cluster_chunked(
 @partial(jax.jit, static_argnames=("cfg", "has_comm"))
 def _lp_cluster_round_launch_jit(
     graph, labels, weights, max_cluster_weight, active, salt, i,
-    cfg: LPConfig, communities, has_comm: bool,
+    cfg: LPConfig, communities, has_comm: bool, plans=None,
 ):
     return _round_with_delta(
         graph, labels, weights, max_cluster_weight, active, salt, cfg,
-        communities if has_comm else None, i,
+        communities if has_comm else None, i, plans=plans,
     )
 
 
 def _lp_cluster_round_launch(
     graph, labels, weights, max_cluster_weight, active, salt, i,
-    cfg: LPConfig, comm,
+    cfg: LPConfig, comm, plans=None,
 ):
     has_comm = comm is not None
     # the dummy is a 1-element array (never read when has_comm is False)
     return _lp_cluster_round_launch_jit(
         graph, labels, weights, max_cluster_weight, active, salt, i, cfg,
         comm if has_comm else jnp.zeros(1, dtype=jnp.int32),
-        has_comm,
+        has_comm, plans,
     )
 
 
 def _lp_cluster_fused_rounds(
-    graph, max_cluster_weight, seed, comm, cfg: LPConfig, iters: int
+    graph, max_cluster_weight, seed, comm, cfg: LPConfig, iters: int,
+    plans=None,
 ):
     """The fused multi-round clustering loop (one launch)."""
     n_pad = graph.n_pad
@@ -563,7 +594,7 @@ def _lp_cluster_fused_rounds(
         salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
         labels, weights, active, moved = _round_with_delta(
             graph, labels, weights, max_cluster_weight, active, salt,
-            cfg, comm, i,
+            cfg, comm, i, plans=plans,
         )
         return (i + 1, labels, weights, active, moved)
 
@@ -591,18 +622,24 @@ def lp_cluster(
 
     Returns i32[n_pad] cluster labels (values are node ids; pad slots keep
     their own id)."""
+    from .lane_gather import maybe_edge_plans
     from .segments import MAX_FUSED_EDGE_SLOTS
 
     has_comm = communities is not None
     iters = (
         num_iterations if num_iterations is not None else cfg.num_iterations
     )
+    # plan building does host readbacks, so it happens HERE (eagerly,
+    # outside jit) and the plan rides into the traced rounds as an
+    # ordinary pytree argument — NEVER as a captured constant, which the
+    # shape-bucketed jit cache would wrongly share across levels
+    plans = maybe_edge_plans(graph)
     if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
         # watchdog guard: the dispatch must stay OUTSIDE jit — the
         # chunked loop reads the convergence flag back per round
         return _lp_cluster_chunked(
             graph, max_cluster_weight, seed, communities, cfg, iters,
-            has_comm,
+            has_comm, plans,
         )
     if communities is None:
         communities = jnp.zeros(graph.n_pad, dtype=jnp.int32)
@@ -614,14 +651,16 @@ def lp_cluster(
         cfg,
         num_iterations,
         has_comm,
+        plans,
     )
 
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _lp_refine_round_launch(graph, part, bw, max_block_weights, active,
-                            salt, i, cfg: LPConfig):
+                            salt, i, cfg: LPConfig, plans=None):
     return _round_with_delta(
-        graph, part, bw, max_block_weights, active, salt, cfg, None, i
+        graph, part, bw, max_block_weights, active, salt, cfg, None, i,
+        plans=plans,
     )
 
 
@@ -641,12 +680,15 @@ def lp_refine(
     active set and moved==0 convergence exit across launches."""
     from .segments import MAX_FUSED_EDGE_SLOTS
 
+    from .lane_gather import maybe_edge_plans
+
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     if not cfg.refinement:
         # normalize once for BOTH launch strategies so the chunked path
         # never runs with clustering semantics (tie moves, no positive-gain
         # restriction); replace() preserves the caller's engine settings
         cfg = replace(cfg, allow_tie_moves=False, refinement=True)
+    plans = maybe_edge_plans(graph)  # eager: host readbacks (see lp_cluster)
     if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
         part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
         bw = jax.ops.segment_sum(
@@ -662,13 +704,13 @@ def lp_refine(
             salt = (jnp.asarray(seed, jnp.int32) * 92821 + off) & 0x7FFFFFFF
             part, bw, active, moved = _lp_refine_round_launch(
                 graph, part, bw, max_block_weights, active, salt,
-                jnp.int32(i), cfg
+                jnp.int32(i), cfg, plans
             )
             if int(moved) == 0:
                 break
         return part
     return _lp_refine_fused(
-        graph, partition, k, max_block_weights, seed, cfg, iters
+        graph, partition, k, max_block_weights, seed, cfg, iters, plans
     )
 
 
@@ -681,6 +723,7 @@ def _lp_refine_fused(
     seed: jax.Array,
     cfg: LPConfig = LPConfig(refinement=True),
     num_iterations: int | None = None,
+    plans=None,
 ) -> jax.Array:
     """LP refinement (analog of LabelPropagationRefiner,
     kaminpar-shm/refinement/lp/lp_refiner.cc): the LP kernel with clusters
@@ -703,7 +746,8 @@ def _lp_refine_fused(
         i, part, bw, active, _ = state
         salt = (seed.astype(jnp.int32) * 92821 + i * 1566083941) & 0x7FFFFFFF
         part, bw, active, moved = _round_with_delta(
-            graph, part, bw, max_block_weights, active, salt, cfg, None, i
+            graph, part, bw, max_block_weights, active, salt, cfg, None, i,
+            plans=plans,
         )
         return (i + 1, part, bw, active, moved)
 

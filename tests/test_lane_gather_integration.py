@@ -1,0 +1,99 @@
+"""Lane-routed rating integration: LP/Jet results must be BITWISE
+identical to the unrouted engines.
+
+The routed paths change only the ORDER in which (owner, label, weight)
+triples reach the rating reductions; every reduction involved (sort by
+owner+label, integer group totals, segment_sum, cumsum-diff spans) is
+order-independent, so routing must not change a single label.  On CPU
+the Pallas kernel runs in interpreter mode.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import kaminpar_tpu.ops.lane_gather as lg
+from kaminpar_tpu.context import JetRefinementContext
+from kaminpar_tpu.graphs import device_graph_from_host, factories
+from kaminpar_tpu.ops import metrics
+from kaminpar_tpu.ops.jet import jet_refine
+from kaminpar_tpu.ops.lp import LPConfig, lp_cluster, lp_refine
+
+
+@pytest.fixture
+def routed(monkeypatch):
+    monkeypatch.setattr(lg, "INTERPRET", True)
+    monkeypatch.setattr(lg, "MIN_EDGE_SLOTS", 0)
+    monkeypatch.setattr(lg, "lane_gather_supported", lambda: True)
+    lg.clear_plan_cache()
+    yield
+    lg.clear_plan_cache()
+
+
+def _graph():
+    return device_graph_from_host(factories.make_rmat(1 << 10, 8000, seed=5))
+
+
+def test_lp_cluster_routed_is_bitwise_identical(routed):
+    dg = _graph()
+    routed_labels = np.asarray(lp_cluster(dg, jnp.int32(64), jnp.int32(3)))
+    lg.clear_plan_cache()
+    import os
+
+    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
+    try:
+        plain_labels = np.asarray(
+            lp_cluster(dg, jnp.int32(64), jnp.int32(3))
+        )
+    finally:
+        del os.environ["KAMINPAR_TPU_LANE_GATHER"]
+    np.testing.assert_array_equal(routed_labels, plain_labels)
+
+
+def test_lp_refine_routed_is_bitwise_identical(routed):
+    dg = _graph()
+    k = 8
+    rng = np.random.default_rng(0)
+    part = np.zeros(dg.n_pad, np.int32)
+    part[: dg.n] = rng.integers(0, k, dg.n)
+    part = jnp.asarray(part)
+    nw = int(np.asarray(dg.node_w).sum())
+    cap = jnp.full(k, int(1.1 * nw / k) + 1, dtype=jnp.int32)
+    cfg = LPConfig(num_iterations=3, refinement=True, allow_tie_moves=False)
+
+    out_r = np.asarray(lp_refine(dg, part, k, cap, jnp.int32(2), cfg))
+    lg.clear_plan_cache()
+    import os
+
+    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
+    try:
+        out_p = np.asarray(lp_refine(dg, part, k, cap, jnp.int32(2), cfg))
+    finally:
+        del os.environ["KAMINPAR_TPU_LANE_GATHER"]
+    np.testing.assert_array_equal(out_r, out_p)
+
+
+def test_jet_routed_is_bitwise_identical(routed):
+    dg = _graph()
+    k = 8
+    rng = np.random.default_rng(1)
+    part = np.zeros(dg.n_pad, np.int32)
+    part[: dg.n] = rng.integers(0, k, dg.n)
+    part = jnp.asarray(part)
+    nw = int(np.asarray(dg.node_w).sum())
+    cap = jnp.full(k, int(1.2 * nw / k) + 1, dtype=jnp.int32)
+    ctx = JetRefinementContext()
+
+    out_r = np.asarray(jet_refine(dg, part, k, cap, jnp.int32(4), ctx))
+    lg.clear_plan_cache()
+    import os
+
+    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
+    try:
+        out_p = np.asarray(jet_refine(dg, part, k, cap, jnp.int32(4), ctx))
+    finally:
+        del os.environ["KAMINPAR_TPU_LANE_GATHER"]
+    np.testing.assert_array_equal(out_r, out_p)
+    assert int(metrics.edge_cut(dg, jnp.asarray(out_r))) <= int(
+        metrics.edge_cut(dg, part)
+    )
