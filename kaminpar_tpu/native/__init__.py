@@ -14,6 +14,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import platform
 import subprocess
 import tempfile
 from typing import Optional
@@ -54,7 +55,12 @@ def _build() -> Optional[str]:
         ) as tmp:
             tmp_path = tmp.name
         subprocess.run(
+            # -mssse3 (x86 only): the StreamVByte-class SIMD residual
+            # decode in codec2.cpp (guarded by __SSSE3__, scalar on
+            # other architectures)
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++20", "-pthread",
+             *(["-mssse3"] if platform.machine() in
+               ("x86_64", "AMD64", "i686") else []),
              *_SRCS, "-o", tmp_path],
             check=True,
             capture_output=True,

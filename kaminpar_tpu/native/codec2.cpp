@@ -27,7 +27,40 @@
 #include <cstdint>
 #include <cstring>
 
+#if defined(__SSSE3__)
+#include <tmmintrin.h>
+#endif
+
 namespace {
+
+#if defined(__SSSE3__)
+// StreamVByte SIMD decode tables (streamvbyte.h parity): for each
+// control byte, a pshufb mask scattering the packed 1..4-byte values
+// into four u32 lanes, and the group's total payload length.
+struct SvbTables {
+  alignas(16) uint8_t shuf[256][16];
+  uint8_t len[256];
+};
+
+inline const SvbTables& svb_tables() {
+  static const SvbTables t = [] {
+    SvbTables t{};
+    for (int c = 0; c < 256; ++c) {
+      int pos = 0;
+      for (int i = 0; i < 4; ++i) {
+        const int l = ((c >> (2 * i)) & 3) + 1;
+        for (int b = 0; b < 4; ++b)
+          t.shuf[c][4 * i + b] =
+              b < l ? (uint8_t)(pos + b) : (uint8_t)0xFF;
+        pos += l;
+      }
+      t.len[c] = (uint8_t)pos;
+    }
+    return t;
+  }();
+  return t;
+}
+#endif
 
 constexpr int64_t MIN_INTERVAL = 3;  // compressed_neighborhoods interval
                                      // length threshold
@@ -216,7 +249,50 @@ void kmp_decode_v2(int64_t n, const int64_t* xadj, const int64_t* offsets,
     const uint8_t* ctrl = p;
     const uint8_t* d = p + (n_res + 3) / 4;
     uint32_t prev = 0;
-    for (int64_t i = 0; i < n_res; ++i) {
+    int64_t i = 0;
+#if defined(__SSSE3__)
+    if (n_res >= 8) {
+      const SvbTables& T = svb_tables();
+      // exact payload size from the control stream bounds the 16-byte
+      // loads.  The final PARTIAL group must be summed field-by-field:
+      // its unused 2-bit controls are zero, which T.len would count as
+      // 1 byte each — overshooting the true buffer end by up to 3
+      // bytes and letting the last SIMD load read past the allocation.
+      const int64_t nfull = n_res / 4;
+      int64_t payload = 0;
+      for (int64_t g = 0; g < nfull; ++g) payload += T.len[ctrl[g]];
+      for (int64_t r = 4 * nfull; r < n_res; ++r)
+        payload += ((ctrl[r >> 2] >> (2 * (r & 3))) & 3) + 1;
+      const uint8_t* d_end = d + payload;
+      // group 0 scalar: the first-residual bias lives there
+      for (; i < 4; ++i) {
+        const int len = ((ctrl[0] >> (2 * i)) & 3) + 1;
+        uint32_t v = 0;
+        for (int b = 0; b < len; ++b) v |= (uint32_t)(*d++) << (8 * b);
+        prev = (i == 0) ? v - 1 : prev + v;
+        *o++ = (int32_t)prev;
+      }
+      // full groups: one pshufb + two shifted adds (in-register prefix
+      // sum of the gaps) per 4 values — the streamvbyte.h decode shape
+      __m128i vprev = _mm_set1_epi32((int)prev);
+      while (i + 4 <= n_res && d + 16 <= d_end) {
+        const uint8_t c = ctrl[i >> 2];
+        const __m128i raw = _mm_loadu_si128((const __m128i*)d);
+        __m128i gaps = _mm_shuffle_epi8(
+            raw, _mm_load_si128((const __m128i*)T.shuf[c]));
+        gaps = _mm_add_epi32(gaps, _mm_slli_si128(gaps, 4));
+        gaps = _mm_add_epi32(gaps, _mm_slli_si128(gaps, 8));
+        const __m128i vals = _mm_add_epi32(gaps, vprev);
+        _mm_storeu_si128((__m128i*)o, vals);
+        o += 4;
+        vprev = _mm_shuffle_epi32(vals, _MM_SHUFFLE(3, 3, 3, 3));
+        d += T.len[c];
+        i += 4;
+      }
+      prev = (uint32_t)_mm_cvtsi128_si32(vprev);
+    }
+#endif
+    for (; i < n_res; ++i) {
       const int len = ((ctrl[i >> 2] >> (2 * (i & 3))) & 3) + 1;
       uint32_t v = 0;
       for (int b = 0; b < len; ++b) v |= (uint32_t)(*d++) << (8 * b);

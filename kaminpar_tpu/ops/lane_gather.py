@@ -373,11 +373,15 @@ def maybe_edge_plans(graph):
 
 @functools.lru_cache(maxsize=1)
 def lane_gather_supported() -> bool:
-    """One-time probe: does this backend compile + correctly run the
-    dynamic_gather kernel on a multi-vreg (cross-sublane) table?"""
+    """One-time probe: the backend must compile the dynamic_gather
+    kernel, produce correct results on a multi-vreg (cross-sublane)
+    table, AND actually beat the XLA gather at a representative shape —
+    a lowering that emulates the gather slowly would silently regress
+    every routed round otherwise."""
     try:
         if jax.devices()[0].platform not in ("tpu", "axon"):
             return False
+        # correctness at a small cross-sublane shape
         n = 16 * L
         rng = np.random.RandomState(0)
         idx = rng.randint(0, n, 4096).astype(np.int32)
@@ -386,6 +390,35 @@ def lane_gather_supported() -> bool:
         got = np.asarray(lane_gather(jnp.asarray(table), plan))
         inv = np.asarray(plan.inv)
         ok = inv >= 0
-        return bool(np.array_equal(got[ok], table[idx[inv[ok]]]))
+        if not np.array_equal(got[ok], table[idx[inv[ok]]]):
+            return False
+        # speed: routed gather must beat the XLA gather at 4M indices
+        # from a 2^19-entry table (a mid-size level's shape)
+        import time
+
+        m_probe, n_probe = 1 << 22, 1 << 19
+        idx2 = jnp.asarray(
+            np.random.RandomState(1).randint(0, n_probe, m_probe), jnp.int32
+        )
+        tab2 = jnp.asarray(
+            np.random.RandomState(2).randint(0, 1 << 30, n_probe), jnp.int32
+        )
+        plan2 = build_gather_plan(idx2, n_probe)
+        xla = jax.jit(lambda t, i: t[i])
+
+        def _time(fn, *args):
+            out = fn(*args)
+            int(jnp.sum(out[:1]))  # force completion (axon-safe readback)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = fn(*args)
+                int(jnp.sum(out[:1]))
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t_routed = _time(lambda t: lane_gather(t, plan2), tab2)
+        t_xla = _time(xla, tab2, idx2)
+        return t_routed < t_xla
     except Exception:  # pragma: no cover - backend specific
         return False

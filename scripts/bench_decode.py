@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Measure v2-codec decode bandwidth (the streamvbyte.h parity probe).
+
+The reference ships an SSSE3 StreamVByte batch decoder
+(kaminpar-common/graph_compression/streamvbyte.h); codec2.cpp now takes
+the same shuffle-table SIMD path for residual groups.  This records
+decode throughput in edges/s and output GB/s on the 10M-edge bench
+graph (run solo — the box has one core).
+
+Usage: python scripts/bench_decode.py [log2_n] [m]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from kaminpar_tpu import native
+    from kaminpar_tpu.graphs.factories import make_rmat
+
+    if not native.available():
+        raise SystemExit("native library unavailable")
+    log_n = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    m = int(sys.argv[2]) if len(sys.argv) > 2 else 10_000_000
+    g = make_rmat(1 << log_n, m, seed=7)
+    xadj = np.ascontiguousarray(g.xadj, dtype=np.int64)
+    adjncy = np.ascontiguousarray(g.adjncy, dtype=np.int32)
+
+    enc = native.encode_v2(xadj, adjncy)
+    data, offsets = enc
+    out = np.empty(len(adjncy), dtype=np.int32)
+    lib = native.get_lib()
+    n = len(xadj) - 1
+
+    lib.kmp_decode_v2(n, xadj, offsets, data, out)  # warm
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        lib.kmp_decode_v2(n, xadj, offsets, data, out)
+        best = min(best, time.perf_counter() - t0)
+
+    # decoded output must round-trip (interval-members-first emit order:
+    # compare as per-row sorted sets)
+    ok = True
+    for u in (0, 1, n // 2, n - 1):
+        lo, hi = int(xadj[u]), int(xadj[u + 1])
+        ok &= sorted(out[lo:hi].tolist()) == sorted(adjncy[lo:hi].tolist())
+
+    edges = len(adjncy)
+    print(
+        json.dumps(
+            {
+                "probe": "v2_decode",
+                "edges": edges,
+                "compressed_bytes": int(len(data)),
+                "ratio": round(edges * 4 / len(data), 2),
+                "decode_s": round(best, 3),
+                "edges_per_s_M": round(edges / best / 1e6, 1),
+                "out_GB_s": round(edges * 4 / best / 1e9, 2),
+                "roundtrip_ok": bool(ok),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

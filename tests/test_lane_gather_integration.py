@@ -20,8 +20,29 @@ from kaminpar_tpu.ops.jet import jet_refine
 from kaminpar_tpu.ops.lp import LPConfig, lp_cluster, lp_refine
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def unrouted():
+    """Force the plain path for the comparison run (saves/restores any
+    pre-set opt-out so the fixture's routed runs stay routed)."""
+    import os
+
+    prev = os.environ.get("KAMINPAR_TPU_LANE_GATHER")
+    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
+    try:
+        yield
+    finally:
+        if prev is None:
+            del os.environ["KAMINPAR_TPU_LANE_GATHER"]
+        else:
+            os.environ["KAMINPAR_TPU_LANE_GATHER"] = prev
+
+
 @pytest.fixture
 def routed(monkeypatch):
+    monkeypatch.delenv("KAMINPAR_TPU_LANE_GATHER", raising=False)
     monkeypatch.setattr(lg, "INTERPRET", True)
     monkeypatch.setattr(lg, "MIN_EDGE_SLOTS", 0)
     monkeypatch.setattr(lg, "lane_gather_supported", lambda: True)
@@ -38,15 +59,10 @@ def test_lp_cluster_routed_is_bitwise_identical(routed):
     dg = _graph()
     routed_labels = np.asarray(lp_cluster(dg, jnp.int32(64), jnp.int32(3)))
     lg.clear_plan_cache()
-    import os
-
-    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
-    try:
+    with unrouted():
         plain_labels = np.asarray(
             lp_cluster(dg, jnp.int32(64), jnp.int32(3))
         )
-    finally:
-        del os.environ["KAMINPAR_TPU_LANE_GATHER"]
     np.testing.assert_array_equal(routed_labels, plain_labels)
 
 
@@ -63,13 +79,8 @@ def test_lp_refine_routed_is_bitwise_identical(routed):
 
     out_r = np.asarray(lp_refine(dg, part, k, cap, jnp.int32(2), cfg))
     lg.clear_plan_cache()
-    import os
-
-    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
-    try:
+    with unrouted():
         out_p = np.asarray(lp_refine(dg, part, k, cap, jnp.int32(2), cfg))
-    finally:
-        del os.environ["KAMINPAR_TPU_LANE_GATHER"]
     np.testing.assert_array_equal(out_r, out_p)
 
 
@@ -86,14 +97,31 @@ def test_jet_routed_is_bitwise_identical(routed):
 
     out_r = np.asarray(jet_refine(dg, part, k, cap, jnp.int32(4), ctx))
     lg.clear_plan_cache()
-    import os
-
-    os.environ["KAMINPAR_TPU_LANE_GATHER"] = "0"
-    try:
+    with unrouted():
         out_p = np.asarray(jet_refine(dg, part, k, cap, jnp.int32(4), ctx))
-    finally:
-        del os.environ["KAMINPAR_TPU_LANE_GATHER"]
     np.testing.assert_array_equal(out_r, out_p)
     assert int(metrics.edge_cut(dg, jnp.asarray(out_r))) <= int(
         metrics.edge_cut(dg, part)
     )
+
+
+def test_contraction_routed_is_bitwise_identical(routed):
+    from kaminpar_tpu.ops.contraction import contract_clustering
+    from kaminpar_tpu.ops.lp import lp_cluster
+
+    dg = _graph()
+    labels = lp_cluster(dg, jnp.int32(64), jnp.int32(9))
+    cg_r, n_r, m_r = contract_clustering(dg, labels)
+    lg.clear_plan_cache()
+    with unrouted():
+        cg_p, n_p, m_p = contract_clustering(dg, labels)
+    assert (n_r, m_r) == (n_p, m_p)
+    np.testing.assert_array_equal(
+        np.asarray(cg_r.cmap), np.asarray(cg_p.cmap)
+    )
+    for field in ("row_ptr", "src", "dst", "edge_w", "node_w"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(cg_r.graph, field)),
+            np.asarray(getattr(cg_p.graph, field)),
+            err_msg=field,
+        )
