@@ -346,7 +346,11 @@ constexpr int64_t kWeightMask = ((int64_t)1 << kTagShift) - 1;
 inline int64_t pack(int32_t block, int64_t w) {
   return ((int64_t)(block + 1) << kTagShift) | w;
 }
-inline int32_t tag_of(int64_t e) { return (int32_t)(e >> kTagShift) - 1; }
+// unsigned shift: block+1 can reach bit 63's neighborhood at large k
+// and an arithmetic shift would sign-extend into a wrong (negative) tag
+inline int32_t tag_of(int64_t e) {
+  return (int32_t)((uint64_t)e >> kTagShift) - 1;
+}
 inline int64_t weight_of(int64_t e) { return e & kWeightMask; }
 
 inline uint64_t hash_block(int32_t b) {
@@ -712,6 +716,8 @@ int64_t refine(int64_t n, const int64_t* xadj, const int32_t* adjncy,
                int64_t num_iterations, int64_t num_seed_nodes,
                double alpha, int64_t num_fruitless_moves,
                int32_t use_adaptive, uint64_t seed) {
+  // the packed tag field holds block+1 in 16 bits
+  if (k + 1 >= ((int64_t)1 << 16)) return 0;
   SparseCtx c{n, k, xadj, adjncy, node_w, edge_w, max_bw, part,
               {}, {}, {}, {}};
   Rng rng(seed);

@@ -473,24 +473,6 @@ def best_from_dense(
     columns whose community differs from the row node's; `allowed`
     (bool[k]) masks whole columns (balancer target restrictions)."""
     n_pad, k = conn.shape
-    if communities is None:
-        from .pallas_kernels import (
-            best_from_dense_pallas,
-            eligible,
-            pallas_available,
-        )
-
-        if eligible(n_pad, k) and pallas_available():
-            return best_from_dense_pallas(
-                conn,
-                labels,
-                cluster_weights,
-                node_w,
-                cap,
-                salt,
-                require_fit=require_fit,
-                allowed=allowed,
-            )
     lab_col = jnp.clip(labels, 0, k - 1)
     w_own = jnp.take_along_axis(conn, lab_col[:, None], axis=1)[:, 0]
     cols = jnp.arange(k, dtype=jnp.int32)

@@ -4,12 +4,15 @@ LOG=/tmp/tpu_watch.log
 echo "=== watcher start $(date) ===" >> $LOG
 for i in $(seq 1 100); do
   echo "--- attempt $i $(date) ---" >> $LOG
-  python /root/repo/scripts/probe_dynamic_gather.py >> $LOG 2>&1
+  ATT=$(mktemp)
+  python /root/repo/scripts/probe_dynamic_gather.py > $ATT 2>&1
   rc=$?
+  cat $ATT >> $LOG
   echo "--- attempt $i exit $rc $(date) ---" >> $LOG
-  if [ $rc -eq 0 ] && grep -q ns_per_index $LOG; then
+  if [ $rc -eq 0 ] && grep -q ns_per_index $ATT; then rm -f $ATT;
     echo "=== SUCCESS $(date) ===" >> $LOG
     exit 0
   fi
+  rm -f $ATT
   sleep 120
 done
