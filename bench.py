@@ -300,6 +300,8 @@ def main() -> None:
 
             print(f"bench: utilization probe failed: {e}", file=sys.stderr)
 
+    import jax
+
     line = {
         "metric": "edge_cut_rmat600k_k16",
         "value": cut,
@@ -307,6 +309,10 @@ def main() -> None:
         "vs_baseline": round(vs, 3),
         "lp_coarsening_seconds": round(coarsening_s, 2),
         "total_seconds": round(total_s, 2),
+        # cuts are platform-independent; every WALL figure is only
+        # meaningful on the TPU — "cpu" here means the tunnel was down
+        # and the speed ratios must not be read as TPU numbers
+        "platform": jax.devices()[0].platform,
     }
     if vs_cpu is not None:
         line["vs_cpu_coarsening"] = vs_cpu

@@ -716,8 +716,8 @@ int64_t refine(int64_t n, const int64_t* xadj, const int32_t* adjncy,
                int64_t num_iterations, int64_t num_seed_nodes,
                double alpha, int64_t num_fruitless_moves,
                int32_t use_adaptive, uint64_t seed) {
-  // the packed tag field holds block+1 in 16 bits
-  if (k + 1 >= ((int64_t)1 << 16)) return 0;
+  // the packed tag field holds block+1 in 16 bits (max tag = k)
+  if (k > 0xFFFF) return 0;
   SparseCtx c{n, k, xadj, adjncy, node_w, edge_w, max_bw, part,
               {}, {}, {}, {}};
   Rng rng(seed);

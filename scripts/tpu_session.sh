@@ -1,0 +1,22 @@
+#!/bin/bash
+# One TPU measurement session, ordered by value-per-minute — run when
+# the tunnel grants a chip (after a pool outage, windows may be short).
+# Each stage appends to its own log; rerunning skips nothing (cheap
+# stages are idempotent and the expensive ones want fresh numbers).
+set -x
+cd /root/repo
+
+# 1. the decisive probe: dynamic_gather speed on tall tables (~5 min)
+python scripts/probe_dynamic_gather.py 2>&1 | tee -a /tmp/tpu_probe.log
+
+# 2. one warm-up + timed 10M LP+coarsening with the routed path
+#    (bench's own measure; also records the medium line) (~15-30 min,
+#    first run pays routed-path compiles)
+python bench.py 2>&1 | tee -a /tmp/tpu_bench1.log
+
+# 3. second bench run: warm-cache steady state (~10 min)
+python bench.py 2>&1 | tee -a /tmp/tpu_bench2.log
+
+# 4. configs[3] analog re-record (strong k=32) — VERDICT r4 #5 wants
+#    the warm wall under 250 s at equal-or-better cut (~20-40 min)
+python scripts/record_configs.py fe_ocean 2>&1 | tee -a /tmp/tpu_cfg3.log

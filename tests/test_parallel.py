@@ -1043,3 +1043,14 @@ def test_dist_deep_k64_quality_vs_shm():
     spart = sc.set_graph(graph).compute_partition(k=k, epsilon=eps, seed=3)
     shm_cut = int(ew[spart[src] != spart[graph.adjncy]].sum() // 2)
     assert dist_cut <= 1.10 * shm_cut + 16, (dist_cut, shm_cut)
+
+
+def test_make_mesh_2d_honors_explicit_devices():
+    """The (rows, cols) path must use the caller's device selection and
+    order, not silently rebuild from jax.devices()."""
+    import jax
+
+    devs = list(jax.devices()[:8])[::-1]
+    mesh = make_mesh((2, 4), devices=devs)
+    assert mesh.devices.shape == (2, 4)
+    assert [d.id for d in mesh.devices.flat] == [d.id for d in devs]
