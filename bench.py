@@ -272,9 +272,16 @@ def main() -> None:
     # the CPU-vs-TPU story (skippable for quick local runs)
     total_10m = cut_10m = feasible_10m = None
     util = {}
+    import jax as _jax
+
+    platform = _jax.devices()[0].platform
+    on_accel = platform in ("tpu", "axon")
     if (
         base.get("large10m_coarsening_s")
         and os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1"
+        # the large section exists to measure TPU walls; on the CPU
+        # fallback it would burn ~an hour for numbers that mean nothing
+        and on_accel
     ):
         try:
             coarsening_10m_s = _measure_large_coarsening()
@@ -300,8 +307,6 @@ def main() -> None:
 
             print(f"bench: utilization probe failed: {e}", file=sys.stderr)
 
-    import jax
-
     line = {
         "metric": "edge_cut_rmat600k_k16",
         "value": cut,
@@ -312,7 +317,7 @@ def main() -> None:
         # cuts are platform-independent; every WALL figure is only
         # meaningful on the TPU — "cpu" here means the tunnel was down
         # and the speed ratios must not be read as TPU numbers
-        "platform": jax.devices()[0].platform,
+        "platform": platform,
     }
     if vs_cpu is not None:
         line["vs_cpu_coarsening"] = vs_cpu
