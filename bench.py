@@ -219,6 +219,17 @@ def main() -> None:
 
     from kaminpar_tpu.utils import timer
 
+    # telemetry for the embedded run report: the BENCH line carries the
+    # same schema as --report-json so the perf trajectory and ad-hoc
+    # runs are directly comparable (telemetry/run_report.schema.json).
+    # Spans must accrue DURING the run, so telemetry is on inside the
+    # timed region; the facade's result-metrics pass that entails costs
+    # ~14 ms on the medium graph (<1% of total_seconds — within seed
+    # noise vs pre-telemetry BENCH lines).
+    from kaminpar_tpu import telemetry
+
+    telemetry.enable()
+
     best = None
     coarsening_times = []
     total_times = []
@@ -239,10 +250,22 @@ def main() -> None:
         )
         cand_res = host_partition_metrics(host, cand, BENCH_K)
         cand_feasible = bool(cand_res["block_weights"].max() <= cap)
+        # capture this run's report before the next compute resets the
+        # telemetry stream; keep the one belonging to the best candidate
+        try:
+            from kaminpar_tpu.telemetry.report import build_run_report
+
+            cand_report = build_run_report(extra_run={"bench_seed": seed})
+        except Exception as e:  # never let telemetry break the line
+            import sys
+
+            print(f"bench: run-report build failed: {e}", file=sys.stderr)
+            cand_report = None
         key = (not cand_feasible, cand_res["cut"])
         if best is None or key < best[0]:
-            best = (key, cand_res, cand_feasible)
-    _, res, feasible = best
+            best = (key, cand_res, cand_feasible, cand_report)
+    _, res, feasible, best_report = best
+    telemetry.disable()
     cut = res["cut"]
     # times are min-over-seeds (steady state): the first seed's run may
     # include remote XLA compiles / cache loads, and the CPU denominator
@@ -333,6 +356,14 @@ def main() -> None:
         if ref_10m and feasible_10m:
             line["vs_baseline_cut_10m"] = round(ref_10m / max(cut_10m, 1), 3)
     line.update(util)
+    if best_report is not None:
+        # drop only OPTIONAL sections; everything the schema requires
+        # (including events) stays, so the embedded report validates
+        # against run_report.schema.json exactly like a --report-json file
+        line["report"] = {
+            k: v for k, v in best_report.items()
+            if k not in ("timers_aggregated", "heap")
+        }
     print(json.dumps(line))
 
 

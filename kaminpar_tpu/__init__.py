@@ -19,6 +19,7 @@ from .graphs import (  # noqa: F401
     validate,
 )
 from .io import load_graph  # noqa: F401
+from . import telemetry  # noqa: F401
 from .context import Context  # noqa: F401
 from .presets import create_context_by_preset_name, get_preset_names  # noqa: F401
 from .kaminpar import KaMinPar, context_from_preset  # noqa: F401

@@ -178,6 +178,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--statistics", action="store_true",
         help="collect and print detailed statistics (IFSTATS analog)",
     )
+    from . import telemetry
+
+    telemetry.add_cli_args(p)
     p.add_argument(
         "-m", "--mode", default=None,
         choices=[m.value for m in PartitioningMode],
@@ -254,12 +257,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: need -k or -B/--max-block-weights", file=sys.stderr)
         return 1
 
+    from . import telemetry
     from .utils import heap_profiler, statistics
 
     if args.heap_profile:
         heap_profiler.enable()
     if args.statistics:
         statistics.enable()
+    telemetry.enable_if_requested(args)
 
     t_io = time.perf_counter()
     if args.graph.startswith("gen:"):
@@ -327,6 +332,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(heap_profiler.render())
     if args.statistics and not args.quiet:
         print(statistics.render())
+
+    telemetry.export_cli_outputs(
+        args,
+        extra_run={"io_seconds": round(io_s, 3),
+                   "partition_seconds": round(wall, 3)},
+        quiet=args.quiet,
+    )
 
     if perm is not None:
         # partition is indexed by reordered node ids; write in file order

@@ -75,6 +75,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--machine-timers", action="store_true",
         help="print the timer tree as one machine-readable line",
     )
+    p.add_argument(
+        "--comm-table", action="store_true",
+        help="print the per-phase collective-traffic account "
+        "(trace-time accounting; see docs/observability.md)",
+    )
+    from . import telemetry
+
+    telemetry.add_cli_args(p)
     return p
 
 
@@ -108,8 +116,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         validate(graph)
 
+    from . import telemetry
     from .parallel import dKaMinPar, make_mesh
 
+    telemetry.enable_if_requested(args)
     mesh = make_mesh(args.num_devices)
     solver = dKaMinPar(args.preset, mesh=mesh)
     solver.set_graph(graph)
@@ -136,6 +146,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(timer.render_aggregated(agg))
         if args.machine_timers:
             print("TIMERS " + timer.GLOBAL_TIMER.render_machine())
+        if args.comm_table:
+            from .parallel.mesh import comm_table
+
+            print(comm_table())
+
+    telemetry.export_cli_outputs(
+        args,
+        extra_run={"io_seconds": round(io_s, 3),
+                   "partition_seconds": round(wall, 3)},
+        quiet=args.quiet,
+    )
 
     if args.output:
         io_mod.write_partition(args.output, partition)

@@ -147,12 +147,26 @@ class KaMinPar:
         self._validate_parameters()
         k = ctx.partition.k
 
+        from . import telemetry
         from .utils import heap_profiler, statistics
         from .utils.heap_profiler import scoped_heap_profiler
 
         timer.GLOBAL_TIMER.reset()
         heap_profiler.reset()
         statistics.reset()
+        # telemetry shares the timer's nesting caveat: when this run is
+        # embedded in another pipeline (shm IP inside the dist driver),
+        # the outer run owns the stream and its annotations
+        if timer.GLOBAL_TIMER.idle():
+            telemetry.reset()
+            telemetry.annotate(
+                preset=ctx.preset_name,
+                seed=int(ctx.seed),
+                k=int(k),
+                epsilon=float(ctx.partition.epsilon),
+                mode=ctx.partitioning.mode.value,
+                graph={"n": int(graph.n), "m": int(graph.m)},
+            )
         from .partitioning import debug
         from .utils.logger import output_level as global_output_level
 
@@ -224,7 +238,13 @@ class KaMinPar:
             "partition labels out of range (validate_partition analog)",
             AssertionLevel.LIGHT,
         )
-        if self.output_level >= OutputLevel.APPLICATION:
+        # telemetry only needs the metrics when this run owns the stream
+        # (idle-gated, like the annotation itself): nested IP runs inside
+        # the dist driver would otherwise pay an O(n + m) pass per
+        # candidate and discard the result
+        if self.output_level >= OutputLevel.APPLICATION or (
+            telemetry.enabled() and timer.GLOBAL_TIMER.idle()
+        ):
             self._print_result(graph, partition)
         return partition
 
@@ -331,31 +351,41 @@ class KaMinPar:
         # isolated-node packing + balancers) — they cut nothing either way
         return False
 
-    def _print_result(self, graph, partition) -> None:
-        """Parseable RESULT line (kaminpar-shm/kaminpar.cc:48)."""
+    def result_metrics(self, graph, partition) -> dict:
+        """cut / imbalance / feasible of a computed partition (the RESULT
+        line's numbers, also the run report's `result` section)."""
         from .graphs.compressed import (
             CompressedHostGraph,
             compressed_partition_metrics,
         )
         from .graphs.host import host_partition_metrics
 
+        p = self.ctx.partition
         if isinstance(graph, CompressedHostGraph):
-            p = self.ctx.partition
             m = compressed_partition_metrics(graph, partition, p.k)
+        else:
+            m = host_partition_metrics(graph, partition, p.k)
+        return {
+            "cut": int(m["cut"]),
+            "imbalance": float(m["imbalance"]),
+            "feasible": bool(
+                (m["block_weights"] <= p.max_block_weights).all()
+            ),
+        }
+
+    def _print_result(self, graph, partition) -> None:
+        """Parseable RESULT line (kaminpar-shm/kaminpar.cc:48) + the
+        telemetry result annotation consumed by --report-json."""
+        from . import telemetry
+
+        m = self.result_metrics(graph, partition)
+        if timer.GLOBAL_TIMER.idle():  # nested runs don't own the stream
+            telemetry.annotate(result=m)
+        if self.output_level >= OutputLevel.APPLICATION:
             log(
                 f"RESULT cut={m['cut']} imbalance={m['imbalance']:.6f} "
-                f"feasible={int((m['block_weights'] <= p.max_block_weights).all())} "
-                f"k={p.k}"
+                f"feasible={int(m['feasible'])} k={self.ctx.partition.k}"
             )
-            return
-
-        p = self.ctx.partition
-        m = host_partition_metrics(graph, partition, p.k)
-        feasible = bool((m["block_weights"] <= p.max_block_weights).all())
-        log(
-            f"RESULT cut={m['cut']} imbalance={m['imbalance']:.6f} "
-            f"feasible={int(feasible)} k={p.k}"
-        )
 
 
 def _fill_blocks_by_headroom(

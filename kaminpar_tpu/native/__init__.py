@@ -322,6 +322,12 @@ def ml_bipartition(graph, max_block_weights, ip_ctx, seed: int):
 # ---------------------------------------------------------------------------
 
 
+# fm_refine's refusal sentinel: native FM could not run at this (n, k).
+# INT64_MIN, matching fm.cpp — NOT a small negative, which a threaded run
+# whose commit prefix was cut short by a cap race can legitimately return.
+FM_REFUSED = -(1 << 63)
+
+
 def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
               threads: int = 1, force_sparse: bool = False):
     """Run the native localized batch FM on a HostGraph partition.
@@ -336,7 +342,13 @@ def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
     switches to the sparse compact-hashing gain cache
     (compact_hashing_gain_cache.h:34 analog, O(m) memory), so FM stays
     active at large k.  `force_sparse` exercises that path at any k
-    (tests)."""
+    (tests).
+
+    Returns FM_REFUSED (INT64_MIN) when the native side REFUSED to run —
+    k above the sparse engine's 16-bit packed-tag limit (0xFFFF) with the
+    dense (n, k) table also unaffordable — so the caller can tell "FM
+    did not run" from "FM found no improvement"; the refusal is also
+    recorded as an `fm-refused` telemetry event for the run report."""
     lib = get_lib()
     if lib is None or graph.n == 0 or k <= 1:
         return None
@@ -347,7 +359,7 @@ def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
     max_bw = np.ascontiguousarray(max_block_weights, dtype=np.int64)
     assert partition.dtype == np.int32 and partition.flags.c_contiguous
     fn = lib.kmp_fm_refine_sparse if force_sparse else lib.kmp_fm_refine
-    return int(
+    ret = int(
         fn(
             graph.n, xadj, adjncy, node_w, edge_w, int(k), max_bw,
             partition,
@@ -358,6 +370,23 @@ def fm_refine(graph, partition, k, max_block_weights, fm_ctx, seed: int,
             max(1, int(threads)),
         )
     )
+    if ret == FM_REFUSED:
+        from .. import telemetry
+        from ..utils.logger import log_warning
+
+        # only the sparse engine refuses (16-bit packed tags); the normal
+        # entry reaches it because the dense table is over the cap, the
+        # test hook because the caller forced the sparse path
+        reason = "k exceeds the sparse engine's 16-bit tag limit (0xFFFF)"
+        reason += (
+            " (sparse path forced)" if force_sparse
+            else " and the dense (n, k) table is unaffordable"
+        )
+        telemetry.event(
+            "fm-refused", n=int(graph.n), k=int(k), reason=reason
+        )
+        log_warning(f"native FM did not run: {reason} (n={graph.n}, k={k})")
+    return ret
 
 
 # ---------------------------------------------------------------------------

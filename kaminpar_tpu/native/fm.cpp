@@ -716,8 +716,12 @@ int64_t refine(int64_t n, const int64_t* xadj, const int32_t* adjncy,
                int64_t num_iterations, int64_t num_seed_nodes,
                double alpha, int64_t num_fruitless_moves,
                int32_t use_adaptive, uint64_t seed) {
-  // the packed tag field holds block+1 in 16 bits (max tag = k)
-  if (k > 0xFFFF) return 0;
+  // the packed tag field holds block+1 in 16 bits (max tag = k).
+  // INT64_MIN is the REFUSAL sentinel — the caller must distinguish "FM
+  // did not run" from "FM found no improvement" (ADVICE round 5 low #3),
+  // and a small negative value would be ambiguous: with threads > 1 a
+  // cap-race-aborted commit prefix can legitimately sum negative.
+  if (k > 0xFFFF) return INT64_MIN;
   SparseCtx c{n, k, xadj, adjncy, node_w, edge_w, max_bw, part,
               {}, {}, {}, {}};
   Rng rng(seed);

@@ -356,14 +356,60 @@ def routed_block_ratings(plans: EdgePlans, labels, k: int, n_pad: int):
     ).reshape(n_pad, k)
 
 
+# last probe / override decision, surfaced in run reports
+# (telemetry.report `lane_gather` section) and by the probe event
+_PROBE_STATUS: dict = {"mode": "not-probed"}
+
+
+def probe_status() -> dict:
+    """The current routing decision: probe verdict + timings when the
+    support probe ran, or the env-override / not-probed state."""
+    import os
+
+    status = dict(_PROBE_STATUS)
+    env = os.environ.get("KAMINPAR_TPU_LANE_GATHER", "")
+    if env in ("0", "1"):
+        status["env_override"] = env
+        if env == "0":
+            status["mode"] = "opt-out"
+    return status
+
+
 def maybe_edge_plans(graph):
     """EdgePlans for the level, or None when routing would not pay:
     backend without the Mosaic kernel, small levels, or opted out via
-    KAMINPAR_TPU_LANE_GATHER=0."""
+    KAMINPAR_TPU_LANE_GATHER=0.  KAMINPAR_TPU_LANE_GATHER=1 force-enables
+    routing past the size gate and the best-of-3 TIMING race — the
+    symmetric override for noisy links where one slow probe round would
+    otherwise disable routing for the whole process (ADVICE round 5 low
+    #2).  The compile/correctness half of the probe still gates: forcing
+    on a backend without the Mosaic kernel (a shell profile exported for
+    TPU work, run on a CPU box) stays a no-op instead of a crash."""
     import os
 
-    if os.environ.get("KAMINPAR_TPU_LANE_GATHER", "") == "0":
+    env = os.environ.get("KAMINPAR_TPU_LANE_GATHER", "")
+    if env == "0":
         return None
+    if env == "1":
+        if _PROBE_STATUS.get("mode") != "forced-on":
+            supported, status = _probe_support(skip_timing=True)
+            status["mode"] = "forced-on"
+            _PROBE_STATUS.clear()
+            _PROBE_STATUS.update(status)
+            from .. import telemetry
+            from ..utils.logger import log_progress
+
+            telemetry.event(
+                "lane-gather-probe",
+                verdict="forced-on",
+                **{k: v for k, v in status.items() if k != "mode"},
+            )
+            log_progress(
+                "lane-gather: force-enabled (KAMINPAR_TPU_LANE_GATHER=1)"
+                + ("" if supported else
+                   f" but unavailable: {status.get('reason')}")
+            )
+        return edge_plans(graph) if _PROBE_STATUS.get("supported") else None
     if graph.dst.shape[0] < MIN_EDGE_SLOTS:
         return None
     if not lane_gather_supported():
@@ -377,10 +423,43 @@ def lane_gather_supported() -> bool:
     kernel, produce correct results on a multi-vreg (cross-sublane)
     table, AND actually beat the XLA gather at a representative shape —
     a lowering that emulates the gather slowly would silently regress
-    every routed round otherwise."""
+    every routed round otherwise.  The verdict (and both timings) is
+    logged and recorded as a telemetry event: the probe is a single
+    best-of-3 timing race cached for the process, and an operator must
+    be able to see which way it went (ADVICE round 5 low #2)."""
+    supported, status = _probe_support()
+    _PROBE_STATUS.clear()
+    _PROBE_STATUS.update(status)
+    from .. import telemetry
+    from ..utils.logger import log_progress
+
+    telemetry.event(
+        "lane-gather-probe",
+        verdict="enabled" if supported else "disabled",
+        **{k: v for k, v in status.items() if k != "mode"},
+    )
+    detail = ", ".join(
+        f"{k}={v}" for k, v in status.items() if k not in ("mode",)
+    )
+    log_progress(
+        f"lane-gather probe: {'enabled' if supported else 'disabled'}"
+        + (f" ({detail})" if detail else "")
+    )
+    return supported
+
+
+def _probe_support(skip_timing: bool = False):
+    """Returns (supported, status dict with reason/timings).  With
+    `skip_timing` (the =1 force-enable) only the platform and
+    correctness halves gate — the timing race is not run."""
     try:
-        if jax.devices()[0].platform not in ("tpu", "axon"):
-            return False
+        platform = jax.devices()[0].platform
+        if platform not in ("tpu", "axon"):
+            return False, {
+                "mode": "probed",
+                "supported": False,
+                "reason": f"platform {platform} lacks the Mosaic kernel",
+            }
         # correctness at a small cross-sublane shape
         n = 16 * L
         rng = np.random.RandomState(0)
@@ -391,7 +470,13 @@ def lane_gather_supported() -> bool:
         inv = np.asarray(plan.inv)
         ok = inv >= 0
         if not np.array_equal(got[ok], table[idx[inv[ok]]]):
-            return False
+            return False, {
+                "mode": "probed",
+                "supported": False,
+                "reason": "dynamic_gather produced incorrect results",
+            }
+        if skip_timing:
+            return True, {"mode": "probed", "supported": True}
         # speed: routed gather must beat the XLA gather at 4M indices
         # from a 2^19-entry table (a mid-size level's shape)
         import time
@@ -419,6 +504,18 @@ def lane_gather_supported() -> bool:
 
         t_routed = _time(lambda t: lane_gather(t, plan2), tab2)
         t_xla = _time(xla, tab2, idx2)
-        return t_routed < t_xla
-    except Exception:  # pragma: no cover - backend specific
-        return False
+        status = {
+            "mode": "probed",
+            "supported": bool(t_routed < t_xla),
+            "t_routed_s": round(t_routed, 6),
+            "t_xla_s": round(t_xla, 6),
+        }
+        if not status["supported"]:
+            status["reason"] = "routed gather lost the timing race"
+        return status["supported"], status
+    except Exception as e:  # pragma: no cover - backend specific
+        return False, {
+            "mode": "probed",
+            "supported": False,
+            "reason": f"probe raised {type(e).__name__}",
+        }

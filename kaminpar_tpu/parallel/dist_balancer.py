@@ -214,7 +214,9 @@ def _dist_node_balance_impl(mesh, graph, partition, k, cap, seed, max_rounds):
             (jnp.int32(0), part_l0, ghost0, jnp.int32(1), jnp.array(True)),
         )
         # ONE O(n) gather at loop exit
-        account_collective("all_gather(partition)", part_l.size * 4)
+        account_collective(
+            "all_gather(partition)", part_l.size * 4, shape=part_l.shape
+        )
         return lax.all_gather(part_l, NODE_AXIS, tiled=True)
 
     return _shard_map(

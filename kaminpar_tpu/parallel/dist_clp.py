@@ -148,7 +148,9 @@ def _dist_clp_impl(
             0, num_iterations, iter_body, (part_l0, ghost0, bw0)
         )
         # ONE O(n) gather at loop exit
-        account_collective("all_gather(partition)", part_l.size * 4)
+        account_collective(
+            "all_gather(partition)", part_l.size * 4, shape=part_l.shape
+        )
         return lax.all_gather(part_l, NODE_AXIS, tiled=True)
 
     return _shard_map(

@@ -133,6 +133,7 @@ def _dist_contract_edges_impl(mesh, graph: DistGraph, labels, cmap_full):
         account_collective(
             "all_to_all(contraction-edges)",
             sum(b.size * b.dtype.itemsize for b in (send_cu, send_cv, send_w)),
+            shape=send_cu.shape,
         )
         recv_cu = lax.all_to_all(send_cu, NODE_AXIS, 0, 0, tiled=True)
         recv_cv = lax.all_to_all(send_cv, NODE_AXIS, 0, 0, tiled=True)

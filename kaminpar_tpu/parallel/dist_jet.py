@@ -59,7 +59,7 @@ def _local_cut(part_l, ghost_part, seg, dstloc_l, ew_l):
     own = part_l[jnp.clip(seg, 0, n_loc - 1)]
     nb = tab[jnp.clip(dstloc_l, 0, tab.shape[0] - 1)]
     local = jnp.sum(jnp.where(own != nb, ew_l, 0).astype(ACC_DTYPE))
-    account_collective("psum(cut)", local.dtype.itemsize)
+    account_collective("psum(cut)", local.dtype.itemsize, shape=local.shape)
     return lax.psum(local, NODE_AXIS) // 2
 
 
@@ -257,7 +257,9 @@ def _dist_jet_impl(
             0, num_rounds, round_body, (part_l0, ghost0, part_l0, best_cut0)
         )
         # ONE O(n) gather at loop exit
-        account_collective("all_gather(partition)", best_l.size * 4)
+        account_collective(
+            "all_gather(partition)", best_l.size * 4, shape=best_l.shape
+        )
         return lax.all_gather(best_l, NODE_AXIS, tiled=True)
 
     return _shard_map(

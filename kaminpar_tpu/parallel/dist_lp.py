@@ -244,7 +244,9 @@ def _dist_lp_round(
 
     delta_l = move_weight_delta(labels_l, target_l, accept_l, nw_l, C)
     account_collective(
-        "psum(weight-delta)", delta_l.size * delta_l.dtype.itemsize
+        "psum(weight-delta)",
+        delta_l.size * delta_l.dtype.itemsize,
+        shape=delta_l.shape,
     )
     delta = lax.psum(delta_l, NODE_AXIS)
     new_weights = (weights.astype(ACC_DTYPE) + delta).astype(weights.dtype)
@@ -259,7 +261,7 @@ def _dist_lp_round(
     else:
         new_active_l = jnp.ones_like(active_l)
 
-    account_collective("psum(convergence)", 4)
+    account_collective("psum(convergence)", 4, shape=())
     num_wanting = lax.psum(jnp.sum(wants.astype(jnp.int32)), NODE_AXIS)
     return new_labels_l, new_ghost_lab, new_weights, new_active_l, num_wanting
 
@@ -319,7 +321,9 @@ def _dist_lp_loop(
         # are all O(interface)
         from .mesh import account_collective
 
-        account_collective("all_gather(labels)", labels_l.size * 4)
+        account_collective(
+            "all_gather(labels)", labels_l.size * 4, shape=labels_l.shape
+        )
         return lax.all_gather(labels_l, NODE_AXIS, tiled=True)
 
     mapped = _shard_map(

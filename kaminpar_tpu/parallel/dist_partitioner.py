@@ -156,8 +156,29 @@ class dKaMinPar:
         ctx.partition.setup(graph, k=k, epsilon=epsilon)
         k = ctx.partition.k
 
+        from .. import telemetry
         from ..utils.logger import output_level, set_output_level
 
+        if timer.GLOBAL_TIMER.idle():
+            from .mesh import reset_comm_log
+
+            # per-run observability: without these resets, a second
+            # compute in the same process reports the first run's traced
+            # comm rows and doubled timer scopes attributed to one run's
+            # seed/k/result — the report must misattribute nothing, even
+            # if cache-hit runs then show an empty comm table (the
+            # documented COMM_CAVEAT tradeoff)
+            reset_comm_log()
+            timer.GLOBAL_TIMER.reset()
+            telemetry.reset()
+            telemetry.annotate(
+                seed=int(ctx.seed),
+                k=int(k),
+                epsilon=float(ctx.partition.epsilon),
+                mode=self.ctx.mode.value,
+                devices=int(self.mesh.devices.size),
+                graph={"n": int(graph.n), "m": int(graph.m)},
+            )
         prior_level = output_level()
         try:
             set_output_level(
@@ -182,6 +203,7 @@ class dKaMinPar:
                 # perfect weight) so the two RESULT paths cannot drift
                 perfect = max(1, pymath.ceil(int(nw.sum()) / k))
                 imbalance = float(bw.max() / perfect - 1.0)
+                feasible = bool((bw <= ctx.partition.max_block_weights).all())
                 # the finest sharded arrays are only retained for this
                 # metrics call — release the device memory
                 self._fine_dg = None
@@ -190,6 +212,18 @@ class dKaMinPar:
 
                 res = host_partition_metrics(self._plain(graph), partition, k)
                 cut, imbalance = res["cut"], res["imbalance"]
+                feasible = bool(
+                    (res["block_weights"] <= ctx.partition.max_block_weights)
+                    .all()
+                )
+            if timer.GLOBAL_TIMER.idle():  # nested runs don't own the stream
+                telemetry.annotate(
+                    result={
+                        "cut": int(cut),
+                        "imbalance": float(imbalance),
+                        "feasible": feasible,
+                    }
+                )
             log(
                 f"RESULT cut={cut} imbalance={imbalance:.6f} "
                 f"k={k} devices={self.mesh.devices.size}"
@@ -633,6 +667,9 @@ class dKaMinPar:
         self._replication_info.update(
             {"levels": len(u_levels), "best_replica": g_best, "cut": cut}
         )
+        from .. import telemetry
+
+        telemetry.event("replicated-coarsening", **self._replication_info)
         log(
             f"replicated coarsening: G={G} replicas x "
             f"{int(self.mesh.devices.size) // G} devices, "

@@ -38,12 +38,26 @@ NODE_AXIS = (NODE_AXIS_X, NODE_AXIS_Y)
 #
 # A static per-phase model of the collective traffic (the dist layer's
 # answer to VERDICT r4 #5/#6: project ICI-vs-compute balance instead of
-# asserting it).  Collective helpers register (op, payload bytes) at
-# TRACE time — inside a lax.while_loop body that is once per ROUND, so
-# entries read as "bytes per round per device".  Enabled only while a
-# `comm_phase` scope is open; `comm_table()` renders the account.
+# asserting it).  Collective helpers register (op, payload bytes, traced
+# shape) at TRACE time — inside a lax.while_loop body that is once per
+# ROUND, so entries read as "bytes per round per device".  Keying by the
+# traced shape keeps shape-bucket retraces as separate rows instead of
+# silently double-counting one phase (ADVICE round 5 low #4); the dual
+# caveat — a phase whose jitted program is an executable-cache hit
+# registers NOTHING — cannot be fixed at trace time and is therefore
+# stamped on every rendering (COMM_CAVEAT).  Enabled only while a
+# `comm_phase` scope is open; `comm_table()` / `comm_records()` render
+# the account, and every new traced key emits a `jit-trace` telemetry
+# event (attr retrace=True when the same phase+op re-traced at a new
+# shape).
 
-_comm_log: Dict[Tuple[str, str], List[int]] = {}
+COMM_CAVEAT = (
+    "collectives are accounted at TRACE time: a phase whose jitted "
+    "program is an executable-cache hit registers zero bytes, and "
+    "figures inside round loops are per round per device"
+)
+
+_comm_log: Dict[Tuple[str, str, tuple], List[int]] = {}
 _comm_phase: List[str] = []
 
 
@@ -57,11 +71,31 @@ def comm_phase(name: str):
         _comm_phase.pop()
 
 
-def account_collective(op: str, nbytes: int) -> None:
-    """Register one traced collective of `nbytes` payload per device."""
+def account_collective(op: str, nbytes: int, shape=None) -> None:
+    """Register one traced collective of `nbytes` payload per device.
+
+    `shape` is the traced payload shape (static at trace time); passing
+    it keys the account by (phase, op, shape) so a shape-bucket retrace
+    lands in its own row."""
     if not _comm_phase:
         return
-    entry = _comm_log.setdefault((_comm_phase[-1], op), [0, 0])
+    phase = _comm_phase[-1]
+    key = (phase, op, tuple(int(d) for d in shape) if shape else ())
+    entry = _comm_log.get(key)
+    if entry is None:
+        entry = _comm_log[key] = [0, 0]
+        from .. import telemetry
+
+        telemetry.event(
+            "jit-trace",
+            phase=phase,
+            op=op,
+            shape=list(key[2]),
+            retrace=any(
+                k[0] == phase and k[1] == op and k is not key
+                for k in _comm_log
+            ),
+        )
     entry[0] += 1
     entry[1] += int(nbytes)
 
@@ -70,14 +104,33 @@ def reset_comm_log() -> None:
     _comm_log.clear()
 
 
+def comm_records() -> List[dict]:
+    """The account as structured rows (run-report `comm.records`)."""
+    return [
+        {
+            "phase": phase,
+            "op": op,
+            "shape": list(shape),
+            "traced_calls": calls,
+            "payload_bytes_per_device": nbytes,
+        }
+        for (phase, op, shape), (calls, nbytes) in sorted(_comm_log.items())
+    ]
+
+
 def comm_table() -> str:
     """Render the per-phase collective account (traced ops; for ops
     inside round loops the figures are per round per device)."""
     if not _comm_log:
         return "(comm accounting: no collectives traced)"
-    lines = ["phase | collective | traced calls | payload bytes/device"]
-    for (phase, op), (calls, nbytes) in sorted(_comm_log.items()):
-        lines.append(f"{phase} | {op} | {calls} | {nbytes}")
+    lines = [
+        f"(caveat: {COMM_CAVEAT})",
+        "phase | collective | traced shape | traced calls | "
+        "payload bytes/device",
+    ]
+    for (phase, op, shape), (calls, nbytes) in sorted(_comm_log.items()):
+        shp = "x".join(str(d) for d in shape) if shape else "-"
+        lines.append(f"{phase} | {op} | {shp} | {calls} | {nbytes}")
     return "\n".join(lines)
 
 
@@ -105,7 +158,11 @@ def throttled_local_capacity(
         jnp.clip(target_l, 0, C - 1),
         num_segments=C,
     )
-    account_collective("psum(cluster-demand)", demand_l.size * demand_l.dtype.itemsize)
+    account_collective(
+        "psum(cluster-demand)",
+        demand_l.size * demand_l.dtype.itemsize,
+        shape=demand_l.shape,
+    )
     demand = lax.psum(demand_l, axis_name)
     headroom = jnp.maximum(cap - weights.astype(ACC_DTYPE), 0)
     frac = headroom.astype(jnp.float32) / jnp.maximum(demand, 1).astype(
@@ -145,7 +202,9 @@ def halo_exchange(
     n_loc = v.shape[1]
     sendbuf = v[:, jnp.clip(send_idx_l, 0, n_loc - 1)]  # [C, D, s_max]
     account_collective(
-        "all_to_all(halo)", sendbuf.size * sendbuf.dtype.itemsize
+        "all_to_all(halo)",
+        sendbuf.size * sendbuf.dtype.itemsize,
+        shape=sendbuf.shape,
     )
     recvbuf = lax.all_to_all(sendbuf, axis_name, 1, 1, tiled=True)
     out = (

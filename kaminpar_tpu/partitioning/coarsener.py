@@ -206,6 +206,15 @@ class Coarsener:
         )
         self.current = coarse.graph
         self.current_n = c_n
+        from .. import telemetry
+
+        telemetry.event(
+            "coarsening-level",
+            level=self.level,
+            n=int(c_n),
+            m=int(c_m),
+            retries=retries,
+        )
         return True
 
     def uncoarsen(self, partition: jnp.ndarray) -> Tuple[DeviceGraph, jnp.ndarray]:

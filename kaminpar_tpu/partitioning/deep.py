@@ -439,6 +439,11 @@ class DeepMultilevelPartitioner:
                 jnp.asarray(base_ids), jnp.asarray(is_split), current_k,
             )
             self._spans = new_spans
+            from .. import telemetry
+
+            telemetry.event(
+                "extend-partition", k=len(new_spans), extractor="device"
+            )
             return new_part, new_spans, len(new_spans)
 
     def _extend_partition_host(
@@ -544,4 +549,9 @@ class DeepMultilevelPartitioner:
             padded = np.zeros(dgraph.n_pad, dtype=np.int32)
             padded[: host.n] = new_part
             self._spans = new_spans
+            from .. import telemetry
+
+            telemetry.event(
+                "extend-partition", k=len(new_spans), extractor="host"
+            )
             return jnp.asarray(padded), new_spans, len(new_spans)

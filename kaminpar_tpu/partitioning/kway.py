@@ -76,6 +76,14 @@ class KWayMultilevelPartitioner:
 
         # --- initial partitioning on host (rb to k) ---
         with timer.scoped_timer("initial-partitioning"):
+            from .. import telemetry
+
+            telemetry.event(
+                "initial-partitioning",
+                n=int(coarsener.current_n),
+                k=int(k),
+                levels=int(coarsener.level),
+            )
             coarsest_host = host_graph_from_device(coarsener.current)
             debug.dump_coarsest_graph(ctx, coarsest_host)
             init_part = recursive_bipartition(coarsest_host, k, ctx, rng)

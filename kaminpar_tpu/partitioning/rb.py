@@ -81,6 +81,12 @@ def recursive_bipartition(
     if k <= 1 or graph.n == 0:
         return part
 
+    from .. import telemetry
+
+    telemetry.event(
+        "rb-bisection", n=int(graph.n), k=int(k),
+        first_sub_block=int(first_sub_block),
+    )
     max_weights = bipartition_max_block_weights(
         ctx, first_sub_block, k, graph.total_node_weight
     )

@@ -182,6 +182,11 @@ class RefinerPipeline:
         )
         if over == 0:
             return partition
+        from .. import telemetry
+
+        # the device balancers stalled with residual overload — a silent
+        # quality/perf decision the run report must show
+        telemetry.event("balancer-host-fallback", residual_overload=over)
         log_debug(f"host balance fallback, residual overload {over}")
         host = host_graph_from_device(graph)
         n = host.n

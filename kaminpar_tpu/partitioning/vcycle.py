@@ -43,10 +43,22 @@ class VcycleDeepMultilevelPartitioner:
         deep_ctx.partition = ctx.partition  # share the configured weights
         part = DeepMultilevelPartitioner(deep_ctx).partition(graph)
 
+        from .. import telemetry
+        from ..graphs.host import host_partition_metrics
+
         num_cycles = max(len(ctx.partitioning.vcycles), 1)
         for cycle in range(num_cycles):
             with timer.scoped_timer(f"vcycle-{cycle}"):
                 part = self._one_vcycle(graph, part, cycle)
+            # cut per cycle only for plain CSR inputs (compressed graphs
+            # lack the host edge arrays; the facade decodes before vcycle
+            # dispatch, but direct callers may not)
+            if telemetry.enabled() and isinstance(graph, HostGraph):
+                telemetry.event(
+                    "vcycle",
+                    cycle=cycle,
+                    cut=int(host_partition_metrics(graph, part, k)["cut"]),
+                )
         return part
 
     def _one_vcycle(

@@ -55,6 +55,7 @@ def fm_refine_host(
     import os
 
     native_ok = os.environ.get("KAMINPAR_TPU_NO_NATIVE_FM", "") != "1"
+    refused = False
     if native_ok:
         from .. import native
 
@@ -64,8 +65,14 @@ def fm_refine_host(
         improvement = native.fm_refine(
             graph, part, k, max_bw, ctx, seed, threads=threads
         )
-        native_ok = improvement is not None
-    if not native_ok:
+        # native FM REFUSED to run (k above the sparse 16-bit tag limit
+        # with the dense table unaffordable): return the partition
+        # unchanged rather than falling into the numpy pass below, whose
+        # dense (n, k) gain cache is unaffordable at exactly these k.
+        # fm_refine already recorded the fm-refused telemetry event.
+        refused = improvement == native.FM_REFUSED
+        native_ok = improvement is not None and not refused
+    if not native_ok and not refused:
         node_w = graph.node_weight_array()
         edge_w = graph.edge_weight_array()
         rng = np.random.default_rng(seed)

@@ -1,0 +1,123 @@
+"""Chrome trace-event exporter (`--trace-out`).
+
+Serializes the telemetry stream to the Chrome trace-event JSON object
+format (the `{"traceEvents": [...]}` flavor Perfetto and chrome://tracing
+both accept): every closed span becomes a complete ("ph": "X") event and
+every one-shot decision an instant ("ph": "i") event.
+
+Multi-host runs get one track per process: each process's local stream is
+gathered with the same `process_allgather` machinery the distributed
+timer finalize uses (utils/timer.aggregate_across_processes), and the
+exporter emits the union with per-process `pid`s plus `process_name`
+metadata — the Perfetto analog of the reference's per-PE timer rows
+(kaminpar-dist/timer.cc).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Tuple
+
+from . import events as _events
+from . import spans as _spans
+
+
+def _local_payload() -> dict:
+    return {
+        "spans": [s.to_dict() for s in _spans()],
+        "events": [e.to_dict() for e in _events()],
+    }
+
+
+def gather_payloads() -> List[Tuple[int, dict]]:
+    """[(process index, {"spans": [...], "events": [...]})] across all
+    processes; a single-process run (or an unreachable backend) returns
+    just the local stream under pid 0."""
+    local = _local_payload()
+    try:
+        import jax
+
+        nproc = jax.process_count()
+        pid = jax.process_index()
+    except Exception:
+        return [(0, local)]
+    if nproc <= 1:
+        return [(pid, local)]
+    # all hosts must call this together (same code path), mirroring the
+    # collective finalize contract of aggregate_across_processes
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    blob = np.frombuffer(json.dumps(local).encode("utf-8"), np.uint8)
+    lens = np.asarray(
+        multihost_utils.process_allgather(
+            np.array([blob.size], dtype=np.int64)
+        )
+    ).reshape(-1)
+    width = int(lens.max())
+    padded = np.zeros(width, np.uint8)
+    padded[: blob.size] = blob
+    gathered = np.asarray(
+        multihost_utils.process_allgather(padded)
+    ).reshape(nproc, width)
+    out = []
+    for p in range(nproc):
+        raw = bytes(gathered[p][: int(lens[p])])
+        out.append((p, json.loads(raw.decode("utf-8"))))
+    return out
+
+
+def chrome_trace() -> dict:
+    """The trace-event JSON object for the current stream."""
+    trace_events: List[dict] = []
+    for pid, payload in gather_payloads():
+        trace_events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"kaminpar-tpu process {pid}"},
+            }
+        )
+        for s in payload["spans"]:
+            trace_events.append(
+                {
+                    "ph": "X",
+                    "cat": "span",
+                    "name": s["name"],
+                    "ts": round(s["start"] * 1e6, 3),
+                    "dur": round(s["duration"] * 1e6, 3),
+                    "pid": pid,
+                    "tid": int(s.get("tid", 0)),
+                    "args": {"path": s["path"], **s.get("attrs", {})},
+                }
+            )
+        for e in payload["events"]:
+            trace_events.append(
+                {
+                    "ph": "i",
+                    "s": "p",  # process-scoped instant
+                    "cat": "event",
+                    "name": e["name"],
+                    "ts": round(e["t"] * 1e6, 3),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": e.get("attrs", {}),
+                }
+            )
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str) -> None:
+    """Write the trace to `path` (open in Perfetto: ui.perfetto.dev).
+
+    Collective on multi-host runs: every process must call this (the
+    payload gather allgathers), but only process 0 writes the file —
+    concurrent writers on a shared filesystem would interleave."""
+    from . import is_primary_process
+
+    trace = chrome_trace()
+    if is_primary_process():
+        with open(path, "w") as f:
+            json.dump(trace, f)

@@ -1,0 +1,140 @@
+"""Per-run JSON report (`--report-json`).
+
+One machine-readable artifact per partition call, the analog of the
+reference's parseable RESULT + TIME output promoted to a single schema:
+scope tree (from the hierarchical timer), result metrics, per-level
+graph sizes (from the coarsener's telemetry events), the collective
+traffic table (parallel/mesh comm accounting), the lane-gather probe
+verdict, statistics counters, and an environment stamp.  `bench.py`
+embeds the same dict into its BENCH line so ad-hoc runs and the perf
+trajectory share one schema.
+
+The schema is checked in at `run_report.schema.json` and enforced by
+`scripts/check_report_schema.py` (invoked from a tier-1 test, so schema
+drift is caught at commit time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from . import events as _events
+from . import jsonable
+from . import run_info as _run_info
+
+SCHEMA_VERSION = 1
+SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
+)
+
+
+def environment_stamp() -> dict:
+    """Platform / device-count / version stamp for the report header."""
+    from .. import __version__
+
+    import platform as _platform
+
+    env: Dict[str, Any] = {
+        "version": __version__,
+        "python": _platform.python_version(),
+    }
+    try:
+        import jax
+
+        env["jax_version"] = jax.__version__
+        devices = jax.devices()
+        env["platform"] = devices[0].platform
+        env["device_count"] = len(devices)
+        env["process_count"] = jax.process_count()
+    except Exception:
+        env.setdefault("jax_version", "unavailable")
+        env.setdefault("platform", "unknown")
+        env.setdefault("device_count", 0)
+        env.setdefault("process_count", 1)
+    return env
+
+
+def _scope_tree(node) -> dict:
+    return {
+        child.name: {
+            "elapsed_s": round(child.elapsed, 6),
+            "count": child.count,
+            "children": _scope_tree(child),
+        }
+        for child in node.children.values()
+    }
+
+
+def build_run_report(extra_run: Optional[dict] = None) -> dict:
+    """Assemble the report from the current telemetry/timer/stats state.
+
+    Call after `compute_partition` returns (the facade annotates the run
+    and result sections during the call); `extra_run` keys (e.g. CLI io /
+    wall seconds) are merged into the `run` section."""
+    from ..ops import lane_gather
+    from ..utils import statistics, timer
+
+    info = _run_info()
+    result = info.pop("result", {})
+    run = dict(info)
+    if extra_run:
+        run.update({k: jsonable(v) for k, v in extra_run.items()})
+
+    levels = [
+        {"level": e.attrs.get("level"), **{
+            k: e.attrs[k] for k in ("n", "m", "retries") if k in e.attrs
+        }}
+        for e in _events("coarsening-level")
+    ]
+
+    try:
+        from ..parallel import mesh
+
+        comm = {"caveat": mesh.COMM_CAVEAT, "records": mesh.comm_records()}
+    except Exception:  # mesh pulls in jax; stay robust without a backend
+        comm = {"caveat": "comm accounting unavailable", "records": []}
+
+    report: Dict[str, Any] = {
+        "schema_version": SCHEMA_VERSION,
+        "environment": environment_stamp(),
+        "run": run,
+        "result": result,
+        "scope_tree": _scope_tree(timer.GLOBAL_TIMER.root),
+        "levels": levels,
+        "comm": comm,
+        "events": [e.to_dict() for e in _events()],
+        "counters": statistics.as_dict() if statistics.enabled() else {},
+        "lane_gather": lane_gather.probe_status(),
+    }
+
+    # distributed finalize: per-scope min/avg/max across processes (the
+    # kaminpar-dist/timer.cc analog); on one process min == avg == max
+    try:
+        report["timers_aggregated"] = timer.aggregate_across_processes()
+    except Exception:
+        pass
+
+    from ..utils import heap_profiler
+
+    if heap_profiler.profiling_enabled():
+        report["heap"] = heap_profiler.tree_dict()
+    return report
+
+
+def write_run_report(path: str, extra_run: Optional[dict] = None) -> dict:
+    """Build the report, write it to `path`, and return it.
+
+    Collective on multi-host runs: every process must call this (the
+    aggregated-timer section allgathers), but only process 0 writes the
+    file — concurrent writers on a shared filesystem would interleave.
+    The written report is process 0's local view plus the cross-process
+    min/avg/max timers."""
+    from . import is_primary_process
+
+    report = build_run_report(extra_run=extra_run)
+    if is_primary_process():
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report

@@ -57,6 +57,10 @@ def disable() -> None:
         _enabled = False
 
 
+def profiling_enabled() -> bool:
+    return _enabled
+
+
 def reset() -> None:
     global _root, _stack
     if len(_stack) > 1:
@@ -153,6 +157,30 @@ def record(name: str, nbytes: int) -> None:
     node = _stack[-1].child(name)
     node.peak_host_bytes = max(node.peak_host_bytes, int(nbytes))
     node.count += 1
+
+
+def live_device_bytes() -> int:
+    """Public probe of the current live-HBM figure (telemetry spans
+    attach this when heap profiling is enabled)."""
+    return _live_device_bytes()
+
+
+def tree_dict() -> dict:
+    """The heap-profile tree as nested dicts (run-report `heap` section)."""
+
+    def rec(node: HeapNode) -> dict:
+        return {
+            child.name: {
+                "peak_host_bytes": child.peak_host_bytes,
+                "peak_device_bytes": child.peak_device_bytes,
+                "live_device_bytes": child.live_device_bytes,
+                "count": child.count,
+                "children": rec(child),
+            }
+            for child in node.children.values()
+        }
+
+    return rec(_root)
 
 
 def _fmt(nbytes: int) -> str:

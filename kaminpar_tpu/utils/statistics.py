@@ -55,6 +55,34 @@ def series(name: str) -> List[float]:
     return list(_series.get(name, []))
 
 
+def counters_snapshot() -> Dict[str, int]:
+    """Copy of the counter map (telemetry span-entry baseline)."""
+    return dict(_counters)
+
+
+def counters_delta(snapshot: Dict[str, int]) -> Dict[str, int]:
+    """Counters that changed since `snapshot` (span counters attribute)."""
+    return {
+        name: value - snapshot.get(name, 0)
+        for name, value in _counters.items()
+        if value != snapshot.get(name, 0)
+    }
+
+
+def as_dict() -> dict:
+    """Counters + series summaries for the run report."""
+    out: dict = dict(_counters)
+    for name, vals in _series.items():
+        if vals:
+            out[name] = {
+                "n": len(vals),
+                "last": vals[-1],
+                "min": min(vals),
+                "max": max(vals),
+            }
+    return out
+
+
 def render() -> str:
     lines = ["STATS"]
     for name in sorted(_counters):

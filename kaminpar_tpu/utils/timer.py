@@ -13,6 +13,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
+from .. import telemetry
+
 
 @dataclass
 class TimerNode:
@@ -53,6 +55,12 @@ class Timer:
         self.root = TimerNode(self.root.name)
         self._stack = [self.root]
 
+    def idle(self) -> bool:
+        """True when no scope is open — i.e. not nested inside another
+        pipeline.  Callers that reset process-global observability state
+        (telemetry, stats) gate on this, matching reset()'s own guard."""
+        return len(self._stack) == 1
+
     @contextmanager
     def scope(self, name: str, sync=None):
         """Time a named scope. `sync` may be a value to block_until_ready on exit."""
@@ -61,19 +69,31 @@ class Timer:
             return
         node = self._stack[-1].child(name)
         self._stack.append(node)
+        tel = telemetry.enabled()
+        entry_state = _span_entry_state() if tel else None
         start = time.perf_counter()
         try:
             yield
         finally:
+            sync_s = None
             if sync is not None:
+                t_sync = time.perf_counter()
                 try:
                     import jax
 
                     jax.block_until_ready(sync)
                 except Exception:
                     pass
-            node.elapsed += time.perf_counter() - start
+                sync_s = time.perf_counter() - t_sync
+            end = time.perf_counter()
+            node.elapsed += end - start
             node.count += 1
+            if tel:
+                path = ".".join(n.name for n in self._stack[1:])
+                telemetry.record_span(
+                    name, path, start, end - start,
+                    **_span_exit_attrs(entry_state, sync_s),
+                )
             self._stack.pop()
 
     def elapsed(self, *path: str) -> float:
@@ -113,6 +133,49 @@ class Timer:
 
         rec(self.root, "")
         return " ".join(parts)
+
+
+def _span_entry_state() -> dict:
+    """Snapshot the per-scope baselines for telemetry span attributes
+    (only taken when telemetry is enabled; each section additionally
+    gates on its own utility being enabled)."""
+    state: dict = {}
+    from . import heap_profiler, statistics
+
+    if heap_profiler.profiling_enabled():
+        import tracemalloc
+
+        state["host_mem"] = tracemalloc.get_traced_memory()
+    if statistics.enabled():
+        state["counters"] = statistics.counters_snapshot()
+    return state
+
+
+def _span_exit_attrs(state: Optional[dict], sync_s: Optional[float]) -> dict:
+    attrs: dict = {}
+    if sync_s is not None:
+        attrs["sync_s"] = round(sync_s, 6)
+    if not state:
+        return attrs
+    from . import heap_profiler, statistics
+
+    host_mem = state.get("host_mem")
+    if host_mem is not None and heap_profiler.profiling_enabled():
+        import tracemalloc
+
+        cur0, peak0 = host_mem
+        _, peak1 = tracemalloc.get_traced_memory()
+        if peak1 > peak0:  # a new high-water mark was set inside the scope
+            attrs["host_peak_bytes"] = int(peak1 - cur0)
+        live = heap_profiler.live_device_bytes()
+        if live:
+            attrs["live_hbm_bytes"] = int(live)
+    counters0 = state.get("counters")
+    if counters0 is not None and statistics.enabled():
+        delta = statistics.counters_delta(counters0)
+        if delta:
+            attrs["counters"] = delta
+    return attrs
 
 
 GLOBAL_TIMER = Timer()

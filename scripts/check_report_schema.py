@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Validate a run-report JSON against the checked-in schema.
+
+CI / tooling backstop for the telemetry run report (`--report-json`,
+bench.py's embedded `report`): the schema lives at
+kaminpar_tpu/telemetry/run_report.schema.json and this validator is a
+dependency-free subset of JSON Schema (type / required / properties /
+items / enum) — enough to catch drift (renamed or dropped sections,
+type changes) without pulling in the `jsonschema` package.  A fast
+tier-1 test (tests/test_telemetry.py) generates a report and runs this
+validator, so schema and producer cannot drift apart silently.
+
+Usage:  python scripts/check_report_schema.py report.json [--schema S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, List
+
+DEFAULT_SCHEMA = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    os.pardir,
+    "kaminpar_tpu",
+    "telemetry",
+    "run_report.schema.json",
+)
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "number": (int, float),
+    "integer": int,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def _type_ok(value: Any, expected: str) -> bool:
+    py = _TYPES.get(expected)
+    if py is None:
+        return True  # unknown type keyword: don't fail on it
+    if expected in ("integer", "number") and isinstance(value, bool):
+        return False  # bool is an int subclass in Python; JSON disagrees
+    return isinstance(value, py)
+
+
+def validate_instance(instance: Any, schema: dict, path: str = "$") -> List[str]:
+    """Returns a list of human-readable violations (empty = valid)."""
+    errors: List[str] = []
+    expected = schema.get("type")
+    if expected is not None and not _type_ok(instance, expected):
+        errors.append(
+            f"{path}: expected {expected}, got {type(instance).__name__}"
+        )
+        return errors  # child checks would only cascade
+    enum = schema.get("enum")
+    if enum is not None and instance not in enum:
+        errors.append(f"{path}: value {instance!r} not in enum {enum}")
+    if isinstance(instance, dict):
+        for req in schema.get("required", []):
+            if req not in instance:
+                errors.append(f"{path}: missing required key {req!r}")
+        props = schema.get("properties", {})
+        for key, sub in props.items():
+            if key in instance:
+                errors.extend(
+                    validate_instance(instance[key], sub, f"{path}.{key}")
+                )
+    if isinstance(instance, list):
+        items = schema.get("items")
+        if items:
+            for i, item in enumerate(instance):
+                errors.extend(
+                    validate_instance(item, items, f"{path}[{i}]")
+                )
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate a kaminpar-tpu run report against the schema"
+    )
+    ap.add_argument("report", help="run-report JSON file (--report-json)")
+    ap.add_argument(
+        "--schema", default=DEFAULT_SCHEMA, help="schema file to check against"
+    )
+    args = ap.parse_args(argv)
+
+    with open(args.schema) as f:
+        schema = json.load(f)
+    with open(args.report) as f:
+        report = json.load(f)
+
+    errors = validate_instance(report, schema)
+    if errors:
+        for e in errors:
+            print(f"SCHEMA VIOLATION {e}", file=sys.stderr)
+        print(f"{args.report}: {len(errors)} violation(s)", file=sys.stderr)
+        return 1
+    print(f"{args.report}: OK (schema_version "
+          f"{report.get('schema_version')})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

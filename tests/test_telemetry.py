@@ -1,0 +1,399 @@
+"""Telemetry layer tests: span/event stream, exporters, satellites.
+
+Covers the observability contract: span nesting mirrors the timer tree,
+disabled mode records nothing, the Chrome-trace export conforms to the
+trace-event schema, the run report round-trips through JSON and passes
+the checked-in schema (scripts/check_report_schema.py — the tier-1
+schema-drift backstop), and the lane-gather / FM decision events fire on
+forced code paths.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import kaminpar_tpu as ktp
+from kaminpar_tpu import telemetry
+from kaminpar_tpu.graphs import factories
+from kaminpar_tpu.utils import timer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(_REPO, "scripts", "check_report_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# core stream
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_mode_is_noop():
+    t = timer.Timer()
+    with t.scope("a"):
+        with t.scope("b"):
+            pass
+    telemetry.event("should-not-record", x=1)
+    telemetry.annotate(k=16)
+    assert telemetry.spans() == []
+    assert telemetry.events() == []
+    assert telemetry.run_info() == {}
+    # the timer itself still recorded normally
+    assert t.elapsed("a") >= 0.0 and t.root.children["a"].count == 1
+
+
+def test_span_nesting_matches_timer_tree():
+    telemetry.enable()
+    t = timer.Timer()
+    with t.scope("a"):
+        with t.scope("b"):
+            pass
+        with t.scope("b"):  # second visit of the same tree node
+            pass
+    with t.scope("c"):
+        pass
+    spans = telemetry.spans()
+    paths = [s.path for s in spans]
+    # children close before parents (exit-order stream)
+    assert paths == ["a.b", "a.b", "a", "c"]
+    # every span path exists in the timer tree with matching totals
+    by_path = {}
+    for s in spans:
+        by_path.setdefault(s.path, []).append(s)
+    for path, ss in by_path.items():
+        node_elapsed = t.elapsed(*path.split("."))
+        assert node_elapsed >= sum(s.duration for s in ss) - 1e-6
+    # nesting: the child span lies within its parent's window
+    parent = next(s for s in spans if s.path == "a")
+    for child in (s for s in spans if s.path == "a.b"):
+        assert child.start >= parent.start - 1e-9
+        assert child.start + child.duration <= (
+            parent.start + parent.duration + 1e-6
+        )
+
+
+def test_reset_guard_when_nested():
+    telemetry.enable()
+    telemetry.event("outer")
+    assert timer.GLOBAL_TIMER.idle()
+    with timer.GLOBAL_TIMER.scope("open"):
+        assert not timer.GLOBAL_TIMER.idle()
+    assert len(telemetry.events()) == 1
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace exporter
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_conforms_to_trace_event_schema(tmp_path):
+    from kaminpar_tpu.telemetry.chrome_trace import write_chrome_trace
+
+    telemetry.enable()
+    t = timer.Timer()
+    with t.scope("phase"):
+        with t.scope("inner"):
+            pass
+    telemetry.event("decision", verdict="yes", value=np.int64(3))
+
+    out = tmp_path / "run.trace.json"
+    write_chrome_trace(str(out))
+    trace = json.loads(out.read_text())
+
+    assert isinstance(trace["traceEvents"], list) and trace["traceEvents"]
+    phases = {e["ph"] for e in trace["traceEvents"]}
+    assert "X" in phases and "i" in phases and "M" in phases
+    for e in trace["traceEvents"]:
+        assert isinstance(e["name"], str)
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+            assert isinstance(e["args"]["path"], str)
+        if e["ph"] == "i":
+            assert e["s"] in ("g", "p", "t")
+    # numpy attr values were coerced to JSON scalars
+    inst = next(e for e in trace["traceEvents"] if e["ph"] == "i")
+    assert inst["args"]["value"] == 3
+
+
+# ---------------------------------------------------------------------------
+# run report: end-to-end, JSON round trip, checked-in schema
+# ---------------------------------------------------------------------------
+
+
+def test_run_report_roundtrip_and_schema(tmp_path):
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH, write_run_report
+
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    telemetry.enable()
+    g = factories.make_grid_graph(16, 16)
+    p = ktp.KaMinPar("default")
+    p.set_output_level(OutputLevel.QUIET)
+    part = p.set_graph(g).compute_partition(k=4, epsilon=0.05, seed=1)
+    assert len(part) == g.n
+
+    out = tmp_path / "report.json"
+    report = write_run_report(str(out), extra_run={"io_seconds": 0.0})
+
+    # round-trips through json.loads unchanged
+    loaded = json.loads(out.read_text())
+    assert loaded == json.loads(json.dumps(report))
+
+    # headline content
+    assert loaded["schema_version"] == 1
+    assert loaded["run"]["k"] == 4
+    assert loaded["run"]["graph"]["n"] == g.n
+    assert loaded["result"]["cut"] >= 0
+    assert isinstance(loaded["result"]["feasible"], bool)
+    assert "partitioning" in loaded["scope_tree"]
+    assert loaded["comm"]["caveat"]
+    assert loaded["lane_gather"]["mode"] in (
+        "not-probed", "probed", "forced-on", "opt-out"
+    )
+
+    # validates against the checked-in schema (drift backstop)
+    checker = _load_checker()
+    schema = json.loads(open(SCHEMA_PATH).read())
+    errors = checker.validate_instance(loaded, schema)
+    assert errors == [], errors
+    # and through the CLI entry point
+    assert checker.main([str(out)]) == 0
+
+
+def test_check_report_schema_rejects_drift(tmp_path):
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH
+
+    checker = _load_checker()
+    schema = json.loads(open(SCHEMA_PATH).read())
+    broken = {"schema_version": "one", "run": {}}  # wrong type + missing keys
+    errors = checker.validate_instance(broken, schema)
+    assert any("schema_version" in e for e in errors)
+    assert any("missing required" in e for e in errors)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(broken))
+    assert checker.main([str(bad)]) == 1
+
+
+def test_cli_trace_and_report(tmp_path):
+    """`--trace-out` + `--report-json` on a sample graph produce a valid
+    trace-event file and a schema-conforming report (acceptance path)."""
+    from kaminpar_tpu import cli
+
+    graph_path = tmp_path / "g.metis"
+    g = factories.make_grid_graph(12, 12)
+    from kaminpar_tpu.io.metis import write_metis
+
+    write_metis(g, str(graph_path))
+    trace_path = tmp_path / "t.json"
+    report_path = tmp_path / "r.json"
+    rc = cli.main(
+        [
+            str(graph_path), "-k", "2", "-q",
+            "--trace-out", str(trace_path),
+            "--report-json", str(report_path),
+        ]
+    )
+    assert rc == 0
+    trace = json.loads(trace_path.read_text())
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+    report = json.loads(report_path.read_text())
+    checker = _load_checker()
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH
+
+    schema = json.loads(open(SCHEMA_PATH).read())
+    assert checker.validate_instance(report, schema) == []
+    assert report["result"]["cut"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# decision events on forced code paths
+# ---------------------------------------------------------------------------
+
+
+def test_lane_gather_force_enable_event(monkeypatch):
+    from kaminpar_tpu.ops import lane_gather
+
+    telemetry.enable()
+    monkeypatch.setenv("KAMINPAR_TPU_LANE_GATHER", "1")
+    monkeypatch.setattr(lane_gather, "_PROBE_STATUS", {"mode": "not-probed"})
+
+    import jax.numpy as jnp
+
+    class G:
+        pass
+
+    g = G()
+    g.n_pad = 128
+    g.dst = jnp.asarray(np.arange(64) % 128, dtype=jnp.int32)
+    g.src = jnp.asarray(np.arange(64) % 128, dtype=jnp.int32)
+    g.edge_w = jnp.ones(64, dtype=jnp.int32)
+    plans = lane_gather.maybe_edge_plans(g)
+    # force-enable skips the size gate and the timing race, but the
+    # platform/correctness gate still applies — on the CPU test backend
+    # the Mosaic kernel is unavailable, so routing stays off (no crash)
+    assert plans is None
+    events = telemetry.events("lane-gather-probe")
+    assert len(events) == 1 and events[0].attrs["verdict"] == "forced-on"
+    assert events[0].attrs["supported"] is False
+    assert "reason" in events[0].attrs
+    status = lane_gather.probe_status()
+    assert status["mode"] == "forced-on"
+    assert status["env_override"] == "1"
+    # the decision is cached: a second call emits no duplicate event
+    assert lane_gather.maybe_edge_plans(g) is None
+    assert len(telemetry.events("lane-gather-probe")) == 1
+
+
+def test_lane_gather_opt_out_status(monkeypatch):
+    from kaminpar_tpu.ops import lane_gather
+
+    monkeypatch.setenv("KAMINPAR_TPU_LANE_GATHER", "0")
+    monkeypatch.setattr(lane_gather, "_PROBE_STATUS", {"mode": "not-probed"})
+
+    class G:
+        pass
+
+    g = G()
+    assert lane_gather.maybe_edge_plans(g) is None
+    assert lane_gather.probe_status()["mode"] == "opt-out"
+
+
+def test_lane_gather_probe_event_records_verdict(monkeypatch):
+    from kaminpar_tpu.ops import lane_gather
+
+    telemetry.enable()
+    monkeypatch.delenv("KAMINPAR_TPU_LANE_GATHER", raising=False)
+    lane_gather.lane_gather_supported.cache_clear()
+    try:
+        supported = lane_gather.lane_gather_supported()
+        # CPU test platform: the Mosaic kernel is unavailable
+        assert supported is False
+        events = telemetry.events("lane-gather-probe")
+        assert len(events) == 1
+        assert events[0].attrs["verdict"] == "disabled"
+        assert "reason" in events[0].attrs
+        assert lane_gather.probe_status()["mode"] == "probed"
+    finally:
+        lane_gather.lane_gather_supported.cache_clear()
+
+
+def test_fm_refusal_sentinel_and_event():
+    from kaminpar_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable (no compiler)")
+    telemetry.enable()
+    g = factories.make_path(8)
+    k = 0x10000 + 1  # above the sparse engine's 16-bit tag limit
+    part = np.arange(8, dtype=np.int32) % 4
+    max_bw = np.full(k, 100, dtype=np.int64)
+    fm_ctx = ktp.context_from_preset("default").refinement.fm
+    ret = native.fm_refine(
+        g, part, k, max_bw, fm_ctx, seed=0, force_sparse=True
+    )
+    assert ret == native.FM_REFUSED
+    events = telemetry.events("fm-refused")
+    assert len(events) == 1
+    assert events[0].attrs["k"] == k
+
+
+def test_fm_runs_normally_below_limit():
+    from kaminpar_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable (no compiler)")
+    telemetry.enable()
+    g = factories.make_grid_graph(8, 8)
+    rng = np.random.default_rng(0)
+    part = rng.integers(0, 4, g.n).astype(np.int32)
+    max_bw = np.full(4, g.n, dtype=np.int64)
+    fm_ctx = ktp.context_from_preset("default").refinement.fm
+    ret = native.fm_refine(g, part, 4, max_bw, fm_ctx, seed=0)
+    assert ret is not None and ret >= 0
+    assert telemetry.events("fm-refused") == []
+
+
+# ---------------------------------------------------------------------------
+# comm accounting: shape keying, retrace events, caveat
+# ---------------------------------------------------------------------------
+
+
+def test_comm_accounting_shape_keyed_with_caveat():
+    from kaminpar_tpu.parallel import mesh
+
+    telemetry.enable()
+    mesh.reset_comm_log()
+    try:
+        with mesh.comm_phase("phase-a"):
+            mesh.account_collective("psum(x)", 128, shape=(4, 8))
+            mesh.account_collective("psum(x)", 128, shape=(4, 8))
+            mesh.account_collective("psum(x)", 64, shape=(2, 8))  # retrace
+        records = mesh.comm_records()
+        assert len(records) == 2  # one row per traced shape
+        by_shape = {tuple(r["shape"]): r for r in records}
+        assert by_shape[(4, 8)]["traced_calls"] == 2
+        assert by_shape[(4, 8)]["payload_bytes_per_device"] == 256
+        assert by_shape[(2, 8)]["traced_calls"] == 1
+        table = mesh.comm_table()
+        assert "TRACE time" in table or "cache" in table  # the caveat
+        traces = telemetry.events("jit-trace")
+        assert len(traces) == 2
+        assert [e.attrs["retrace"] for e in traces] == [False, True]
+    finally:
+        mesh.reset_comm_log()
+
+
+def test_dist_run_populates_comm_records():
+    from kaminpar_tpu.parallel import dKaMinPar, make_mesh, mesh
+
+    from kaminpar_tpu.parallel.dist_context import (
+        create_dist_context_by_preset_name,
+    )
+
+    telemetry.enable()
+    mesh.reset_comm_log()
+    try:
+        g = factories.make_grid_graph(32, 32)
+        ctx = create_dist_context_by_preset_name("default")
+        # force a distributed coarsening level so collectives trace
+        ctx.shm.coarsening.contraction_limit = 50
+        ctx.replication_min_nodes_per_device = 0
+        solver = dKaMinPar(ctx, mesh=make_mesh(2))
+        try:
+            part = solver.set_graph(g).compute_partition(k=2, seed=1)
+        except TypeError as e:
+            # older jax: shard_map lacks check_vma — the whole dist layer
+            # is unavailable in this environment, not a telemetry defect
+            pytest.skip(f"dist layer unavailable on this jax: {e}")
+        assert len(part) == g.n
+        from kaminpar_tpu.telemetry.report import build_run_report
+
+        report = build_run_report()
+        assert report["run"].get("devices") == 2
+        assert report["result"]["cut"] >= 0
+        # at least one collective was traced and attributed to a phase
+        assert report["comm"]["records"], report["comm"]
+    finally:
+        mesh.reset_comm_log()
