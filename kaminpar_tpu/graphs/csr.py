@@ -98,10 +98,10 @@ def shape_floors() -> tuple[int, int]:
     of extra warm work per call for ~a minute of compile per avoided
     bucket.  CPU (tests, fallback) keeps small floors so tiny unit-test
     graphs stay tiny."""
-    import jax
+    from ..utils import platform
 
     try:
-        backend = jax.default_backend()
+        backend = platform.default_backend()
     except Exception:
         backend = "cpu"
     if backend == "cpu":

@@ -1,0 +1,49 @@
+"""tpulint — codebase-specific AST static analysis for the JAX pipeline.
+
+The round-5 verdict and advisor findings were all *statically visible*
+in the Python source before they cost a round: the C-ABI driver eagerly
+initialized a TPU backend despite ``JAX_PLATFORMS=cpu`` and hung the
+suite 600 s; trace-time comm accounting silently under/over-counted;
+int32 tags and accumulators capped scale; routed-gather plans could
+inflate without bound on skewed graphs.  tpulint encodes each incident
+class as a rule so future perf PRs cannot silently reintroduce them:
+
+  R1  host-sync primitives (``.item()``, ``int()/float()/bool()`` of jax
+      values, ``np.asarray`` of device values, Python ``if`` on traced
+      expressions) inside functions reachable from ``jax.jit``-decorated
+      code or inside telemetry span scopes;
+  R2  eager/ungated device or backend queries — ``jax.devices()`` et al.
+      must go through ``kaminpar_tpu.utils.platform`` (the lazy,
+      ``JAX_PLATFORMS``-respecting gate), and must never run at import
+      time;
+  R3  32-bit accumulation (``dtype=...int32`` on cumsum/sum/segment_sum
+      class reductions, int32 astype of reduction results) in ``ops/``,
+      ``graphs/``, ``parallel/`` — the ``dtypes.py`` 64-bit policy owns
+      accumulator widths;
+  R4  retrace hygiene — jit wrappers constructed inside loops or around
+      fresh lambdas retrace/recompile per evaluation;
+  R5  routed-gather plan builders must check the plan against a slot cap
+      (``plan_within_cap`` / ``num_slots``) before keeping it.
+
+Usage:  ``python -m kaminpar_tpu.lint [paths...]`` — see ``--help`` and
+docs/static_analysis.md.  Findings are suppressible per line with
+``# tpulint: disable=R1[,R2...]`` (or per file with ``disable-file=``)
+and ratcheted via the checked-in baseline
+``scripts/tpulint_baseline.json``.
+"""
+
+from __future__ import annotations
+
+from .engine import (  # noqa: F401
+    Finding,
+    LintConfig,
+    RULES,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from .baseline import (  # noqa: F401
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,157 @@
+"""tpulint CLI: ``python -m kaminpar_tpu.lint [paths...]``.
+
+Exit codes: 0 clean (vs baseline), 1 new findings, 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .baseline import diff_against_baseline, load_baseline, write_baseline
+from .engine import RULES, LintConfig, lint_paths
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "scripts", "tpulint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kaminpar_tpu.lint",
+        description=(
+            "tpulint: AST hot-path hazard checker for the kaminpar-tpu "
+            "JAX pipeline (rules R1-R5; see docs/static_analysis.md)"
+        ),
+    )
+    ap.add_argument(
+        "paths", nargs="*", default=None,
+        help="files or directories to lint (default: the kaminpar_tpu "
+        "package)",
+    )
+    ap.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help=f"baseline JSON of accepted findings (default: "
+        f"{os.path.relpath(DEFAULT_BASELINE, _REPO_ROOT)} when present)",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding (ignore the baseline)",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current findings as the new baseline (use only "
+        "to SHRINK the file — the ratchet policy)",
+    )
+    ap.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule subset, e.g. R2,R3",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in RULES.items():
+            print(f"{rid}  {desc}")
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO_ROOT, "kaminpar_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    config = LintConfig()
+    if args.select:
+        wanted = tuple(
+            r.strip().upper() for r in args.select.split(",") if r.strip()
+        )
+        unknown = [r for r in wanted if r not in RULES]
+        if unknown:
+            print(f"tpulint: unknown rule(s): {unknown}", file=sys.stderr)
+            return 2
+        config.rules = wanted
+
+    findings = lint_paths(paths, config)
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if os.path.exists(DEFAULT_BASELINE) else None
+    )
+
+    if args.write_baseline:
+        # a rule or path subset would silently TRUNCATE the baseline to
+        # that subset's findings, breaking every full run afterwards
+        if args.select:
+            print(
+                "tpulint: refusing --write-baseline with --select "
+                "(a rule subset would truncate the baseline)",
+                file=sys.stderr,
+            )
+            return 2
+        default_pkg = os.path.abspath(os.path.join(_REPO_ROOT, "kaminpar_tpu"))
+        norm = sorted(os.path.abspath(p).rstrip(os.sep) for p in paths)
+        if args.baseline is None and norm != [default_pkg]:
+            print(
+                "tpulint: refusing to overwrite the default baseline "
+                "from a path subset; pass --baseline PATH explicitly",
+                file=sys.stderr,
+            )
+            return 2
+        out = args.baseline or DEFAULT_BASELINE
+        write_baseline(out, findings)
+        print(f"tpulint: wrote {len(findings)} entries to {out}")
+        return 0
+
+    if args.no_baseline or baseline_path is None:
+        new, stale = findings, []
+    else:
+        try:
+            entries = load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tpulint: bad baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        diff = diff_against_baseline(findings, entries)
+        new, stale = diff.new, diff.stale
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "new": [f.to_dict() for f in new],
+                    "total": len(findings),
+                    "stale_baseline_entries": len(stale),
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in new:
+            print(f.render())
+        suffix = "" if args.no_baseline or baseline_path is None else (
+            f" ({len(findings) - len(new)} baselined)"
+        )
+        print(
+            f"tpulint: {len(new)} new finding(s), {len(findings)} "
+            f"total{suffix}"
+        )
+        if stale:
+            print(
+                f"tpulint: ratchet: {len(stale)} stale baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} no longer fire — "
+                "shrink the baseline with --write-baseline"
+            )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""tpulint baseline: land clean, ratchet down.
+
+The baseline is a checked-in JSON multiset of accepted findings
+(``scripts/tpulint_baseline.json``).  A lint run fails only on findings
+NOT in the baseline, so the tool gates new hazards from day one while
+the accepted backlog is burned down; stale entries (baselined findings
+that no longer fire) are reported so the file can be regenerated
+smaller — the ratchet direction is enforced socially (never regenerate
+to a bigger file; docs/static_analysis.md#baseline-ratchet).
+
+Entries match on ``(path, rule, code)`` where ``code`` is the stripped
+source line — stable under unrelated edits that shift line numbers, the
+failure mode that makes line-keyed baselines rot instantly.  Line
+numbers are stored for human readers only.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+from .engine import Finding
+
+BASELINE_VERSION = 1
+
+
+def _key(path: str, rule: str, code: str) -> Tuple[str, str, str]:
+    return (path, rule, code)
+
+
+@dataclass
+class BaselineDiff:
+    new: List[Finding] = field(default_factory=list)  # fail the run
+    accepted: List[Finding] = field(default_factory=list)  # in baseline
+    stale: List[dict] = field(default_factory=list)  # baselined, gone
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {data.get('version')!r} "
+            f"(expected {BASELINE_VERSION})"
+        )
+    return list(data.get("entries", []))
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    entries = [
+        {
+            "path": f.path,
+            "rule": f.rule,
+            "line": f.line,
+            "code": f.code,
+            "message": f.message,
+        }
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {
+                "version": BASELINE_VERSION,
+                "tool": "tpulint",
+                "note": (
+                    "accepted findings; regenerate ONLY to shrink "
+                    "(python -m kaminpar_tpu.lint --write-baseline)"
+                ),
+                "entries": entries,
+            },
+            fh,
+            indent=2,
+        )
+        fh.write("\n")
+
+
+def diff_against_baseline(findings: List[Finding],
+                          entries: List[dict]) -> BaselineDiff:
+    budget = Counter(
+        _key(e["path"], e["rule"], e.get("code", "")) for e in entries
+    )
+    diff = BaselineDiff()
+    for f in findings:
+        k = _key(f.path, f.rule, f.code)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            diff.accepted.append(f)
+        else:
+            diff.new.append(f)
+    for e in entries:
+        k = _key(e["path"], e["rule"], e.get("code", ""))
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            diff.stale.append(e)
+    return diff

@@ -1,0 +1,330 @@
+"""tpulint core: per-module AST analysis, suppressions, file walking.
+
+One analyzer instance handles one module.  The rule logic lives in
+``rules.py``; this module owns the shared machinery every rule needs:
+
+  * import alias resolution (``jnp`` -> ``jax.numpy``) so rules match
+    fully-qualified names regardless of local import style;
+  * the module-local jit call graph (which functions are
+    ``jax.jit``-decorated or transitively called from one) for R1;
+  * lexical context stacks (function nesting, loop depth, span-scope
+    ``with`` blocks) maintained during a single AST walk;
+  * ``# tpulint: disable=``/``disable-file=`` suppression parsing.
+
+The analysis is intentionally module-local (no cross-file call graph):
+it trades recall for zero-setup speed and deterministic findings, and
+the baseline absorbs the difference.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "R1": "host-sync primitive in jit-reachable code or a span scope",
+    "R2": "eager/ungated device or backend query (use utils.platform)",
+    "R3": "32-bit accumulation where the dtypes.py 64-bit policy applies",
+    "R4": "jit wrapper constructed per iteration/evaluation (retrace)",
+    "R5": "routed-gather plan built without a slot cap check",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9]+(?:\s*,\s*[A-Za-z0-9]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative, posix separators
+    rule: str
+    line: int
+    col: int
+    symbol: str  # enclosing function ('<module>' at top level)
+    message: str
+    code: str  # stripped source line, the churn-stable baseline key
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.symbol}] {self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "rule": self.rule,
+            "line": self.line,
+            "col": self.col,
+            "symbol": self.symbol,
+            "message": self.message,
+            "code": self.code,
+        }
+
+
+@dataclass
+class LintConfig:
+    """Knobs the CLI and tests tune; defaults match the package layout."""
+
+    # files allowed to call jax device/backend queries directly (the gate)
+    gate_suffixes: Tuple[str, ...] = ("utils/platform.py",)
+    # R3 fires only under these directory names (plus lint fixtures)
+    r3_dirs: Tuple[str, ...] = ("ops", "graphs", "parallel", "lint_fixtures")
+    # rules to run (all by default)
+    rules: Tuple[str, ...] = tuple(RULES)
+
+
+def _parse_suppressions(source: str) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    """(per-line rule sets, file-wide rule set); 'all' disables everything.
+
+    A ``# tpulint: disable=`` on a comment-only line applies to the next
+    code line (so long statements can carry their justification above)."""
+    per_line: Dict[int, Set[str]] = {}
+    per_file: Set[str] = set()
+    lines = source.splitlines()
+    for lineno, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        kind, rules = m.groups()
+        names = {r.strip().upper() for r in rules.split(",") if r.strip()}
+        if kind == "disable-file":
+            per_file |= names
+            continue
+        target = lineno
+        if line.lstrip().startswith("#"):
+            # comment-only line: attach to the next code line
+            nxt = lineno + 1
+            while nxt <= len(lines) and lines[nxt - 1].lstrip().startswith("#"):
+                nxt += 1
+            target = nxt
+        per_line.setdefault(target, set()).update(names)
+    return per_line, per_file
+
+
+class ModuleContext:
+    """Everything rules need to know about one parsed module."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module,
+                 config: LintConfig) -> None:
+        self.path = path
+        self.source_lines = source.splitlines()
+        self.tree = tree
+        self.config = config
+        self.aliases = _collect_aliases(tree)
+        self.jit_reachable = _jit_reachable_functions(tree, self)
+        self.is_gate_module = any(
+            path.endswith(sfx) for sfx in config.gate_suffixes
+        )
+        parts = set(path.replace("\\", "/").split("/"))
+        self.r3_applies = bool(parts & set(config.r3_dirs))
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a Name/Attribute chain with aliases resolved;
+        None for anything that is not a plain chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.source_lines):
+            return self.source_lines[lineno - 1].strip()
+        return ""
+
+
+def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+_JIT_WRAPPERS = ("jax.jit", "jax.pmap")
+
+
+def _is_jit_decorator(dec: ast.AST, ctx: "ModuleContext") -> bool:
+    """@jax.jit, @jit (from jax), @functools.partial(jax.jit, ...),
+    @jax.jit(...) — anything that makes the function a trace root."""
+    q = ctx.qualname(dec)
+    if q in _JIT_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        fq = ctx.qualname(dec.func)
+        if fq in _JIT_WRAPPERS:
+            return True
+        if fq in ("functools.partial", "partial") and dec.args:
+            return ctx.qualname(dec.args[0]) in _JIT_WRAPPERS
+    return False
+
+
+def _jit_reachable_functions(tree: ast.Module, ctx: "ModuleContext"
+                             ) -> Set[ast.AST]:
+    """Function nodes that are jit roots or transitively called from one
+    (module-local, by simple name).  Nested defs inherit reachability
+    from their enclosing function."""
+    funcs: List[ast.AST] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    by_name: Dict[str, List[ast.AST]] = {}
+    for f in funcs:
+        by_name.setdefault(f.name, []).append(f)
+
+    parent: Dict[ast.AST, ast.AST] = {}
+    for f in funcs:
+        for inner in ast.walk(f):
+            if inner is not f and isinstance(
+                inner, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and inner not in parent:
+                parent[inner] = f
+
+    roots: Set[ast.AST] = {
+        f for f in funcs
+        if any(_is_jit_decorator(d, ctx) for d in f.decorator_list)
+    }
+    # module-level `g = jax.jit(f)` marks f as a root
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and ctx.qualname(node.func) in _JIT_WRAPPERS:
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    roots.update(by_name.get(arg.id, []))
+
+    calls: Dict[ast.AST, Set[str]] = {}
+    for f in funcs:
+        names: Set[str] = set()
+        for inner in ast.walk(f):
+            if isinstance(inner, ast.Call) and isinstance(inner.func, ast.Name):
+                names.add(inner.func.id)
+        calls[f] = names
+
+    reachable: Set[ast.AST] = set()
+    work = list(roots)
+    while work:
+        f = work.pop()
+        if f in reachable:
+            continue
+        reachable.add(f)
+        for name in calls.get(f, ()):
+            for g in by_name.get(name, []):
+                if g not in reachable:
+                    work.append(g)
+    # nested defs of reachable functions trace with them
+    changed = True
+    while changed:
+        changed = False
+        for child, par in parent.items():
+            if par in reachable and child not in reachable:
+                reachable.add(child)
+                work.append(child)
+                changed = True
+        while work:
+            f = work.pop()
+            for name in calls.get(f, ()):
+                for g in by_name.get(name, []):
+                    if g not in reachable:
+                        reachable.add(g)
+                        work.append(g)
+                        changed = True
+    return reachable
+
+
+def _repo_relative(path: str) -> str:
+    """Stable posix-style path for findings/baselines: relative to the
+    repo root (the directory holding the kaminpar_tpu package) when the
+    file is under it, else relative to cwd, else absolute."""
+    ap = os.path.abspath(path)
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    repo_root = os.path.dirname(pkg_root)
+    for base in (repo_root, os.getcwd()):
+        if ap.startswith(base.rstrip(os.sep) + os.sep):
+            return os.path.relpath(ap, base).replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def lint_source(source: str, path: str,
+                config: Optional[LintConfig] = None) -> List[Finding]:
+    """Lint one module's source text (path is used for reporting and
+    path-scoped rules only)."""
+    from . import rules as rules_mod
+
+    config = config or LintConfig()
+    rel = _repo_relative(path)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                path=rel, rule="E0", line=int(e.lineno or 0), col=0,
+                symbol="<module>",
+                message=f"syntax error: {e.msg}",
+                code="",
+            )
+        ]
+    ctx = ModuleContext(rel, source, tree, config)
+    per_line, per_file = _parse_suppressions(source)
+
+    raw = rules_mod.run_rules(ctx)
+    findings: List[Finding] = []
+    for f in raw:
+        # E0 (syntax error) always passes the rule filter
+        if f.rule not in config.rules and f.rule != "E0":
+            continue
+        if "ALL" in per_file or f.rule in per_file:
+            continue
+        line_rules = per_line.get(f.line, set())
+        if "ALL" in line_rules or f.rule in line_rules:
+            continue
+        findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, config: Optional[LintConfig] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path, config)
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               config: Optional[LintConfig] = None) -> List[Finding]:
+    """Lint every .py file under the given paths (files or directories)."""
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        findings.extend(lint_file(path, config))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -33,11 +33,26 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+def sanitize_flags() -> list:
+    """Extra compile flags from KMP_SANITIZE (e.g. 'address,undefined').
+
+    The sanitizer build mode for the native layer: frame pointers and
+    debug info stay in, optimization drops to -O1 so reports map to
+    source lines.  scripts/run_native_sanitized.sh drives a full
+    rebuild + test run under it (LD_PRELOAD of libasan included)."""
+    san = os.environ.get("KMP_SANITIZE", "").strip()
+    if not san:
+        return []
+    return [f"-fsanitize={san}", "-fno-omit-frame-pointer", "-g", "-O1"]
+
+
 def _build() -> Optional[str]:
     h = hashlib.sha256()
     for src in _SRCS:
         with open(src, "rb") as f:
             h.update(f.read())
+    # sanitized and plain builds must not share a cache slot
+    h.update(",".join(sanitize_flags()).encode())
     tag = h.hexdigest()[:16]
     out = os.path.join(_DIR, f"libkmpnative-{tag}.so")
     if os.path.exists(out):
@@ -61,6 +76,7 @@ def _build() -> Optional[str]:
             ["g++", "-O3", "-shared", "-fPIC", "-std=c++20", "-pthread",
              *(["-mssse3"] if platform.machine() in
                ("x86_64", "AMD64", "i686") else []),
+             *sanitize_flags(),
              *_SRCS, "-o", tmp_path],
             check=True,
             capture_output=True,

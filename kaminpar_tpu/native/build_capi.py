@@ -19,6 +19,19 @@ import sysconfig
 def build(out_dir: str | None = None) -> str:
     src_dir = os.path.dirname(os.path.abspath(__file__))
     src = os.path.join(src_dir, "ckaminpar.cpp")
+    from . import sanitize_flags
+
+    if sanitize_flags() and out_dir is None:
+        # the fixed link name (-lckaminpar_tpu) cannot key the sanitize
+        # mode, so a sanitized build must never overwrite the package
+        # dir's plain artifact — a later plain consumer would abort at
+        # load (libasan not preloaded) with nothing in the filename to
+        # explain why
+        raise ValueError(
+            "KMP_SANITIZE is set: pass an explicit output dir so the "
+            "sanitized libckaminpar_tpu.so cannot shadow the plain one "
+            "(scripts/run_native_sanitized.sh builds into a tmp dir)"
+        )
     out_dir = out_dir or src_dir
     out = os.path.join(out_dir, "libckaminpar_tpu.so")
 
@@ -28,7 +41,9 @@ def build(out_dir: str | None = None) -> str:
         "VERSION"
     )
     cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        *sanitize_flags(),
+        src,
         f"-I{include}",
         f"-L{libdir}",
         f"-lpython{version}",

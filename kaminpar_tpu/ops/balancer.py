@@ -155,6 +155,7 @@ def overload_balance_round(
     accept = accept_out & accept_in
 
     new_part = jnp.where(accept, target, part)
+    # moved-node count <= n, ID domain  # tpulint: disable=R3
     return new_part, jnp.sum(accept, dtype=jnp.int32)
 
 
@@ -264,6 +265,7 @@ def underload_balance(
         )
         accept = accept_out & accept_in
         new_part = jnp.where(accept, target, part)
+        # moved-node count <= n, ID domain  # tpulint: disable=R3
         return (i + 1, new_part, jnp.sum(accept, dtype=jnp.int32))
 
     def cond(state):

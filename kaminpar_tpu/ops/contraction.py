@@ -83,6 +83,7 @@ def _contract_part1(graph: DeviceGraph, labels: jax.Array, plans=None):
     )
     rank = jnp.cumsum(used) - used
     cmap = jnp.where(is_real, rank[lab], -1).astype(jnp.int32)
+    # coarse-node count <= n, ID domain  # tpulint: disable=R3
     c_n = jnp.sum(used, dtype=jnp.int32)
 
     # coarse node weights over fine slots
@@ -118,6 +119,7 @@ def _contract_part1(graph: DeviceGraph, labels: jax.Array, plans=None):
 
     cu_g, cv_g, w_g = aggregate_by_key(cu, cv, w)
     group_valid = (cu_g >= 0) & (cu_g < sentinel)
+    # coarse-edge count <= m_pad < 2^31 (device layout)  # tpulint: disable=R3
     c_m = jnp.sum(group_valid, dtype=jnp.int32)
     return cmap, c_n, c_node_w, cu_g, cv_g, w_g, group_valid, c_m
 
@@ -160,6 +162,8 @@ def _contract_part2(
     # nodes are < c_n so zero counts beyond c_n
     counts = jnp.where(jnp.arange(n_pad_c) < c_n, counts, 0)
     row_ptr = jnp.concatenate(
+        # row_ptr tops out at m_pad < 2^31 (device layout contract);
+        # host xadj stays int64  # tpulint: disable=R3
         [jnp.zeros(1, dtype=jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
     )
 

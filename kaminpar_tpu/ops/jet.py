@@ -248,6 +248,8 @@ def _jet_iteration(
     def _conn_step(conn_, before, after):
         if dslots is None:
             return _full_ratings(graph, after, k, plans)
+        # degree total <= m_pad < 2^31 (device layout)
+        # tpulint: disable=R3
         changed_edges = jnp.sum(
             jnp.where(before != after, graph.degrees, 0), dtype=jnp.int32
         )

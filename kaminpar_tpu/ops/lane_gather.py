@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -149,10 +150,26 @@ def _scatter_plan(key_s, pos_s, qloc_s, chunk_start, region_off, H):
     return q, inv
 
 
+class PlanBlowupError(ValueError):
+    """build_gather_plan aborted: the routed plan would exceed max_slots.
+
+    Raised BEFORE the H*128-wide q/inv arrays are materialized, so a
+    hub-skewed level can be rejected without first allocating the very
+    blowup the cap exists to prevent."""
+
+    def __init__(self, num_slots: int, max_slots: int) -> None:
+        self.num_slots = num_slots
+        self.max_slots = max_slots
+        super().__init__(
+            f"routed plan needs {num_slots} slots > cap {max_slots}"
+        )
+
+
 def build_gather_plan(
     idx,
     table_len: int,
     chunk_rows: int = DEFAULT_CHUNK_ROWS,
+    max_slots: Optional[int] = None,
 ) -> GatherPlan:
     """Plan lane-routed gathers from the static index array ``idx``.
 
@@ -162,6 +179,10 @@ def build_gather_plan(
     lane-count histogram), but cheap: one m-wide sort, two m-wide
     scatters, and a 1 KiB histogram readback — amortized over every
     round at the level.
+
+    With ``max_slots`` the plan aborts with PlanBlowupError as soon as
+    the routed height is known (after the histogram, before any
+    slot-wide array exists) when it would exceed the cap.
     """
     if table_len % L:
         raise ValueError(f"table_len {table_len} not a multiple of {L}")
@@ -191,9 +212,12 @@ def build_gather_plan(
     ]
     if sum(h_c) == 0:
         h_c[0] = S  # degenerate m=0 plan: one all-pad tile
+    # routed-row offsets <= H < 2^31 by construction  # tpulint: disable=R3
     region_off = np.concatenate([[0], np.cumsum(h_c)[:-1]]).astype(np.int32)
     chunk_start = bounds[: C * L : L].astype(np.int32)
     H = int(sum(h_c))
+    if max_slots is not None and H * L > max_slots:
+        raise PlanBlowupError(H * L, int(max_slots))
 
     q, inv = _scatter_plan(
         key_s,
@@ -287,6 +311,32 @@ INTERPRET = False
 # plan never pays for itself (matches ops/lp.DELTA_MIN_EDGE_SLOTS).
 MIN_EDGE_SLOTS = 1 << 22
 
+# Routed-slot blowup cap: per-chunk heights round each chunk's max
+# per-lane count up to S, so one high in-degree hub (RMAT-typical)
+# can inflate H*128 to a multiple of m — five i32 arrays of that width
+# pin HBM per cached level and every rating sort then runs over the
+# inflated slot count (ADVICE round 5 medium).  Plans wider than this
+# multiple of the index count are discarded in favor of the XLA gather.
+PLAN_MAX_SLOT_RATIO = 2.0
+
+
+def slot_cap(m: int) -> Optional[int]:
+    """The num_slots budget for an m-wide index array
+    (PLAN_MAX_SLOT_RATIO * m); None = uncapped (tests lift the ratio
+    to inf).  The single source of the cap for plan_within_cap and
+    edge_plans' build_gather_plan(max_slots=...) abort."""
+    import math
+
+    ratio = PLAN_MAX_SLOT_RATIO * max(int(m), 1)
+    return None if math.isinf(ratio) or math.isnan(ratio) else int(ratio)
+
+
+def plan_within_cap(plan: GatherPlan, m: int) -> bool:
+    """True when the routed plan's slot count is affordable for an
+    m-wide index array (num_slots <= slot_cap(m))."""
+    cap = slot_cap(m)
+    return cap is None or plan.num_slots <= cap
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclass(frozen=True)
@@ -321,21 +371,58 @@ def clear_plan_cache() -> None:
     _PLAN_CACHE.clear()
 
 
-def edge_plans(graph) -> EdgePlans:
-    """The routed edge views of a DeviceGraph level (cached)."""
+def edge_plans(graph):
+    """The routed edge views of a DeviceGraph level (cached), or None
+    when the plan blew past PLAN_MAX_SLOT_RATIO and the level must use
+    the XLA-gather fallback.  The verdict (and the pad-overhead ratio)
+    is emitted as a `lane-gather-plan` telemetry event either way, so
+    run reports show how much slot padding each routed level carries."""
     key = (id(graph.dst), graph.dst.shape[0], graph.n_pad)
     hit = _PLAN_CACHE.get(key)
     if hit is not None and hit[0] is graph.dst:
         return hit[1]
-    plan = build_gather_plan(graph.dst, graph.n_pad)
-    n_pad = graph.n_pad
-    owner_key = route_codata(plan, graph.src, n_pad - 1)
-    pack = EdgePlans(
-        plan=plan,
-        owner_key=owner_key,
-        src_idx=jnp.clip(owner_key, 0, n_pad - 1),
-        edge_w=route_codata(plan, graph.edge_w, 0),
-    )
+    m = int(graph.dst.shape[0])
+    cap = slot_cap(m)
+    from .. import telemetry
+
+    try:
+        # the cap aborts inside the builder, BEFORE the H*128-wide
+        # q/inv arrays exist — a hub-skewed level must not allocate
+        # the very blowup it is being rejected for
+        plan = build_gather_plan(graph.dst, graph.n_pad, max_slots=cap)
+    except PlanBlowupError as e:
+        pad_overhead = e.num_slots / max(m, 1)
+        telemetry.event(
+            "lane-gather-plan",
+            m=m,
+            num_slots=e.num_slots,
+            pad_overhead=round(pad_overhead, 4),
+            capped=True,
+        )
+        from ..utils.logger import log_progress
+
+        log_progress(
+            f"lane-gather: plan discarded (num_slots={e.num_slots} > "
+            f"{PLAN_MAX_SLOT_RATIO}x m={m}, pad overhead "
+            f"{pad_overhead:.2f}x); falling back to the XLA gather"
+        )
+        pack = None
+    else:
+        telemetry.event(
+            "lane-gather-plan",
+            m=m,
+            num_slots=plan.num_slots,
+            pad_overhead=round(plan.num_slots / max(m, 1), 4),
+            capped=False,
+        )
+        n_pad = graph.n_pad
+        owner_key = route_codata(plan, graph.src, n_pad - 1)
+        pack = EdgePlans(
+            plan=plan,
+            owner_key=owner_key,
+            src_idx=jnp.clip(owner_key, 0, n_pad - 1),
+            edge_w=route_codata(plan, graph.edge_w, 0),
+        )
     if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
         _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
     _PLAN_CACHE[key] = (graph.dst, pack)
@@ -377,7 +464,8 @@ def probe_status() -> dict:
 
 def maybe_edge_plans(graph):
     """EdgePlans for the level, or None when routing would not pay:
-    backend without the Mosaic kernel, small levels, or opted out via
+    backend without the Mosaic kernel, small levels, a plan over the
+    PLAN_MAX_SLOT_RATIO blowup cap, or opted out via
     KAMINPAR_TPU_LANE_GATHER=0.  KAMINPAR_TPU_LANE_GATHER=1 force-enables
     routing past the size gate and the best-of-3 TIMING race — the
     symmetric override for noisy links where one slow probe round would
@@ -453,7 +541,9 @@ def _probe_support(skip_timing: bool = False):
     `skip_timing` (the =1 force-enable) only the platform and
     correctness halves gate — the timing race is not run."""
     try:
-        platform = jax.devices()[0].platform
+        from ..utils import platform as _platform
+
+        platform = _platform.default_backend()
         if platform not in ("tpu", "axon"):
             return False, {
                 "mode": "probed",
@@ -465,6 +555,8 @@ def _probe_support(skip_timing: bool = False):
         rng = np.random.RandomState(0)
         idx = rng.randint(0, n, 4096).astype(np.int32)
         table = rng.randint(0, 1 << 30, n).astype(np.int32)
+        # probe plan: fixed 4096-index uniform shape, blowup impossible
+        # tpulint: disable=R5
         plan = build_gather_plan(jnp.asarray(idx), n)
         got = np.asarray(lane_gather(jnp.asarray(table), plan))
         inv = np.asarray(plan.inv)
@@ -488,7 +580,11 @@ def _probe_support(skip_timing: bool = False):
         tab2 = jnp.asarray(
             np.random.RandomState(2).randint(0, 1 << 30, n_probe), jnp.int32
         )
+        # probe plan: fixed uniform 4M-index shape, blowup impossible
+        # tpulint: disable=R5
         plan2 = build_gather_plan(idx2, n_probe)
+        # one-shot probe (lru_cached), the per-call retrace never repeats
+        # tpulint: disable=R4
         xla = jax.jit(lambda t, i: t[i])
 
         def _time(fn, *args):

@@ -413,6 +413,7 @@ def lp_round(
     # convergence is judged on *wanting* nodes, not sampled movers: a round
     # where the participation sample happens to move nobody must not stop
     # the loop while unsampled nodes still have improving moves
+    # wanting-node count <= n, ID domain  # tpulint: disable=R3
     num_wanting = jnp.sum(wants, dtype=jnp.int32)
     return new_labels, new_cluster_weights, new_active, num_wanting
 
@@ -462,6 +463,8 @@ def _round_with_delta(
             communities=communities, plans=plans,
         )
 
+    # active-degree total <= m_pad < 2^31 (device layout)
+    # tpulint: disable=R3
     total = jnp.sum(jnp.where(active & (deg > 0), deg, 0), dtype=jnp.int32)
     pred = (i > 0) & (total <= dslots)
     return lax.cond(pred, delta_fn, full_fn, (labels, weights, active))

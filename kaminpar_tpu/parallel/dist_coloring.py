@@ -111,6 +111,7 @@ def _dist_coloring_impl(mesh, graph: DistGraph, seed, max_rounds: int):
         leftover = (colors_l < 0) & is_real_l
         count_l = jnp.sum(leftover.astype(jnp.int32))
         counts = lax.all_gather(count_l, NODE_AXIS)  # [D]
+        # leftover-node count <= n, ID domain  # tpulint: disable=R3
         prefix = jnp.sum(jnp.where(
             jnp.arange(counts.shape[0]) < d, counts, 0
         )).astype(jnp.int32)

@@ -20,8 +20,6 @@ import enum
 from dataclasses import dataclass, field, replace
 from typing import Callable, List
 
-import jax.numpy as jnp
-
 from ..context import Context, JetRefinementContext, PartitioningMode
 from ..ops.lp import LPConfig
 from ..presets import create_context_by_preset_name
@@ -220,7 +218,14 @@ def get_dist_preset_names():
 
 
 def create_dist_clusterer(ctx: DistContext) -> Callable:
-    """Returns clusterer(graph, max_cluster_weight, seed) -> labels."""
+    """Returns clusterer(graph, max_cluster_weight, seed) -> labels.
+
+    Imports (including jax) are lazy so building a config object can
+    never initialize a backend — config construction must stay safe in
+    embedding hosts with a restricted JAX_PLATFORMS (see utils.platform).
+    """
+    import jax.numpy as jnp
+
     from .dist_hem import dist_hem_cluster, dist_hem_lp_cluster
     from .dist_lp import dist_lp_cluster
 

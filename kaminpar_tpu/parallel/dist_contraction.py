@@ -189,6 +189,7 @@ def dist_contract_clustering(
     lab = np.asarray(labels, dtype=np.int64)
     used = np.zeros(n_pad, dtype=bool)
     used[lab[:dg_host_n]] = True
+    # coarse ids <= n, ID domain  # tpulint: disable=R3
     cmap_full = (np.cumsum(used) - 1).astype(np.int32)
     c_n = int(used.sum())
     cmap = cmap_full[lab[:dg_host_n]]

@@ -232,7 +232,9 @@ def make_mesh(
     """
     explicit_devices = devices is not None
     if devices is None:
-        devices = jax.devices()
+        from ..utils import platform
+
+        devices = platform.devices()
     if isinstance(n_devices, tuple):
         rows, cols = n_devices
         if len(devices) < rows * cols:

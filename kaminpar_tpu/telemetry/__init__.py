@@ -191,9 +191,9 @@ def is_primary_process() -> bool:
     gate on this: on multi-host runs every process must still CALL them
     (their gathers are collective), but only one may write the path."""
     try:
-        import jax
+        from ..utils.platform import process_index
 
-        return jax.process_index() == 0
+        return process_index() == 0
     except Exception:
         return True
 

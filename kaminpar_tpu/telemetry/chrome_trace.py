@@ -35,10 +35,10 @@ def gather_payloads() -> List[Tuple[int, dict]]:
     just the local stream under pid 0."""
     local = _local_payload()
     try:
-        import jax
+        from ..utils.platform import process_count, process_index
 
-        nproc = jax.process_count()
-        pid = jax.process_index()
+        nproc = process_count()
+        pid = process_index()
     except Exception:
         return [(0, local)]
     if nproc <= 1:

@@ -43,11 +43,13 @@ def environment_stamp() -> dict:
     try:
         import jax
 
+        from ..utils import platform
+
         env["jax_version"] = jax.__version__
-        devices = jax.devices()
+        devices = platform.devices()
         env["platform"] = devices[0].platform
         env["device_count"] = len(devices)
-        env["process_count"] = jax.process_count()
+        env["process_count"] = platform.process_count()
     except Exception:
         env.setdefault("jax_version", "unavailable")
         env.setdefault("platform", "unknown")

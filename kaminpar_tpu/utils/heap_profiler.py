@@ -73,9 +73,9 @@ def _device_peak_bytes() -> int:
     """Process-lifetime device high-water mark where the backend exposes
     it (TPU does via memory_stats; CPU returns 0)."""
     try:
-        import jax
+        from .platform import local_devices
 
-        stats = jax.local_devices()[0].memory_stats()
+        stats = local_devices()[0].memory_stats()
         if stats:
             return int(
                 stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))

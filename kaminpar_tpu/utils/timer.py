@@ -223,9 +223,9 @@ def aggregate_across_processes(timer: Optional[Timer] = None):
 
     local = np.asarray(values, dtype=np.float64)
     try:
-        import jax
+        from .platform import process_count
 
-        nproc = jax.process_count()
+        nproc = process_count()
     except Exception:
         nproc = 1
     if nproc > 1 and len(local):

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# The one-command commit gate: tpulint, run-report schema check, and
+# the ROADMAP.md tier-1 pytest command.  Exits nonzero on the first
+# failing stage.
+#
+# Usage:  scripts/check_all.sh [--fast]
+#         --fast skips the tier-1 pytest stage (lint + schema only,
+#         the same pair the pre-commit hooks run).
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+echo "== [1/3] tpulint (vs scripts/tpulint_baseline.json) =="
+python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
+
+echo "== [2/3] run-report schema (producer selftest) =="
+python scripts/check_report_schema.py --selftest || exit 1
+
+if [ "${1:-}" = "--fast" ]; then
+    echo "== [3/3] tier-1 pytest: SKIPPED (--fast) =="
+    exit 0
+fi
+
+echo "== [3/3] tier-1 pytest (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit $rc

@@ -81,20 +81,66 @@ def validate_instance(instance: Any, schema: dict, path: str = "$") -> List[str]
     return errors
 
 
+def _selftest_report(path: str) -> None:
+    """Generate a minimal live report so producer and schema are checked
+    against each other with no partition run (the pre-commit /
+    check_all.sh fast path)."""
+    # run as a script, sys.path[0] is scripts/ — add the repo root
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from kaminpar_tpu import telemetry
+    from kaminpar_tpu.telemetry.report import write_run_report
+
+    telemetry.enable()
+    telemetry.annotate(result={"cut": 0, "imbalance": 0.0, "feasible": True})
+    write_run_report(path)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="validate a kaminpar-tpu run report against the schema"
     )
-    ap.add_argument("report", help="run-report JSON file (--report-json)")
+    ap.add_argument(
+        "report", nargs="?", default=None,
+        help="run-report JSON file (--report-json); omit with --selftest",
+    )
     ap.add_argument(
         "--schema", default=DEFAULT_SCHEMA, help="schema file to check against"
     )
+    ap.add_argument(
+        "--selftest", action="store_true",
+        help="generate a minimal report from the live producer and "
+        "validate it (no report file needed)",
+    )
     args = ap.parse_args(argv)
 
-    with open(args.schema) as f:
-        schema = json.load(f)
-    with open(args.report) as f:
-        report = json.load(f)
+    if args.selftest:
+        if args.report is not None:
+            ap.error("--selftest generates its own report; drop the "
+                     "report argument (or the flag) — refusing to "
+                     "silently ignore the given file")
+        import tempfile
+
+        fd, args.report = tempfile.mkstemp(
+            prefix="kmp_report_", suffix=".json"
+        )
+        os.close(fd)
+        try:
+            _selftest_report(args.report)
+            with open(args.schema) as f:
+                schema = json.load(f)
+            with open(args.report) as f:
+                report = json.load(f)
+        finally:
+            os.unlink(args.report)
+    elif args.report is None:
+        ap.error("a report file is required unless --selftest is given")
+    else:
+        with open(args.schema) as f:
+            schema = json.load(f)
+        with open(args.report) as f:
+            report = json.load(f)
 
     errors = validate_instance(report, schema)
     if errors:

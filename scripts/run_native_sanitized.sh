@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Rebuild the native layer (codec.cpp/codec2.cpp/ip.cpp/fm.cpp and the
+# C-ABI shim ckaminpar.cpp) with ASan/UBSan and run the C-API and FM
+# tests under it.
+#
+# The sanitized .so's are dlopen'd into an UNsanitized python, so
+# libasan must be LD_PRELOADed into the whole process tree (including
+# the compiled C driver test_capi spawns).  Leak detection is off —
+# CPython/jax hold allocations for the process lifetime by design; the
+# run hunts heap-buffer-overflow / use-after-free / UB, which abort.
+#
+# Usage:  scripts/run_native_sanitized.sh [extra pytest args]
+#         KMP_SANITIZE=address scripts/run_native_sanitized.sh   # ASan only
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export KMP_SANITIZE="${KMP_SANITIZE:-address,undefined}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+LIBASAN="$(gcc -print-file-name=libasan.so)"
+if [ ! -e "$LIBASAN" ]; then
+    echo "run_native_sanitized: libasan.so not found (gcc too old?)" >&2
+    exit 2
+fi
+# libstdc++ must ride along: python links no C++ runtime, so ASan's
+# __cxa_throw interceptor finds no real symbol at init and CHECK-aborts
+# on the first C++ exception (jaxlib's MLIR throws StopIteration from
+# C++ during every jit compile) without it
+LIBSTDCPP="$(g++ -print-file-name=libstdc++.so.6)"
+export LD_PRELOAD="$LIBASAN $LIBSTDCPP"
+export ASAN_OPTIONS="${ASAN_OPTIONS:-detect_leaks=0,abort_on_error=1}"
+export UBSAN_OPTIONS="${UBSAN_OPTIONS:-print_stacktrace=1,halt_on_error=1}"
+
+echo "== sanitized rebuild (KMP_SANITIZE=$KMP_SANITIZE) =="
+python - <<'PY'
+from kaminpar_tpu import native
+
+flags = native.sanitize_flags()
+assert flags, "KMP_SANITIZE unset?"
+lib = native.get_lib()
+assert lib is not None, "sanitized native build failed (see g++ stderr)"
+print(f"sanitized libkmpnative OK ({' '.join(flags)})")
+PY
+
+echo "== C-API + native FM tests under ASan/UBSan =="
+python -m pytest tests/test_capi.py tests/test_refinement.py \
+    -q -p no:cacheprovider "$@"

@@ -1,0 +1,30 @@
+"""R1 bad fixture: host-sync primitives in jit-reachable code and spans.
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+@jax.jit
+def jitted_entry(x):
+    return helper(x)
+
+
+def helper(x):
+    total = x.sum()
+    if jnp.any(x > 0):  # line 20: R1 python branch on traced expr
+        total = total + 1
+    n = int(jnp.sum(x))  # line 22: R1 int() of a jax value
+    val = total.item()  # line 23: R1 .item()
+    host = np.asarray(x)  # line 24: R1 device->host copy
+    return n + val + host.shape[0]
+
+
+def span_scope_sync(x):
+    with scoped_timer("phase"):
+        return np.asarray(x)  # line 30: R1 asarray inside a span scope

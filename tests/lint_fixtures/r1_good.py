@@ -1,0 +1,21 @@
+"""R1 good fixture: device values stay on device inside jit reach;
+host readbacks happen only in plain driver code."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def jitted_entry(x):
+    return helper(x)
+
+
+def helper(x):
+    # traced control flow via where, not a python branch
+    return jnp.where(jnp.any(x > 0), x.sum() + 1, x.sum())
+
+
+def driver(x):
+    # not reachable from a jit root: host readback is fine here
+    out = jitted_entry(x)
+    return int(jnp.sum(out)), np.asarray(out)

@@ -1,0 +1,9 @@
+"""R2 bad fixture: eager device discovery at import time plus direct
+backend queries that bypass the utils.platform gate."""
+import jax
+
+DEVICES = jax.devices()  # line 5: R2 eager, at import time
+
+
+def pick_backend():
+    return jax.default_backend()  # line 9: R2 direct, bypasses the gate

@@ -1,0 +1,16 @@
+"""R3 bad fixture: int32 accumulation where the dtypes.py policy rules."""
+import jax
+import jax.numpy as jnp
+
+
+def edge_prefix_sums(counts):
+    return jnp.cumsum(counts, dtype=jnp.int32)  # line 7: R3
+
+
+def cut_accumulator(weights, mask):
+    return jnp.sum(jnp.where(mask, weights, 0), dtype=jnp.int32)  # line 11
+
+
+def narrowed(weights, owners, n):
+    sums = jax.ops.segment_sum(weights, owners, num_segments=n)
+    return jnp.cumsum(sums).astype(jnp.int32)  # line 16: R3 narrowing

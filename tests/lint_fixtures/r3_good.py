@@ -1,0 +1,12 @@
+"""R3 good fixture: accumulators ride the dtypes.py 64-bit policy."""
+import jax.numpy as jnp
+
+from kaminpar_tpu.dtypes import ACC_DTYPE
+
+
+def edge_prefix_sums(counts):
+    return jnp.cumsum(counts.astype(ACC_DTYPE))
+
+
+def cut_accumulator(weights, mask):
+    return jnp.sum(jnp.where(mask, weights, 0), dtype=ACC_DTYPE)

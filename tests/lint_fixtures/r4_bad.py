@@ -1,0 +1,23 @@
+"""R4 bad fixture: jit wrappers minted per iteration / per evaluation."""
+import functools
+
+import jax
+
+
+def per_level_compile(levels, fn):
+    outs = []
+    for level in levels:
+        step = jax.jit(fn)  # line 10: R4 wrapper built inside a loop
+        outs.append(step(level))
+    return outs
+
+
+def per_level_partial(levels, fn):
+    while levels:
+        step = functools.partial(jax.jit, static_argnames=("k",))(fn)  # 17
+        levels = levels[1:]
+        step(levels)
+
+
+def fresh_lambda(x):
+    return jax.jit(lambda v: v * 2)(x)  # line 23: R4 fresh lambda

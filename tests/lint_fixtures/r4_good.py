@@ -1,0 +1,11 @@
+"""R4 good fixture: one wrapper defined once, reused by every level."""
+import jax
+
+
+@jax.jit
+def _step(level):
+    return level * 2
+
+
+def per_level_compile(levels):
+    return [_step(level) for level in levels]

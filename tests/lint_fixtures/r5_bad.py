@@ -1,0 +1,12 @@
+"""R5 bad fixture: routed-gather plan kept without a slot-cap check."""
+from kaminpar_tpu.ops.lane_gather import build_gather_plan
+
+
+def plan_level(dst, n_pad):
+    return build_gather_plan(dst, n_pad)  # line 6: R5 no cap check
+
+
+def plan_level_logged_only(dst, n_pad, telemetry):
+    plan = build_gather_plan(dst, n_pad)  # line 10: R5 logging != a cap
+    telemetry.event("plan", num_slots=plan.num_slots)
+    return plan

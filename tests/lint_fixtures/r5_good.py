@@ -1,0 +1,9 @@
+"""R5 good fixture: plan checked against the blowup cap before use."""
+from kaminpar_tpu.ops.lane_gather import build_gather_plan, plan_within_cap
+
+
+def plan_level(dst, n_pad):
+    plan = build_gather_plan(dst, n_pad)
+    if not plan_within_cap(plan, dst.shape[0]):
+        return None
+    return plan

@@ -109,3 +109,94 @@ def test_plan_rejects_out_of_range_indices():
         build_gather_plan(
             jnp.array([5, 64 * L * 2], jnp.int32), 64 * L, chunk_rows=64
         )
+
+
+# ---------------------------------------------------------------------------
+# plan-blowup cap (ADVICE round 5 medium): hub-skewed index arrays must
+# fall back to the XLA gather instead of pinning an inflated plan
+# ---------------------------------------------------------------------------
+
+
+def _skewed_graph(n_pad=1024, m=512):
+    """Every edge targets node 0: one lane soaks all m indices, so the
+    routed height is ~m rows and num_slots ~ m * 128 >> 2 * m."""
+    import jax.numpy as jnp
+
+    class G:
+        pass
+
+    g = G()
+    g.n_pad = n_pad
+    g.dst = jnp.zeros(m, dtype=jnp.int32)
+    g.src = jnp.zeros(m, dtype=jnp.int32)
+    g.edge_w = jnp.ones(m, dtype=jnp.int32)
+    return g
+
+
+def test_plan_within_cap_predicate():
+    from kaminpar_tpu.ops import lane_gather as lg
+
+    uniform = build_gather_plan(
+        jnp.arange(1024, dtype=jnp.int32) % (8 * L), 8 * L
+    )
+    assert lg.plan_within_cap(uniform, 1024)
+    skewed = build_gather_plan(jnp.zeros(512, jnp.int32), 8 * L)
+    assert not lg.plan_within_cap(skewed, 512)
+
+
+def test_edge_plans_discards_blown_up_plan_and_emits_event():
+    from kaminpar_tpu import telemetry
+    from kaminpar_tpu.ops import lane_gather as lg
+
+    telemetry.enable()
+    telemetry.reset()
+    lg.clear_plan_cache()
+    try:
+        g = _skewed_graph()
+        assert lg.edge_plans(g) is None
+        events = telemetry.events("lane-gather-plan")
+        assert len(events) == 1
+        attrs = events[0].attrs
+        assert attrs["capped"] is True
+        assert attrs["m"] == 512
+        assert attrs["num_slots"] > 2 * 512
+        assert attrs["pad_overhead"] == pytest.approx(
+            attrs["num_slots"] / 512, rel=1e-3
+        )
+        # the verdict is cached: a second call rebuilds nothing
+        assert lg.edge_plans(g) is None
+        assert len(telemetry.events("lane-gather-plan")) == 1
+    finally:
+        lg.clear_plan_cache()
+        telemetry.reset()
+        telemetry.disable()
+
+
+def test_edge_plans_keeps_affordable_plan_and_reports_overhead():
+    from kaminpar_tpu import telemetry
+    from kaminpar_tpu.ops import lane_gather as lg
+
+    telemetry.enable()
+    telemetry.reset()
+    lg.clear_plan_cache()
+    try:
+        import jax.numpy as jnp
+
+        class G:
+            pass
+
+        g = G()
+        g.n_pad = 8 * L
+        m = 8 * L * 4
+        g.dst = jnp.arange(m, dtype=jnp.int32) % (8 * L)  # uniform
+        g.src = jnp.zeros(m, dtype=jnp.int32)
+        g.edge_w = jnp.ones(m, dtype=jnp.int32)
+        plans = lg.edge_plans(g)
+        assert plans is not None
+        (ev,) = telemetry.events("lane-gather-plan")
+        assert ev.attrs["capped"] is False
+        assert ev.attrs["num_slots"] <= 2 * m
+    finally:
+        lg.clear_plan_cache()
+        telemetry.reset()
+        telemetry.disable()

@@ -1,0 +1,201 @@
+"""tpulint: per-rule fixture firing, suppressions, baseline ratchet,
+CLI exit codes, and the package-clean gate (the acceptance criterion:
+`python -m kaminpar_tpu.lint kaminpar_tpu/` exits 0 vs the checked-in
+baseline)."""
+
+import json
+import os
+
+import pytest
+
+from kaminpar_tpu.lint import (
+    LintConfig,
+    diff_against_baseline,
+    lint_file,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+from kaminpar_tpu.lint.__main__ import DEFAULT_BASELINE, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+PACKAGE = os.path.join(REPO, "kaminpar_tpu")
+
+
+def _findings(name):
+    return lint_file(os.path.join(FIXTURES, name))
+
+
+# --- every rule fires on its bad fixture at the pinned lines --------------
+
+BAD_EXPECT = {
+    "r1_bad.py": [("R1", 20), ("R1", 22), ("R1", 23), ("R1", 24), ("R1", 30)],
+    "r2_bad.py": [("R2", 5), ("R2", 9)],
+    "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16)],
+    "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
+    "r5_bad.py": [("R5", 6), ("R5", 10)],
+}
+
+
+@pytest.mark.parametrize("name", sorted(BAD_EXPECT))
+def test_rule_fires_on_bad_fixture(name):
+    got = [(f.rule, f.line) for f in _findings(name)]
+    assert got == BAD_EXPECT[name]
+
+
+@pytest.mark.parametrize(
+    "name", ["r1_good.py", "r2_good.py", "r3_good.py", "r4_good.py",
+             "r5_good.py"]
+)
+def test_rule_silent_on_good_fixture(name):
+    assert _findings(name) == []
+
+
+# --- finding metadata ------------------------------------------------------
+
+def test_findings_carry_symbol_and_code():
+    by_line = {f.line: f for f in _findings("r1_bad.py")}
+    assert by_line[23].symbol == "helper"
+    assert ".item()" in by_line[23].code
+    assert by_line[30].symbol == "span_scope_sync"
+    mod_level = {f.line: f for f in _findings("r2_bad.py")}
+    assert mod_level[5].symbol == "<module>"
+
+
+# --- suppressions ----------------------------------------------------------
+
+R2_SNIPPET = "import jax\n\n\ndef f():\n    return jax.devices()\n"
+
+
+def test_same_line_suppression():
+    src = R2_SNIPPET.replace(
+        "return jax.devices()",
+        "return jax.devices()  # tpulint: disable=R2",
+    )
+    assert lint_source(src, "x.py") == []
+
+
+def test_comment_line_above_suppression():
+    src = R2_SNIPPET.replace(
+        "    return jax.devices()",
+        "    # bounded: test harness only  # tpulint: disable=R2\n"
+        "    return jax.devices()",
+    )
+    assert lint_source(src, "x.py") == []
+
+
+def test_file_level_suppression():
+    src = "# tpulint: disable-file=R2\n" + R2_SNIPPET
+    assert lint_source(src, "x.py") == []
+
+
+def test_suppression_of_other_rule_does_not_hide():
+    src = R2_SNIPPET.replace(
+        "return jax.devices()",
+        "return jax.devices()  # tpulint: disable=R1",
+    )
+    assert [f.rule for f in lint_source(src, "x.py")] == ["R2"]
+
+
+def test_gate_module_is_exempt():
+    findings = lint_source(R2_SNIPPET, "kaminpar_tpu/utils/platform.py")
+    assert findings == []
+
+
+# --- baseline --------------------------------------------------------------
+
+def test_baseline_roundtrip_and_diff(tmp_path):
+    findings = _findings("r3_bad.py")
+    path = tmp_path / "baseline.json"
+    write_baseline(str(path), findings)
+    entries = load_baseline(str(path))
+    assert len(entries) == len(findings)
+
+    diff = diff_against_baseline(findings, entries)
+    assert diff.new == [] and len(diff.accepted) == len(findings)
+    assert diff.stale == []
+
+    # a fresh finding not in the baseline is NEW
+    extra = _findings("r5_bad.py")
+    diff = diff_against_baseline(findings + extra, entries)
+    assert [f.rule for f in diff.new] == ["R5"] * len(extra)
+
+    # a fixed finding leaves a STALE entry (the ratchet signal)
+    diff = diff_against_baseline(findings[1:], entries)
+    assert len(diff.stale) == 1 and diff.new == []
+
+
+def test_baseline_is_line_churn_stable(tmp_path):
+    src = R2_SNIPPET
+    findings = lint_source(src, "x.py")
+    path = tmp_path / "b.json"
+    write_baseline(str(path), findings)
+    # shift every line down: same code, different line numbers
+    shifted = "# a new leading comment\n\n" + src
+    diff = diff_against_baseline(
+        lint_source(shifted, "x.py"), load_baseline(str(path))
+    )
+    assert diff.new == [] and diff.stale == []
+
+
+# --- CLI -------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = os.path.join(FIXTURES, "r2_bad.py")
+    good = os.path.join(FIXTURES, "r2_good.py")
+    assert main([good, "--no-baseline"]) == 0
+    assert main([bad, "--no-baseline"]) == 1
+    assert main([str(tmp_path / "missing.py")]) == 2
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "R1" in out and "R5" in out
+
+
+def test_cli_select_subset():
+    bad = os.path.join(FIXTURES, "r2_bad.py")
+    # selecting a rule the file does not violate -> clean
+    assert main([bad, "--no-baseline", "--select", "R5"]) == 0
+    assert main([bad, "--no-baseline", "--select", "R2"]) == 1
+    assert main([bad, "--select", "R9"]) == 2
+
+
+def test_cli_json_format(capsys):
+    bad = os.path.join(FIXTURES, "r5_bad.py")
+    assert main([bad, "--no-baseline", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["total"] == 2
+    assert payload["new"][0]["rule"] == "R5"
+
+
+def test_cli_write_baseline_refuses_subsets(tmp_path, capsys):
+    """--write-baseline must not truncate the checked-in baseline to a
+    rule or path subset's findings."""
+    bad = os.path.join(FIXTURES, "r2_bad.py")
+    assert main([bad, "--select", "R2", "--write-baseline"]) == 2
+    assert main([bad, "--write-baseline"]) == 2  # path subset, default file
+    capsys.readouterr()
+    # an explicit --baseline target is fine for a subset
+    out = tmp_path / "b.json"
+    assert main([bad, "--write-baseline", "--baseline", str(out)]) == 0
+    assert load_baseline(str(out))
+
+
+# --- the acceptance gate ---------------------------------------------------
+
+def test_package_is_clean_against_checked_in_baseline():
+    """`python -m kaminpar_tpu.lint kaminpar_tpu/` must exit 0: every
+    finding is either fixed, suppressed with a justification, or in
+    scripts/tpulint_baseline.json (ratchet: only ever shrink it)."""
+    assert os.path.exists(DEFAULT_BASELINE), "baseline file is checked in"
+    findings = lint_paths([PACKAGE], LintConfig())
+    diff = diff_against_baseline(findings, load_baseline(DEFAULT_BASELINE))
+    assert diff.new == [], "\n".join(f.render() for f in diff.new)
+
+
+def test_syntax_error_reports_e0_even_with_rule_subset():
+    cfg = LintConfig()
+    cfg.rules = ("R2",)
+    findings = lint_source("def f(:\n", "broken.py", cfg)
+    assert [f.rule for f in findings] == ["E0"]
