@@ -164,6 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="validate the input graph (graph_validator analog)",
     )
     p.add_argument(
+        "--no-repair", action="store_true",
+        help="disable the output gate's greedy balance-repair pass "
+        "(the strict-balance check still runs and reports violations; "
+        "see docs/robustness.md)",
+    )
+    p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
@@ -237,6 +243,8 @@ def make_context(args: argparse.Namespace) -> Context:
             setattr(ctx.debug, "dump_" + what.replace("-", "_"), True)
     if args.debug_dump_dir:
         ctx.debug.dump_dir = args.debug_dump_dir
+    if args.no_repair:
+        ctx.resilience.repair = False
     if args.seed is not None:  # -C config may set the seed; flag wins
         ctx.seed = args.seed
     return ctx
@@ -265,6 +273,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.statistics:
         statistics.enable()
     telemetry.enable_if_requested(args)
+
+    # fault-plan echo: an active injection plan changes every result —
+    # it must be impossible to mistake a chaos run for a clean one (the
+    # run report carries the same plan in its `faults` section).  The
+    # plan is parsed HERE so a typo fails at startup with a clear
+    # message, not minutes in at the first registered site.
+    from .resilience import faults as faults_mod
+
+    fault_plan = os.environ.get(faults_mod.ENV_VAR, "")
+    if fault_plan:
+        try:
+            faults_mod.parse_plan(fault_plan)
+        except faults_mod.FaultPlanError as e:
+            print(f"error: bad {faults_mod.ENV_VAR}: {e}", file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print(
+                f"FAULTS plan={fault_plan} (fault injection ACTIVE; "
+                "see the report's 'faults' section)"
+            )
 
     t_io = time.perf_counter()
     if args.graph.startswith("gen:"):

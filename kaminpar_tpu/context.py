@@ -402,6 +402,20 @@ class GraphCompressionContext:
 
 
 @dataclass
+class ResilienceContext:
+    """Degradation / output-gate policy (resilience/, docs/robustness.md).
+
+    `output_gate` runs the end-of-pipeline strict-balance validator
+    (one O(n + m) host pass; also killable per-run via
+    KAMINPAR_TPU_OUTPUT_GATE=0); `repair` lets the gate fix balance
+    violations with the greedy host pass (--no-repair disables repair
+    but keeps the check, so violations still surface in telemetry)."""
+
+    output_gate: bool = True
+    repair: bool = True
+
+
+@dataclass
 class DebugContext:
     """kaminpar.h:484-496."""
 
@@ -433,6 +447,7 @@ class Context:
     compression: GraphCompressionContext = field(
         default_factory=GraphCompressionContext
     )
+    resilience: ResilienceContext = field(default_factory=ResilienceContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
 

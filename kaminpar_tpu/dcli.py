@@ -120,6 +120,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .parallel import dKaMinPar, make_mesh
 
     telemetry.enable_if_requested(args)
+    # fault-plan echo + startup validation (cli.py twin): chaos runs
+    # must be unmistakable, and a typo'd plan must fail before the run
+    import os as os_mod
+
+    from .resilience import faults as faults_mod
+
+    fault_plan = os_mod.environ.get(faults_mod.ENV_VAR, "")
+    if fault_plan:
+        try:
+            faults_mod.parse_plan(fault_plan)
+        except faults_mod.FaultPlanError as e:
+            print(f"error: bad {faults_mod.ENV_VAR}: {e}", file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print(
+                f"FAULTS plan={fault_plan} (fault injection ACTIVE; "
+                "see the report's 'faults' section)"
+            )
     mesh = make_mesh(args.num_devices)
     solver = dKaMinPar(args.preset, mesh=mesh)
     solver.set_graph(graph)

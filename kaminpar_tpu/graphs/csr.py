@@ -168,6 +168,13 @@ def device_graph_from_compressed(
     The resulting DeviceGraph is bitwise identical to
     device_graph_from_host(cgraph.decode()), so downstream kernels and
     compile caches are untouched."""
+    # `compressed-stream` degradation site: a failure here (device OOM
+    # mid-stream, injected chaos fault) propagates to the facade's
+    # with_fallback wrapper, which decodes to the plain host CSR and
+    # re-partitions (kaminpar._partition_core_resilient)
+    from ..resilience import maybe_inject
+
+    maybe_inject("compressed-stream")
     n, m = cgraph.n, cgraph.m
     n_floor, m_floor = shape_floors()
     n_pad = n_pad if n_pad is not None else pad_size(n + 1, n_floor)
@@ -241,6 +248,188 @@ def host_graph_from_device(graph: DeviceGraph) -> HostGraph:
         node_weights=None if (node_w == 1).all() else node_w,
         edge_weights=None if m == 0 or (edge_w == 1).all() else edge_w,
     )
+
+
+# ---------------------------------------------------------------------------
+# CSR invariant checker (debug; the output gate's and the chaos suite's
+# structural validator)
+# ---------------------------------------------------------------------------
+
+ASSERTS_ENV = "KAMINPAR_TPU_ASSERTS"
+
+
+class CSRInvariantError(ValueError):
+    """csr.validate found a structural violation (message says which)."""
+
+
+def asserts_enabled() -> bool:
+    """KAMINPAR_TPU_ASSERTS=1 turns on the debug invariant sweeps
+    (maybe_validate at the output gate and at upload boundaries); heavy
+    KAMINPAR_TPU_ASSERTION_LEVEL implies it."""
+    import os
+
+    if os.environ.get(ASSERTS_ENV, "") == "1":
+        return True
+    from ..utils.assertions import heavy_assertions_enabled
+
+    return heavy_assertions_enabled()
+
+
+def maybe_validate(graph, undirected: bool = True, where: str = "") -> None:
+    """validate() gated behind KAMINPAR_TPU_ASSERTS=1 (free otherwise)."""
+    if not asserts_enabled():
+        return
+    try:
+        validate(graph, undirected=undirected)
+    except CSRInvariantError as e:
+        raise CSRInvariantError(
+            f"{e}{' (at ' + where + ')' if where else ''}"
+        ) from None
+
+
+def _require(cond: bool, what: str) -> None:
+    if not cond:
+        raise CSRInvariantError(what)
+
+
+def validate(graph, undirected: bool = True) -> None:
+    """Structural CSR invariants for HostGraph, CompressedHostGraph, or
+    DeviceGraph; raises CSRInvariantError naming the violated invariant.
+
+    Checks (the graph_validator.cc analog plus this pipeline's dtype and
+    padding policy):
+      * offsets: start at 0, non-decreasing (not ragged), end at m;
+      * adjacency ids in [0, n);
+      * dtype policy: int32 ids, int64 host offsets/weights,
+        WEIGHT_DTYPE device weights (dtypes.py);
+      * undirected graphs: every edge's reverse twin is present;
+      * DeviceGraph padding: pad nodes weightless and degree-free, pad
+        edges parked on the guaranteed-pad node with weight 0, src
+        consistent with row_ptr.
+    """
+    from .compressed import CompressedHostGraph
+    from .host import HostGraph
+
+    if isinstance(graph, CompressedHostGraph):
+        return _validate_host_arrays(
+            np.asarray(graph.xadj, dtype=np.int64),
+            graph.decode().adjncy,
+            graph.n,
+            undirected,
+        )
+    if isinstance(graph, HostGraph):
+        xadj = np.asarray(graph.xadj)
+        _require(
+            np.issubdtype(xadj.dtype, np.integer),
+            f"dtype policy: xadj must be integer, got {xadj.dtype}",
+        )
+        _require(
+            graph.adjncy.dtype == np.int32,
+            f"dtype policy: adjncy must be int32, got {graph.adjncy.dtype}",
+        )
+        for name in ("node_weights", "edge_weights"):
+            w = getattr(graph, name)
+            _require(
+                w is None or np.issubdtype(np.asarray(w).dtype, np.integer),
+                f"dtype policy: {name} must be integer",
+            )
+        return _validate_host_arrays(
+            xadj.astype(np.int64), graph.adjncy, graph.n, undirected,
+            edge_w=None if graph.edge_weights is None
+            else np.asarray(graph.edge_weights),
+        )
+    # DeviceGraph
+    _require(
+        graph.row_ptr.dtype == jnp.int32
+        and graph.src.dtype == jnp.int32
+        and graph.dst.dtype == jnp.int32,
+        "dtype policy: device ids must be int32",
+    )
+    wdt = jnp.dtype(WEIGHT_DTYPE)
+    _require(
+        graph.edge_w.dtype == wdt and graph.node_w.dtype == wdt,
+        f"dtype policy: device weights must be {wdt}",
+    )
+    n, m = int(graph.n), int(graph.m)
+    n_pad, m_pad = graph.n_pad, graph.m_pad
+    _require(n_pad >= n + 1, "padding: n_pad must exceed n (pad node)")
+    row_ptr = np.asarray(graph.row_ptr)
+    src = np.asarray(graph.src)
+    dst = np.asarray(graph.dst)
+    _require(
+        (row_ptr[n:] == m).all(),
+        "padding: row_ptr pad slots must be clamped to m",
+    )
+    _require(
+        (src[m:] == n_pad - 1).all() and (dst[m:] == n_pad - 1).all(),
+        "padding: pad edges must be parked on the pad node",
+    )
+    _require(
+        (np.asarray(graph.edge_w)[m:] == 0).all(),
+        "padding: pad edges must have weight 0",
+    )
+    _require(
+        (np.asarray(graph.node_w)[n:] == 0).all(),
+        "padding: pad nodes must have weight 0",
+    )
+    deg = np.diff(row_ptr[: n + 1].astype(np.int64))
+    _require(
+        int(row_ptr[0]) == 0 and (deg >= 0).all() and int(row_ptr[n]) == m,
+        "offsets: row_ptr must rise monotonically from 0 to m",
+    )
+    _require(
+        np.array_equal(
+            src[:m], np.repeat(np.arange(n, dtype=np.int64), deg)
+        ),
+        "src/row_ptr mismatch: COO sources disagree with CSR offsets",
+    )
+    return _validate_host_arrays(
+        row_ptr[: n + 1].astype(np.int64), dst[:m], n, undirected,
+        edge_w=np.asarray(graph.edge_w)[:m],
+    )
+
+
+def _validate_host_arrays(
+    xadj: np.ndarray,
+    adjncy: np.ndarray,
+    n: int,
+    undirected: bool,
+    edge_w: Optional[np.ndarray] = None,
+) -> None:
+    m = int(xadj[-1]) if len(xadj) else 0
+    _require(
+        len(xadj) == n + 1, f"offsets: xadj has {len(xadj)} entries for n={n}"
+    )
+    _require(int(xadj[0]) == 0, "offsets: xadj must start at 0")
+    _require(
+        (np.diff(xadj) >= 0).all(), "offsets: xadj must be non-decreasing"
+    )
+    _require(
+        m == len(adjncy),
+        f"offsets: xadj ends at {m} but adjncy has {len(adjncy)} entries",
+    )
+    if m:
+        _require(
+            int(adjncy.min()) >= 0 and int(adjncy.max()) < n,
+            "adjacency: neighbor id out of [0, n)",
+        )
+    if undirected and m:
+        src = np.repeat(np.arange(n, dtype=np.int64), np.diff(xadj))
+        adj64 = adjncy.astype(np.int64)
+        fwd = np.lexsort((adj64, src))
+        rev = np.lexsort((src, adj64))
+        sym = np.array_equal(src[fwd], adj64[rev]) and np.array_equal(
+            adj64[fwd], src[rev]
+        )
+        _require(sym, "symmetry: some edge's reverse twin is missing")
+        if sym and edge_w is not None:
+            _require(
+                np.array_equal(
+                    np.asarray(edge_w, dtype=np.int64)[fwd],
+                    np.asarray(edge_w, dtype=np.int64)[rev],
+                ),
+                "symmetry: reverse twin present but weights differ",
+            )
 
 
 def pad_arrays_to(

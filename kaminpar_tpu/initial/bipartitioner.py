@@ -147,16 +147,30 @@ class InitialMultilevelBipartitioner:
         max_block_weights = np.asarray(max_block_weights, dtype=np.int64)
         if os.environ.get("KAMINPAR_TPU_NO_NATIVE_IP", "") != "1":
             from .. import native
+            from ..resilience import NativeUnavailable, with_fallback
 
             # check availability BEFORE drawing the seed: the fallback
             # must see the same rng stream whether the native path was
             # skipped by env flag or by a missing toolchain
             if native.available():
-                with timer.scoped_timer("ip-native"):
-                    part = native.ml_bipartition(
-                        graph, max_block_weights, self.ctx,
-                        seed=int(rng.integers(0, 2**62)),
-                    )
+                seed = int(rng.integers(0, 2**62))
+
+                def _native_ip():
+                    with timer.scoped_timer("ip-native"):
+                        part = native.ml_bipartition(
+                            graph, max_block_weights, self.ctx, seed=seed
+                        )
+                    if part is None:
+                        raise NativeUnavailable(
+                            "native bipartitioner unavailable"
+                        )
+                    return part
+
+                # fallback: fall through to the numpy multilevel path
+                # below (the behavioral spec of the native engine)
+                part = with_fallback(
+                    _native_ip, lambda exc: None, site="native-ip"
+                )
                 if part is not None:
                     return part
         with timer.scoped_timer("ip-coarsen"):

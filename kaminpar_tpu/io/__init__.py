@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import os
 
+from .errors import GraphFormatError  # noqa: F401
 from .metis import load_metis, parse_metis, write_metis  # noqa: F401
 from .parhip import load_parhip, parse_parhip, write_parhip  # noqa: F401
 from .compressed_binary import (  # noqa: F401

@@ -16,37 +16,81 @@ from __future__ import annotations
 import numpy as np
 
 from ..graphs.host import HostGraph
+from .errors import GraphFormatError
 
 
 def parse_metis(text: str) -> HostGraph:
-    # keep empty lines: a node with no neighbors is an empty line
-    lines = [l.strip() for l in text.splitlines() if not l.lstrip().startswith("%")]
-    while lines and not lines[0]:
+    # keep empty lines: a node with no neighbors is an empty line.
+    # Original 1-based line numbers ride along so every violation can
+    # name its line (GraphFormatError contract).
+    lines = [
+        (i + 1, l.strip())
+        for i, l in enumerate(text.splitlines())
+        if not l.lstrip().startswith("%")
+    ]
+    while lines and not lines[0][1]:
         lines.pop(0)
     if not lines:
-        raise ValueError("empty METIS file")
+        raise GraphFormatError("empty METIS file", line=1)
 
-    header = lines[0].split()
-    n = int(header[0])
-    m2 = int(header[1]) * 2  # file stores undirected edge count
+    header_ln, header_text = lines[0]
+    header = header_text.split()
+    if len(header) < 2:
+        raise GraphFormatError(
+            "header must be 'n m [fmt]'", line=header_ln
+        )
+    try:
+        n = int(header[0])
+        m_undirected = int(header[1])
+    except ValueError:
+        raise GraphFormatError(
+            f"non-integer header token in {header_text!r}", line=header_ln
+        ) from None
+    if n < 0 or m_undirected < 0:
+        raise GraphFormatError("negative n or m in header", line=header_ln)
+    m2 = m_undirected * 2  # file stores undirected edge count
+    # a corrupted header cannot commandeer an astronomic allocation:
+    # every directed edge needs at least two characters of body
+    if m2 > 2 * max(len(text), 1):
+        raise GraphFormatError(
+            f"header claims {m2} directed edges but the file is only "
+            f"{len(text)} bytes",
+            line=header_ln,
+        )
     fmt = header[2] if len(header) > 2 else "0"
     has_node_weights = len(fmt) >= 2 and fmt[-2] == "1"
     has_edge_weights = fmt[-1] == "1"
 
     if len(lines) - 1 < n:
-        raise ValueError(f"expected {n} node lines, found {len(lines) - 1}")
+        raise GraphFormatError(
+            f"expected {n} node lines, found {len(lines) - 1} "
+            "(truncated file?)",
+            line=lines[-1][0],
+        )
 
     # token-stream fast path: per node line, tokens are
     # [vw] (v [ew]) (v [ew]) ...
-    per_line_tokens = [
-        np.array(l.split(), dtype=np.int64) for l in lines[1 : n + 1]
-    ]
+    per_line_tokens = []
+    for ln, l in lines[1 : n + 1]:
+        try:
+            per_line_tokens.append(np.array(l.split(), dtype=np.int64))
+        except OverflowError:
+            raise GraphFormatError(
+                "weight or id overflows 64-bit", line=ln
+            ) from None
+        except ValueError:
+            raise GraphFormatError("non-integer token", line=ln) from None
+    line_numbers = [ln for ln, _ in lines[1 : n + 1]]
     degrees = np.zeros(n, dtype=np.int64)
     stride = 2 if has_edge_weights else 1
     for i, toks in enumerate(per_line_tokens):
         cnt = len(toks) - (1 if has_node_weights else 0)
-        if cnt % stride:
-            raise ValueError(f"malformed adjacency on node line {i + 1}")
+        if cnt < 0 or cnt % stride:
+            raise GraphFormatError(
+                "malformed adjacency (token count does not match the "
+                "header's weight flags)",
+                line=line_numbers[i],
+            )
         degrees[i] = cnt // stride
 
     xadj = np.zeros(n + 1, dtype=np.int64)
@@ -55,7 +99,10 @@ def parse_metis(text: str) -> HostGraph:
     if m != m2:
         # tolerated like the reference tolerates trailing data, but warn-level
         # strictness: mismatch is almost always a broken file
-        raise ValueError(f"header claims {m2} directed edges, file has {m}")
+        raise GraphFormatError(
+            f"header claims {m2} directed edges, file has {m}",
+            line=header_ln,
+        )
 
     adjncy = np.empty(m, dtype=np.int32)
     edge_weights = np.empty(m, dtype=np.int64) if has_edge_weights else None
@@ -64,6 +111,11 @@ def parse_metis(text: str) -> HostGraph:
     for i, toks in enumerate(per_line_tokens):
         off = 0
         if has_node_weights:
+            if toks[0] < 0:
+                raise GraphFormatError(
+                    f"negative node weight {int(toks[0])}",
+                    line=line_numbers[i],
+                )
             node_weights[i] = toks[0]
             off = 1
         body = toks[off:]
@@ -75,7 +127,20 @@ def parse_metis(text: str) -> HostGraph:
             adjncy[s:e] = body - 1
 
     if m and (adjncy.min() < 0 or adjncy.max() >= n):
-        raise ValueError("neighbor id out of range")
+        bad = int(
+            np.flatnonzero((adjncy < 0) | (adjncy >= n))[0]
+        )
+        node = int(np.searchsorted(xadj, bad, side="right")) - 1
+        raise GraphFormatError(
+            f"neighbor id {int(adjncy[bad]) + 1} out of range [1, {n}]",
+            line=line_numbers[node],
+        )
+    if edge_weights is not None and m and edge_weights.min() < 0:
+        bad = int(np.flatnonzero(edge_weights < 0)[0])
+        node = int(np.searchsorted(xadj, bad, side="right")) - 1
+        raise GraphFormatError(
+            "negative edge weight", line=line_numbers[node]
+        )
     return HostGraph(
         xadj=xadj,
         adjncy=adjncy,
@@ -87,10 +152,13 @@ def parse_metis(text: str) -> HostGraph:
 def load_metis(path: str) -> HostGraph:
     with open(path, "rb") as f:
         raw = f.read()
-    graph = _parse_metis_native(raw)
-    if graph is not None:
-        return graph
-    return parse_metis(raw.decode("latin-1"))
+    try:
+        graph = _parse_metis_native(raw)
+        if graph is not None:
+            return graph
+        return parse_metis(raw.decode("latin-1"))
+    except GraphFormatError as e:
+        raise e.with_path(path) from None
 
 
 def _parse_metis_native(raw: bytes) -> HostGraph | None:
@@ -116,8 +184,21 @@ def _parse_metis_native(raw: bytes) -> HostGraph | None:
             break
     if header is None or len(header) < 2:
         return None
-    n = int(header[0])
-    m2 = int(header[1]) * 2
+    try:
+        n = int(header[0])
+        m2 = int(header[1]) * 2
+    except ValueError:
+        raise GraphFormatError(
+            "non-integer header token", line=1
+        ) from None
+    if n < 0 or m2 < 0:
+        raise GraphFormatError("negative n or m in header", line=1)
+    if m2 > 2 * max(len(raw), 1):
+        raise GraphFormatError(
+            f"header claims {m2} directed edges but the file is only "
+            f"{len(raw)} bytes",
+            line=1,
+        )
     fmt = header[2].decode() if len(header) > 2 else "0"
     has_vw = len(fmt) >= 2 and fmt[-2] == "1"
     has_ew = fmt[-1] == "1"
@@ -132,11 +213,23 @@ def _parse_metis_native(raw: bytes) -> HostGraph | None:
         xadj, adjncy, vw, ew,
     )
     if m < 0:
-        raise ValueError(f"malformed adjacency on node line {-m}")
+        # -m is the 1-based NODE index whose line is malformed; the
+        # native tokenizer does not track comment lines, so report the
+        # node index rather than a possibly-off-by-comments line number
+        raise GraphFormatError(
+            f"malformed adjacency on node {-m} (line {-m} + header/"
+            "comment lines)"
+        )
     if m != m2:
-        raise ValueError(f"header claims {m2} directed edges, file has {m}")
+        raise GraphFormatError(
+            f"header claims {m2} directed edges, file has {m}", line=1
+        )
     if m and (adjncy[:m].min() < 0 or adjncy[:m].max() >= n):
-        raise ValueError("neighbor id out of range")
+        raise GraphFormatError("neighbor id out of range")
+    if has_vw and n and vw.min() < 0:
+        raise GraphFormatError("negative node weight")
+    if has_ew and m and ew[:m].min() < 0:
+        raise GraphFormatError("negative edge weight")
     return HostGraph(
         xadj=xadj,
         adjncy=adjncy[:m],

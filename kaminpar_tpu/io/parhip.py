@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..graphs.host import HostGraph
+from .errors import GraphFormatError
 
 _HEADER_BYTES = 24
 
@@ -26,12 +27,31 @@ _HEADER_BYTES = 24
 def load_parhip(path: str) -> HostGraph:
     with open(path, "rb") as f:
         data = f.read()
-    return parse_parhip(data)
+    try:
+        return parse_parhip(data)
+    except GraphFormatError as e:
+        raise e.with_path(path) from None
+
+
+def _take(data: bytes, dtype, count: int, pos: int, what: str) -> np.ndarray:
+    """frombuffer with an explicit truncation error naming the section
+    and the byte offset where the file ran out."""
+    need = pos + count * np.dtype(dtype).itemsize
+    if len(data) < need:
+        raise GraphFormatError(
+            f"truncated ParHiP file: {what} needs bytes [{pos}, {need}), "
+            f"file has {len(data)}",
+            offset=len(data),
+        )
+    return np.frombuffer(data, dtype=dtype, count=count, offset=pos)
 
 
 def parse_parhip(data: bytes) -> HostGraph:
     if len(data) < _HEADER_BYTES:
-        raise ValueError("truncated ParHiP file")
+        raise GraphFormatError(
+            "truncated ParHiP file: missing 24-byte header",
+            offset=len(data),
+        )
     version, n, m = np.frombuffer(data[:_HEADER_BYTES], dtype=np.uint64)
     version = int(version)
     n, m = int(n), int(m)
@@ -44,29 +64,66 @@ def parse_parhip(data: bytes) -> HostGraph:
     ew_t = np.int32 if version >> 5 & 1 else np.int64
 
     pos = _HEADER_BYTES
-    offsets = np.frombuffer(data, dtype=eid_t, count=n + 1, offset=pos)
+    offsets = _take(data, eid_t, n + 1, pos, f"offset array (n={n})")
     pos += (n + 1) * np.dtype(eid_t).itemsize
-    # offsets are byte addresses of first neighbor; normalize to edge indices
+    # offsets are byte addresses of first neighbor; normalize to edge
+    # indices.  int64 view: corrupted huge uint64 values wrap and are
+    # caught by the monotonicity / alignment / total checks below.
     nid_size = np.dtype(nid_t).itemsize
-    xadj = (offsets.astype(np.int64) - int(offsets[0])) // nid_size
+    o64 = offsets.astype(np.int64)
+    if n and (np.diff(o64) < 0).any():
+        bad = int(np.flatnonzero(np.diff(o64) < 0)[0])
+        raise GraphFormatError(
+            f"non-monotone neighborhood offsets at node {bad}",
+            offset=_HEADER_BYTES + bad * np.dtype(eid_t).itemsize,
+        )
+    rel = o64 - int(o64[0])
+    if (rel % nid_size != 0).any():
+        raise GraphFormatError(
+            f"offsets not aligned to the {nid_size}-byte neighbor id size",
+            offset=_HEADER_BYTES,
+        )
+    xadj = rel // nid_size
     if xadj[-1] != m:
-        raise ValueError("ParHiP offsets inconsistent with edge count")
+        raise GraphFormatError(
+            f"offsets end at edge {int(xadj[-1])} but header claims m={m}",
+            offset=_HEADER_BYTES,
+        )
 
-    adjncy = np.frombuffer(data, dtype=nid_t, count=m, offset=pos).astype(np.int32)
+    adj_raw = _take(data, nid_t, m, pos, f"adjacency (m={m})")
+    if m and int(adj_raw.max()) >= n:
+        bad = int(np.flatnonzero(adj_raw >= np.uint64(n))[0])
+        raise GraphFormatError(
+            f"neighbor id {int(adj_raw[bad])} out of range [0, {n})",
+            offset=pos + bad * nid_size,
+        )
+    adjncy = adj_raw.astype(np.int32)
     pos += m * nid_size
 
     node_weights = None
     if has_node_weights:
-        node_weights = np.frombuffer(data, dtype=nw_t, count=n, offset=pos).astype(
-            np.int64
-        )
+        node_weights = _take(
+            data, nw_t, n, pos, f"node weights (n={n})"
+        ).astype(np.int64)
+        if n and node_weights.min() < 0:
+            bad = int(np.flatnonzero(node_weights < 0)[0])
+            raise GraphFormatError(
+                f"negative node weight at node {bad}",
+                offset=pos + bad * np.dtype(nw_t).itemsize,
+            )
         pos += n * np.dtype(nw_t).itemsize
 
     edge_weights = None
     if has_edge_weights:
-        edge_weights = np.frombuffer(data, dtype=ew_t, count=m, offset=pos).astype(
-            np.int64
-        )
+        edge_weights = _take(
+            data, ew_t, m, pos, f"edge weights (m={m})"
+        ).astype(np.int64)
+        if m and edge_weights.min() < 0:
+            bad = int(np.flatnonzero(edge_weights < 0)[0])
+            raise GraphFormatError(
+                f"negative edge weight at edge {bad}",
+                offset=pos + bad * np.dtype(ew_t).itemsize,
+            )
 
     return HostGraph(
         xadj=xadj,

@@ -32,6 +32,28 @@ _SRCS = [
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
+# Build-cache directory override (tests poison a tmp cache dir to
+# exercise the corrupted-cache clean-rebuild path without touching the
+# package's real artifacts) and the compile timeout.
+CACHE_DIR_ENV = "KAMINPAR_TPU_NATIVE_CACHE_DIR"
+BUILD_TIMEOUT_ENV = "KAMINPAR_TPU_NATIVE_BUILD_TIMEOUT"
+DEFAULT_BUILD_TIMEOUT_S = 300.0
+
+
+def cache_dir() -> str:
+    """Where built artifacts are cached (package dir unless overridden)."""
+    return os.environ.get(CACHE_DIR_ENV, "") or _DIR
+
+
+def build_timeout() -> float:
+    """Native compile timeout in seconds (KAMINPAR_TPU_NATIVE_BUILD_TIMEOUT;
+    a hung compiler must degrade to ctypes-free mode, not hang the run)."""
+    raw = os.environ.get(BUILD_TIMEOUT_ENV, "")
+    try:
+        return float(raw) if raw else DEFAULT_BUILD_TIMEOUT_S
+    except ValueError:
+        return DEFAULT_BUILD_TIMEOUT_S
+
 
 def sanitize_flags() -> list:
     """Extra compile flags from KMP_SANITIZE (e.g. 'address,undefined').
@@ -46,7 +68,15 @@ def sanitize_flags() -> list:
     return [f"-fsanitize={san}", "-fno-omit-frame-pointer", "-g", "-O1"]
 
 
-def _build() -> Optional[str]:
+def _build() -> str:
+    """Compile (or reuse) the cached native library; returns its path.
+
+    Raises resilience.NativeUnavailable on a missing toolchain, a failed
+    compile, or a compile exceeding build_timeout() — the structured
+    error the `native-build` degradation site routes to ctypes-free
+    mode."""
+    from ..resilience import NativeUnavailable
+
     h = hashlib.sha256()
     for src in _SRCS:
         with open(src, "rb") as f:
@@ -54,19 +84,27 @@ def _build() -> Optional[str]:
     # sanitized and plain builds must not share a cache slot
     h.update(",".join(sanitize_flags()).encode())
     tag = h.hexdigest()[:16]
-    out = os.path.join(_DIR, f"libkmpnative-{tag}.so")
+    cdir = cache_dir()
+    out = os.path.join(cdir, f"libkmpnative-{tag}.so")
     if os.path.exists(out):
         return out
-    # stale builds from older source versions
-    for name in os.listdir(_DIR):
-        if name.startswith("libkmpnative-") and name.endswith(".so"):
-            try:
-                os.remove(os.path.join(_DIR, name))
-            except OSError:
-                pass
+    try:
+        os.makedirs(cdir, exist_ok=True)
+        # stale builds from older source versions
+        for name in os.listdir(cdir):
+            if name.startswith("libkmpnative-") and name.endswith(".so"):
+                try:
+                    os.remove(os.path.join(cdir, name))
+                except OSError:
+                    pass
+    except OSError as e:
+        # an unusable cache dir (bad KAMINPAR_TPU_NATIVE_CACHE_DIR,
+        # permissions) must degrade to ctypes-free mode, not crash
+        raise NativeUnavailable(f"build cache dir unusable: {e}") from e
+    tmp_path = None
     try:
         with tempfile.NamedTemporaryFile(
-            suffix=".so", dir=_DIR, delete=False
+            suffix=".so", dir=cdir, delete=False
         ) as tmp:
             tmp_path = tmp.name
         subprocess.run(
@@ -80,27 +118,76 @@ def _build() -> Optional[str]:
              *_SRCS, "-o", tmp_path],
             check=True,
             capture_output=True,
+            timeout=build_timeout(),
         )
         os.replace(tmp_path, out)
         return out
-    except (OSError, subprocess.CalledProcessError):
-        return None
+    except subprocess.TimeoutExpired as e:
+        raise NativeUnavailable(
+            f"native build timed out after {build_timeout():.0f}s "
+            f"(raise {BUILD_TIMEOUT_ENV} if the toolchain is just slow)"
+        ) from e
+    except subprocess.CalledProcessError as e:
+        stderr = (e.stderr or b"").decode("utf-8", "replace")[-400:]
+        raise NativeUnavailable(f"g++ failed: {stderr}") from e
+    except OSError as e:
+        raise NativeUnavailable(f"toolchain unavailable: {e}") from e
+    finally:
+        if tmp_path is not None and os.path.exists(tmp_path):
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+
+
+def _load_native() -> ctypes.CDLL:
+    """Build + dlopen + bind signatures, with ONE automatic clean-rebuild
+    retry when the cached artifact is corrupted (truncated file, wrong
+    architecture, poisoned cache dir: dlopen or symbol binding fails)."""
+    from ..resilience import NativeUnavailable
+    from ..utils.logger import log_warning
+
+    path = _build()
+    try:
+        return _bind(ctypes.CDLL(path))
+    except (OSError, AttributeError) as e:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        log_warning(
+            f"native build cache corrupted ({type(e).__name__}: "
+            f"{str(e)[:120]}); clean rebuild"
+        )
+        path = _build()  # artifact removed -> full recompile
+        try:
+            return _bind(ctypes.CDLL(path))
+        except (OSError, AttributeError) as e2:
+            raise NativeUnavailable(
+                f"native library unusable after clean rebuild: {e2}"
+            ) from e2
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """The native library, building it on first call; None if unavailable."""
+    """The native library, building it on first call; None if unavailable.
+
+    Build/load failures degrade through the `native-build` site: a
+    `degraded` telemetry event is emitted once and every native entry
+    point falls back to its ctypes-free numpy twin for the rest of the
+    process."""
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    path = _build()
-    if path is None:
-        return None
-    try:
-        lib = ctypes.CDLL(path)
-    except OSError:
-        return None
+    from ..resilience import with_fallback
 
+    _lib = with_fallback(_load_native, lambda exc: None, site="native-build")
+    return _lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every exported symbol's signature (raises AttributeError
+    on a library that is loadable but not ours — a corrupted cache)."""
     i64 = ctypes.c_int64
     p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
     p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -154,8 +241,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.kmp_encode_v2_weights.argtypes = [i64, p_i64, p_i32, p_i64, p_i64, p_u8]
     lib.kmp_decode_v2_weights.restype = None
     lib.kmp_decode_v2_weights.argtypes = [i64, p_i64, p_i64, p_u8, p_i64]
-    _lib = lib
-    return _lib
+    return lib
 
 
 def available() -> bool:

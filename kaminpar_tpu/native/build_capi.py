@@ -49,8 +49,39 @@ def build(out_dir: str | None = None) -> str:
         f"-lpython{version}",
         "-o", out,
     ]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
-    return out
+    # the compile runs under the shared native-build timeout
+    # (KAMINPAR_TPU_NATIVE_BUILD_TIMEOUT) and surfaces failure as the
+    # structured NativeUnavailable of the `native-build` degradation
+    # site; a stale/corrupted previous artifact gets one clean retry
+    # (link errors against a half-written .so are retried without it)
+    from . import build_timeout
+    from ..resilience import NativeUnavailable
+
+    for attempt in (0, 1):
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True,
+                timeout=build_timeout(),
+            )
+            return out
+        except subprocess.TimeoutExpired as e:
+            raise NativeUnavailable(
+                f"C-API build timed out after {build_timeout():.0f}s "
+                "(KAMINPAR_TPU_NATIVE_BUILD_TIMEOUT raises the limit)"
+            ) from e
+        except subprocess.CalledProcessError as e:
+            if attempt == 0 and os.path.exists(out):
+                try:
+                    os.remove(out)  # clean-rebuild retry
+                    continue
+                except OSError:
+                    pass
+            raise NativeUnavailable(
+                f"C-API build failed: {(e.stderr or '')[-400:]}"
+            ) from e
+        except OSError as e:
+            raise NativeUnavailable(f"toolchain unavailable: {e}") from e
+    raise AssertionError("unreachable")
 
 
 if __name__ == "__main__":

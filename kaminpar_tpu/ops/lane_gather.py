@@ -150,12 +150,18 @@ def _scatter_plan(key_s, pos_s, qloc_s, chunk_start, region_off, H):
     return q, inv
 
 
-class PlanBlowupError(ValueError):
+from ..resilience.errors import PlanBlowup
+
+
+class PlanBlowupError(PlanBlowup, ValueError):
     """build_gather_plan aborted: the routed plan would exceed max_slots.
 
     Raised BEFORE the H*128-wide q/inv arrays are materialized, so a
     hub-skewed level can be rejected without first allocating the very
-    blowup the cap exists to prevent."""
+    blowup the cap exists to prevent.  Subclasses the structured
+    resilience.PlanBlowup, so the `lane-gather` site's with_fallback
+    wrapper classifies it and degrades to the XLA gather (ValueError is
+    kept for backward compatibility with pre-resilience callers)."""
 
     def __init__(self, num_slots: int, max_slots: int) -> None:
         self.num_slots = num_slots
@@ -384,30 +390,13 @@ def edge_plans(graph):
     m = int(graph.dst.shape[0])
     cap = slot_cap(m)
     from .. import telemetry
+    from ..resilience import with_fallback
 
-    try:
+    def _build_pack():
         # the cap aborts inside the builder, BEFORE the H*128-wide
         # q/inv arrays exist — a hub-skewed level must not allocate
         # the very blowup it is being rejected for
         plan = build_gather_plan(graph.dst, graph.n_pad, max_slots=cap)
-    except PlanBlowupError as e:
-        pad_overhead = e.num_slots / max(m, 1)
-        telemetry.event(
-            "lane-gather-plan",
-            m=m,
-            num_slots=e.num_slots,
-            pad_overhead=round(pad_overhead, 4),
-            capped=True,
-        )
-        from ..utils.logger import log_progress
-
-        log_progress(
-            f"lane-gather: plan discarded (num_slots={e.num_slots} > "
-            f"{PLAN_MAX_SLOT_RATIO}x m={m}, pad overhead "
-            f"{pad_overhead:.2f}x); falling back to the XLA gather"
-        )
-        pack = None
-    else:
         telemetry.event(
             "lane-gather-plan",
             m=m,
@@ -417,12 +406,42 @@ def edge_plans(graph):
         )
         n_pad = graph.n_pad
         owner_key = route_codata(plan, graph.src, n_pad - 1)
-        pack = EdgePlans(
+        return EdgePlans(
             plan=plan,
             owner_key=owner_key,
             src_idx=jnp.clip(owner_key, 0, n_pad - 1),
             edge_w=route_codata(plan, graph.edge_w, 0),
         )
+
+    def _xla_fallback(exc):
+        num_slots = getattr(exc, "num_slots", None)
+        pad_overhead = (
+            round(num_slots / max(m, 1), 4) if num_slots is not None
+            else None
+        )
+        telemetry.event(
+            "lane-gather-plan",
+            m=m,
+            num_slots=num_slots,
+            pad_overhead=pad_overhead,
+            capped=True,
+        )
+        from ..utils.logger import log_progress
+
+        detail = (
+            f"num_slots={num_slots} > {PLAN_MAX_SLOT_RATIO}x m={m}, "
+            f"pad overhead {pad_overhead}x"
+            if num_slots is not None
+            else f"{type(exc).__name__}" if exc is not None
+            else "circuit breaker open"
+        )
+        log_progress(
+            f"lane-gather: plan discarded ({detail}); falling back to "
+            "the XLA gather"
+        )
+        return None
+
+    pack = with_fallback(_build_pack, _xla_fallback, site="lane-gather")
     if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
         _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
     _PLAN_CACHE[key] = (graph.dst, pack)

@@ -193,7 +193,21 @@ class dKaMinPar:
                 # node weights alone
                 full = np.zeros(self._fine_dg.n_pad, dtype=np.int32)
                 full[: graph.n] = partition
-                cut = dist_edge_cut_of(self._fine_dg, jnp.asarray(full))
+                # `collective` degradation site: the sharded cut
+                # reduction can time out / OOM on a sick link — degrade
+                # to the host-side cut (decoding if needed) rather than
+                # losing the whole run at the metrics step
+                from ..resilience import with_fallback
+
+                fine_dg = self._fine_dg
+                cut = with_fallback(
+                    lambda: dist_edge_cut_of(fine_dg, jnp.asarray(full)),
+                    lambda exc: self._host_cut(
+                        self._plain(graph), partition
+                    ),
+                    site="collective",
+                    where="dist-result-cut",
+                )
                 import math as pymath
 
                 nw = graph.node_weight_array()

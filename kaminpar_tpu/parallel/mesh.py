@@ -59,6 +59,12 @@ COMM_CAVEAT = (
 
 _comm_log: Dict[Tuple[str, str, tuple], List[int]] = {}
 _comm_phase: List[str] = []
+# phase name -> number of times its scope was OPENED.  A phase opened
+# more often than it traced ran (at least partly) on cached executables;
+# a phase opened with ZERO traced keys is a pure cache hit — its traffic
+# happened, but trace-time accounting cannot see it.  comm_table() marks
+# those rows explicitly (ADVICE round 5 low #4).
+_phase_opens: Dict[str, int] = {}
 
 
 @contextmanager
@@ -69,6 +75,7 @@ def comm_phase(name: str):
         yield
     finally:
         _comm_phase.pop()
+        _phase_opens[name] = _phase_opens.get(name, 0) + 1
 
 
 def account_collective(op: str, nbytes: int, shape=None) -> None:
@@ -102,6 +109,22 @@ def account_collective(op: str, nbytes: int, shape=None) -> None:
 
 def reset_comm_log() -> None:
     _comm_log.clear()
+    _phase_opens.clear()
+
+
+def phase_opens() -> Dict[str, int]:
+    """How many times each comm_phase scope was opened (run-report
+    `comm.phase_opens`; compare against per-phase traced_calls to spot
+    executable-cache reuse)."""
+    return dict(_phase_opens)
+
+
+def cache_hit_phases() -> List[str]:
+    """Phases that were opened but traced NO collective: their programs
+    were executable-cache hits, so the account shows zero bytes for
+    traffic that really happened."""
+    traced = {phase for (phase, _op, _shape) in _comm_log}
+    return sorted(p for p in _phase_opens if p not in traced)
 
 
 def comm_records() -> List[dict]:
@@ -120,17 +143,38 @@ def comm_records() -> List[dict]:
 
 def comm_table() -> str:
     """Render the per-phase collective account (traced ops; for ops
-    inside round loops the figures are per round per device)."""
-    if not _comm_log:
+    inside round loops the figures are per round per device).  Phases
+    whose scope was opened but traced nothing are listed explicitly as
+    cache hits instead of being indistinguishable from silent phases."""
+    hit_phases = cache_hit_phases()
+    if not _comm_log and not hit_phases:
         return "(comm accounting: no collectives traced)"
     lines = [
         f"(caveat: {COMM_CAVEAT})",
         "phase | collective | traced shape | traced calls | "
         "payload bytes/device",
     ]
+    phase_calls: Dict[str, int] = {}
     for (phase, op, shape), (calls, nbytes) in sorted(_comm_log.items()):
         shp = "x".join(str(d) for d in shape) if shape else "-"
         lines.append(f"{phase} | {op} | {shp} | {calls} | {nbytes}")
+        phase_calls[phase] = phase_calls.get(phase, 0) + calls
+    # opens > total traced calls PROVES at least one opening traced
+    # nothing (per-row comparison would mislabel a phase that traces a
+    # different shape on each opening); one summary line per such phase
+    for phase, total in sorted(phase_calls.items()):
+        opens = _phase_opens.get(phase, 0)
+        if opens > total:
+            lines.append(
+                f"{phase} | (partly cache-hit: opened {opens}x, traced "
+                f"{total} call(s); remaining openings reused cached "
+                f"executables) | - | 0 | 0"
+            )
+    for phase in hit_phases:
+        lines.append(
+            f"{phase} | (cache-hit: executable reused, traffic not "
+            f"re-traced) | - | 0 | 0 (opened {_phase_opens[phase]}x)"
+        )
     return "\n".join(lines)
 
 

@@ -186,7 +186,8 @@ class DeepMultilevelPartitioner:
 
         refiner = RefinerPipeline(self.ctx, current_k)
         partition = refiner.enforce_balance_host(
-            dgraph, partition, np.asarray(self.ctx.partition.max_block_weights)
+            dgraph, partition,
+            np.asarray(self.ctx.partition.max_block_weights), where="deep",
         )
         return np.asarray(partition)[: graph.n]
 

@@ -127,6 +127,7 @@ class KWayMultilevelPartitioner:
 
         # strict balance backstop on the finest level
         partition = refiner.enforce_balance_host(
-            dgraph, partition, np.asarray(ctx.partition.max_block_weights)
+            dgraph, partition, np.asarray(ctx.partition.max_block_weights),
+            where="kway",
         )
         return np.asarray(partition)[: graph.n]

@@ -54,7 +54,8 @@ class RBMultilevelPartitioner:
                     dgraph, jnp.asarray(padded), max_bw, min_bw, seed=ctx.seed
                 )
                 refined = refiner.enforce_balance_host(
-                    dgraph, refined, np.asarray(ctx.partition.max_block_weights)
+                    dgraph, refined,
+                    np.asarray(ctx.partition.max_block_weights), where="rb",
                 )
                 part = np.asarray(refined)[: graph.n]
         return part

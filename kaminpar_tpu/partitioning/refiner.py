@@ -54,6 +54,7 @@ class RefinerPipeline:
         level: int = 0,
         num_levels: int = 1,
     ) -> jax.Array:
+        from ..resilience import with_fallback
         from ..utils import statistics
         from ..ops.segments import pad_k_bucket
 
@@ -64,14 +65,60 @@ class RefinerPipeline:
             salt = jnp.int32((seed * 2654435761 + i * 40503 + level) & 0x7FFFFFFF)
             if algorithm == RefinementAlgorithm.NOOP:
                 continue
-            elif algorithm == RefinementAlgorithm.LABEL_PROPAGATION:
+            step = self._make_step(
+                algorithm, graph, k, max_block_weights, min_block_weights,
+                salt, seed + i, level, num_levels,
+            )
+            if step is None:
+                continue
+            # Jet-style recoverability (the Gilbert et al. / Mt-KaHyPar
+            # discipline): a refiner step that fails — device OOM, a
+            # refusal, an injected chaos fault — is rolled back to the
+            # best-known partition (its input) instead of aborting the
+            # run; the balancer step instead degrades to the exact host
+            # balancer so the balance guarantee is not lost with it.
+            prev = partition
+            if algorithm == RefinementAlgorithm.OVERLOAD_BALANCER:
+                partition = with_fallback(
+                    lambda s=step: s(prev),
+                    lambda exc: self._host_balance(
+                        graph, prev, np.asarray(max_block_weights)
+                    ),
+                    site="device-balancer",
+                    where=f"level{level}",
+                )
+            else:
+                partition = with_fallback(
+                    lambda s=step: s(prev),
+                    lambda exc: prev,
+                    site="refiner",
+                    where=f"{algorithm.value}@level{level}",
+                )
+            if statistics.enabled():
+                statistics.track(
+                    f"cut_after_{algorithm.value}",
+                    int(metrics.edge_cut(graph, partition)),
+                )
+                statistics.count(f"runs_{algorithm.value}")
+        return partition
+
+    def _make_step(
+        self, algorithm, graph, k, max_block_weights, min_block_weights,
+        salt, seed, level, num_levels,
+    ):
+        """One refinement algorithm as a partition -> partition closure
+        (the unit the degradation contract wraps); None = skipped."""
+        if algorithm == RefinementAlgorithm.LABEL_PROPAGATION:
+            def step(partition):
                 with timer.scoped_timer("lp-refinement"):
-                    partition = lp_refine(
-                        graph, partition, k, max_block_weights, salt, self._lp_cfg
+                    return lp_refine(
+                        graph, partition, k, max_block_weights, salt,
+                        self._lp_cfg,
                     )
-            elif algorithm == RefinementAlgorithm.OVERLOAD_BALANCER:
+        elif algorithm == RefinementAlgorithm.OVERLOAD_BALANCER:
+            def step(partition):
                 with timer.scoped_timer("overload-balancer"):
-                    partition = balancer_ops.overload_balance(
+                    return balancer_ops.overload_balance(
                         graph,
                         partition,
                         k,
@@ -79,11 +126,13 @@ class RefinerPipeline:
                         salt,
                         max_rounds=self.ctx.refinement.balancer.max_rounds,
                     )
-            elif algorithm == RefinementAlgorithm.UNDERLOAD_BALANCER:
-                if min_block_weights is None:
-                    continue
+        elif algorithm == RefinementAlgorithm.UNDERLOAD_BALANCER:
+            if min_block_weights is None:
+                return None
+
+            def step(partition):
                 with timer.scoped_timer("underload-balancer"):
-                    partition = balancer_ops.underload_balance(
+                    return balancer_ops.underload_balance(
                         graph,
                         partition,
                         k,
@@ -92,18 +141,20 @@ class RefinerPipeline:
                         salt,
                         max_rounds=self.ctx.refinement.balancer.max_rounds,
                     )
-            elif algorithm == RefinementAlgorithm.JET:
-                from ..ops.jet import jet_refine
+        elif algorithm == RefinementAlgorithm.JET:
+            from ..ops.jet import jet_refine
 
-                jet_ctx = self.ctx.refinement.jet
-                if self.light:
-                    jet_ctx = dataclasses.replace(
-                        jet_ctx,
-                        num_rounds_on_fine_level=1,
-                        num_rounds_on_coarse_level=1,
-                    )
+            jet_ctx = self.ctx.refinement.jet
+            if self.light:
+                jet_ctx = dataclasses.replace(
+                    jet_ctx,
+                    num_rounds_on_fine_level=1,
+                    num_rounds_on_coarse_level=1,
+                )
+
+            def step(partition):
                 with timer.scoped_timer("jet"):
-                    partition = jet_refine(
+                    return jet_refine(
                         graph,
                         partition,
                         k,
@@ -113,9 +164,10 @@ class RefinerPipeline:
                         level=level,
                         num_levels=num_levels,
                     )
-            elif algorithm == RefinementAlgorithm.MTKAHYPAR:
-                from ..refinement.mtkahypar import mtkahypar_refine_host
+        elif algorithm == RefinementAlgorithm.MTKAHYPAR:
+            from ..refinement.mtkahypar import mtkahypar_refine_host
 
+            def step(partition):
                 with timer.scoped_timer("mtkahypar"):
                     host = host_graph_from_device(graph)
                     part_h = np.asarray(partition)[: host.n]
@@ -128,66 +180,50 @@ class RefinerPipeline:
                             : self.k
                         ],
                         epsilon=self.ctx.partition.epsilon,
-                        seed=seed + i,
+                        seed=seed,
                         threads=self.ctx.parallel.num_workers,
                     )
                     full = np.zeros(graph.n_pad, dtype=np.int32)
                     full[: host.n] = refined
-                    partition = jnp.asarray(full)
-            elif algorithm == RefinementAlgorithm.GREEDY_FM:
-                # FM earns its host round-trip where moves are worth the
-                # most polish: the finest levels (coarse-level structure
-                # is Jet's job, and a full FM pass there re-pays ~0.1%
-                # cut for full pass cost).  Light intermediate extensions
-                # skip it entirely like they skip full Jet.
-                if self.light or level > self.ctx.refinement.fm.max_level:
-                    continue
-                from ..refinement.fm import fm_refine_host
+                    return jnp.asarray(full)
+        elif algorithm == RefinementAlgorithm.GREEDY_FM:
+            # FM earns its host round-trip where moves are worth the
+            # most polish: the finest levels (coarse-level structure
+            # is Jet's job, and a full FM pass there re-pays ~0.1%
+            # cut for full pass cost).  Light intermediate extensions
+            # skip it entirely like they skip full Jet.
+            if self.light or level > self.ctx.refinement.fm.max_level:
+                return None
+            from ..refinement.fm import fm_refine_host
 
+            def step(partition):
                 with timer.scoped_timer("kway-fm"):
-                    partition = fm_refine_host(
+                    return fm_refine_host(
                         graph,
                         partition,
                         self.k,
                         max_block_weights[: self.k],
                         self.ctx.refinement.fm,
-                        seed=seed + i,
+                        seed=seed,
                         # reference-style worker pool (fm_refiner.cc:48);
                         # 1 on this dev box (one logical CPU) keeps runs
                         # bitwise-deterministic
                         threads=self.ctx.parallel.num_workers,
                     )
-            else:
-                log_warning(f"unknown refinement algorithm: {algorithm}")
-            if statistics.enabled():
-                statistics.track(
-                    f"cut_after_{algorithm.value}",
-                    int(metrics.edge_cut(graph, partition)),
-                )
-                statistics.count(f"runs_{algorithm.value}")
-        return partition
+        else:
+            log_warning(f"unknown refinement algorithm: {algorithm}")
+            return None
+        return step
 
-    def enforce_balance_host(
+    def _host_balance(
         self,
         graph: DeviceGraph,
         partition: jax.Array,
         max_block_weights: np.ndarray,
     ) -> jax.Array:
-        """Exact host fallback for the strict balance guarantee
-        (README.MD:18) when device balancing rounds stall."""
-        over = int(
-            metrics.total_overload(
-                graph, partition, jnp.asarray(max_block_weights)
-            )
-        )
-        if over == 0:
-            return partition
-        from .. import telemetry
-
-        # the device balancers stalled with residual overload — a silent
-        # quality/perf decision the run report must show
-        telemetry.event("balancer-host-fallback", residual_overload=over)
-        log_debug(f"host balance fallback, residual overload {over}")
+        """The exact host balancer as a device-partition transform (the
+        device-balancer site's fallback and enforce_balance_host's
+        engine)."""
         host = host_graph_from_device(graph)
         n = host.n
         part_h = np.asarray(partition)[:n].copy()
@@ -200,3 +236,37 @@ class RefinerPipeline:
         full = np.zeros(graph.n_pad, dtype=np.int32)
         full[:n] = balanced
         return jnp.asarray(full)
+
+    def enforce_balance_host(
+        self,
+        graph: DeviceGraph,
+        partition: jax.Array,
+        max_block_weights: np.ndarray,
+        where: str = "",
+    ) -> jax.Array:
+        """Exact host fallback for the strict balance guarantee
+        (README.MD:18) when device balancing rounds stall.  `where`
+        labels the calling driver phase in the telemetry event, so a
+        degraded balancer in `deep` uncoarsening reads differently from
+        one in a `vcycle` restart."""
+        over = int(
+            metrics.total_overload(
+                graph, partition, jnp.asarray(max_block_weights)
+            )
+        )
+        if over == 0:
+            return partition
+        from .. import telemetry
+
+        # the device balancers stalled with residual overload — a silent
+        # quality/perf decision the run report must show
+        telemetry.event(
+            "balancer-host-fallback",
+            residual_overload=over,
+            where=where or None,
+        )
+        log_debug(
+            f"host balance fallback{' (' + where + ')' if where else ''}, "
+            f"residual overload {over}"
+        )
+        return self._host_balance(graph, partition, max_block_weights)

@@ -151,6 +151,7 @@ class VcycleDeepMultilevelPartitioner:
             )
 
         current_part = refiner.enforce_balance_host(
-            dgraph, current_part, np.asarray(ctx.partition.max_block_weights)
+            dgraph, current_part,
+            np.asarray(ctx.partition.max_block_weights), where="vcycle",
         )
         return np.asarray(current_part)[: graph.n]

@@ -54,25 +54,7 @@ def fm_refine_host(
 
     import os
 
-    native_ok = os.environ.get("KAMINPAR_TPU_NO_NATIVE_FM", "") != "1"
-    refused = False
-    if native_ok:
-        from .. import native
-
-        # native localized BATCH FM (fm.cpp — the reference's parallel
-        # localized scheme minus threads: seeded regions grown against a
-        # delta gain overlay, best prefixes committed)
-        improvement = native.fm_refine(
-            graph, part, k, max_bw, ctx, seed, threads=threads
-        )
-        # native FM REFUSED to run (k above the sparse 16-bit tag limit
-        # with the dense table unaffordable): return the partition
-        # unchanged rather than falling into the numpy pass below, whose
-        # dense (n, k) gain cache is unaffordable at exactly these k.
-        # fm_refine already recorded the fm-refused telemetry event.
-        refused = improvement == native.FM_REFUSED
-        native_ok = improvement is not None and not refused
-    if not native_ok and not refused:
+    def _numpy_fm() -> np.ndarray:
         node_w = graph.node_weight_array()
         edge_w = graph.edge_weight_array()
         rng = np.random.default_rng(seed)
@@ -82,6 +64,53 @@ def fm_refine_host(
             )
             if improvement <= 0:
                 break
+        return part
+
+    if os.environ.get("KAMINPAR_TPU_NO_NATIVE_FM", "") == "1":
+        # explicit opt-out, not a degradation: no fallback event
+        part = _numpy_fm()
+    else:
+        from ..resilience import (
+            NativeUnavailable,
+            RefinerRefused,
+            with_fallback,
+        )
+
+        def _native_fm() -> np.ndarray:
+            from .. import native
+
+            # native localized BATCH FM (fm.cpp — the reference's
+            # parallel localized scheme minus threads: seeded regions
+            # grown against a delta gain overlay, best prefixes
+            # committed); refines `part` in place
+            improvement = native.fm_refine(
+                graph, part, k, max_bw, ctx, seed, threads=threads
+            )
+            if improvement is None:
+                raise NativeUnavailable(
+                    "native FM library unavailable (build failed or "
+                    "no toolchain)"
+                )
+            if improvement == native.FM_REFUSED:
+                # fm_refine already recorded the fm-refused telemetry
+                # event; surface the refusal as a structured exception
+                # so the policy wrapper routes it — NOT as zero gain
+                raise RefinerRefused(
+                    f"native FM refused to run at n={graph.n}, k={k}"
+                )
+            return part
+
+        def _fm_fallback(exc) -> np.ndarray:
+            # a REFUSAL (k above the sparse engine's 16-bit tag limit
+            # with the dense table unaffordable) returns the partition
+            # unchanged: the numpy pass's dense (n, k) gain cache is
+            # unaffordable at exactly these k.  Everything else
+            # (unavailable native lib, OOM) runs the numpy FM twin.
+            if isinstance(exc, RefinerRefused) and not exc.injected:
+                return part
+            return _numpy_fm()
+
+        part = with_fallback(_native_fm, _fm_fallback, site="native-fm")
 
     padded = np.zeros(dgraph.n_pad, dtype=np.int32)
     padded[:n] = part

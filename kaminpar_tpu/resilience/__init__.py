@@ -1,0 +1,53 @@
+"""Graceful degradation, fault injection, and the strict-balance gate.
+
+Three pieces (see docs/robustness.md for the operator view):
+
+  * the **degradation contract** — structured exception types
+    (errors.py) plus :func:`with_fallback`, the policy wrapper with
+    bounded retry and a per-site circuit breaker (policy.py), wired
+    through every optional fast path so a failure degrades visibly (a
+    ``degraded`` telemetry event) instead of aborting the run or going
+    silent;
+  * the **fault-injection harness** — ``KAMINPAR_TPU_FAULTS`` site plans
+    (faults.py), deterministic by seed, driving the chaos suite
+    (tests/test_resilience.py) and the check_all.sh chaos smoke stage;
+  * the **strict-balance output gate** — end-of-pipeline host validation
+    of partition invariants with a greedy repair pass (gate.py), so
+    ``KaMinPar.compute_partition``'s postcondition holds no matter which
+    paths degraded.
+"""
+
+from .errors import (  # noqa: F401
+    CollectiveTimeout,
+    DegradationError,
+    DeviceOOM,
+    NativeUnavailable,
+    PlanBlowup,
+    RefinerRefused,
+    classify,
+)
+from .faults import (  # noqa: F401
+    ENV_VAR as FAULTS_ENV_VAR,
+    FaultPlanError,
+    SITES,
+    injected_log,
+    maybe_inject,
+    parse_plan,
+    plan_summary,
+    site_spec,
+)
+from .policy import (  # noqa: F401
+    BREAKER_THRESHOLD,
+    breaker_state,
+    reset_breakers,
+    with_fallback,
+)
+from . import gate  # noqa: F401
+
+
+def reset() -> None:
+    """Reset injection counters and circuit breakers (test isolation)."""
+    from . import faults as _faults
+
+    _faults.reset()
+    reset_breakers()

@@ -1,0 +1,117 @@
+"""Structured degradation exceptions — the failure vocabulary of the
+pipeline's optional fast paths.
+
+Every optional accelerator path (native FM/IP via the C-API, routed
+lane-gather plans, compressed-graph streaming, device balancers,
+distributed collectives) can refuse, crash, or time out.  Instead of a
+bare ``except Exception`` at each call site (a tpulint-documented hazard,
+docs/static_analysis.md), failures are raised as one of these types and
+routed through :func:`kaminpar_tpu.resilience.with_fallback`, which pairs
+each registered *site* with its documented fallback and emits a
+``degraded`` telemetry event (docs/robustness.md has the full matrix).
+
+The hierarchy is deliberately flat: callers either handle
+:class:`DegradationError` (the policy wrapper) or a specific subtype
+(tests, site-local handling).  ``injected=True`` marks exceptions raised
+by the fault-injection harness (``KAMINPAR_TPU_FAULTS``) so chaos tests
+can tell simulated failures from real ones in the telemetry stream.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class DegradationError(RuntimeError):
+    """Base of all structured fast-path failures.
+
+    Attributes:
+      site      registered fault-site name ("" until the policy wrapper
+                stamps it)
+      injected  True when raised by the fault-injection harness
+
+    Class attribute ``breaker_relevant``: whether failures of this type
+    advance the site's circuit breaker.  Crash-shaped failures (missing
+    native lib, OOM, timeout) do; deterministic data-dependent REFUSALS
+    (plan blowup on a skewed level, FM refusing a too-large k) do not —
+    a legitimate refusal on one input must not disable the fast path
+    for the next input.
+    """
+
+    breaker_relevant = True
+
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        site: Optional[str] = None,
+        injected: bool = False,
+    ) -> None:
+        super().__init__(message or type(self).__name__)
+        self.site = site or ""
+        self.injected = bool(injected)
+
+
+class NativeUnavailable(DegradationError):
+    """The native (C++/ctypes) component could not be built, loaded, or
+    run — missing toolchain, build timeout, or a corrupted build cache.
+    Fallback: the pure-numpy/ctypes-free twin of the same entry point."""
+
+
+class PlanBlowup(DegradationError):
+    """A routed lane-gather plan would exceed its slot budget (one
+    high-degree hub inflating H*128 past PLAN_MAX_SLOT_RATIO * m).
+    Fallback: the plain XLA gather.  A refusal, not a fault: does not
+    advance the circuit breaker."""
+
+    breaker_relevant = False
+
+
+class RefinerRefused(DegradationError):
+    """A refiner declined to run at the current (n, k) — e.g. native FM's
+    INT64_MIN sentinel when k exceeds the sparse engine's 16-bit packed
+    tags and the dense (n, k) table is unaffordable.  Fallback: return
+    the partition unchanged (refusal, not failure: no moves were made).
+    Does not advance the circuit breaker."""
+
+    breaker_relevant = False
+
+
+class CollectiveTimeout(DegradationError):
+    """A cross-process collective (timer aggregation, metric allgather)
+    timed out or failed.  Fallback: continue with local-only data."""
+
+
+class DeviceOOM(DegradationError):
+    """The accelerator (or host, for MemoryError) ran out of memory in an
+    optional fast path.  Fallback: the path's smaller-footprint twin
+    (host balancer, uncompressed CSR, XLA gather)."""
+
+
+#: Raw-exception markers that classify as DeviceOOM.  XLA surfaces
+#: allocator failure as XlaRuntimeError("RESOURCE_EXHAUSTED: ...").
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def classify(exc: BaseException, site: str) -> Optional[DegradationError]:
+    """Map a raw exception to a structured degradation, or None.
+
+    * DegradationError passes through (site stamped if missing);
+    * MemoryError and XLA RESOURCE_EXHAUSTED become :class:`DeviceOOM`;
+    * anything else returns None — the caller must re-raise, NOT swallow
+      (an unclassified exception is a bug, not a degradation).
+    """
+    if isinstance(exc, DegradationError):
+        if not exc.site:
+            exc.site = site
+        return exc
+    if isinstance(exc, MemoryError):
+        err = DeviceOOM(f"host allocation failed: {exc}", site=site)
+        err.__cause__ = exc
+        return err
+    text = f"{type(exc).__name__}: {exc}"
+    if any(marker in text for marker in _OOM_MARKERS):
+        err = DeviceOOM(text, site=site)
+        err.__cause__ = exc
+        return err
+    return None

@@ -1,0 +1,258 @@
+"""Fault-site registry and the ``KAMINPAR_TPU_FAULTS`` injection harness.
+
+Every optional fast path that can degrade registers a *site* here: a
+stable name, the structured exception its failures surface as, and a
+one-line description of the fallback (the degradation matrix rendered in
+docs/robustness.md).  :func:`kaminpar_tpu.resilience.with_fallback`
+refuses unregistered sites, so the registry is the single source of
+truth for the chaos suite, the run-report fault-plan echo, and the docs.
+
+Injection plans come from the environment::
+
+    KAMINPAR_TPU_FAULTS=site[:spec][,site[:spec]...]
+
+where ``site`` is a registered name or ``all``, and ``spec`` is
+
+  * omitted or ``always`` — every call at the site fails,
+  * ``nth=K``            — exactly the K-th call at the site fails
+                           (1-based; ``all:nth=1`` is the chaos smoke
+                           plan: first call at EVERY site fails once),
+  * a float in (0, 1]    — each call fails with that probability,
+                           drawn deterministically from the global seed
+                           (utils.rng), the site name, and the per-site
+                           call counter — reruns inject identically.
+
+The harness is dormant (two dict lookups) when the variable is unset.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Type
+
+from .errors import (
+    CollectiveTimeout,
+    DegradationError,
+    DeviceOOM,
+    NativeUnavailable,
+    PlanBlowup,
+    RefinerRefused,
+)
+
+ENV_VAR = "KAMINPAR_TPU_FAULTS"
+
+
+@dataclass(frozen=True)
+class SiteSpec:
+    """One registered degradation site (a row of the degradation matrix)."""
+
+    name: str
+    exc: Type[DegradationError]
+    fallback: str  # human-readable fallback description (docs + events)
+    description: str
+
+
+# Registered in pipeline order; with_fallback() rejects names not listed
+# here.  Adding a site means adding a row HERE plus its wiring, a chaos
+# test, and a docs/robustness.md matrix row.
+SITES: Dict[str, SiteSpec] = {}
+
+
+def _register(spec: SiteSpec) -> None:
+    SITES[spec.name] = spec
+
+
+_register(SiteSpec(
+    "native-build", NativeUnavailable,
+    "ctypes-free mode (numpy codecs, python parsers)",
+    "g++ build / dlopen of the native library (native/__init__.py)",
+))
+_register(SiteSpec(
+    "native-ip", NativeUnavailable,
+    "pure-numpy multilevel bipartitioner",
+    "native sequential initial bipartitioner (initial/bipartitioner.py)",
+))
+_register(SiteSpec(
+    "native-fm", RefinerRefused,
+    "numpy FM pass (or unchanged partition on refusal)",
+    "native localized batch k-way FM (refinement/fm.py)",
+))
+_register(SiteSpec(
+    "refiner", DeviceOOM,
+    "rollback to the pre-step partition (best known)",
+    "one refinement algorithm step (partitioning/refiner.py)",
+))
+_register(SiteSpec(
+    "device-balancer", DeviceOOM,
+    "exact greedy host balancer",
+    "device overload-balancing rounds (ops/balancer.py)",
+))
+_register(SiteSpec(
+    "lane-gather", PlanBlowup,
+    "plain XLA gather (no routed plan for the level)",
+    "routed lane-gather plan build (ops/lane_gather.py)",
+))
+_register(SiteSpec(
+    "compressed-stream", DeviceOOM,
+    "decode to uncompressed host CSR and re-partition",
+    "chunk-streamed device upload of a compressed graph (graphs/csr.py)",
+))
+_register(SiteSpec(
+    "collective", CollectiveTimeout,
+    "local-only data (skip cross-process aggregation)",
+    "host-side cross-process gathers (telemetry/report.py, dist driver)",
+))
+
+
+@dataclass
+class _FaultRule:
+    site: str  # registered name or "all"
+    prob: Optional[float] = None  # None => deterministic (always / nth)
+    nth: Optional[int] = None  # 1-based exact call index
+
+
+@dataclass
+class _PlanState:
+    raw: str
+    rules: List[_FaultRule] = field(default_factory=list)
+
+
+_plan_cache: Optional[_PlanState] = None
+_counters: Dict[str, int] = {}
+_injected: List[dict] = []
+
+
+class FaultPlanError(ValueError):
+    """KAMINPAR_TPU_FAULTS could not be parsed (bad site or spec)."""
+
+
+def parse_plan(raw: str) -> List[_FaultRule]:
+    """Parse a fault-plan string; raises FaultPlanError on bad input."""
+    rules: List[_FaultRule] = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, spec = part.partition(":")
+        site = site.strip()
+        if site != "all" and site not in SITES:
+            raise FaultPlanError(
+                f"unknown fault site {site!r} (registered: "
+                f"{', '.join(SITES)}, or 'all')"
+            )
+        spec = spec.strip()
+        if not spec or spec == "always":
+            rules.append(_FaultRule(site))
+        elif spec.startswith("nth="):
+            try:
+                nth = int(spec[4:])
+            except ValueError:
+                raise FaultPlanError(f"bad nth spec {spec!r} for {site!r}")
+            if nth < 1:
+                raise FaultPlanError(f"nth must be >= 1 in {part!r}")
+            rules.append(_FaultRule(site, nth=nth))
+        else:
+            try:
+                prob = float(spec)
+            except ValueError:
+                raise FaultPlanError(
+                    f"bad fault spec {spec!r} for {site!r} "
+                    "(want nothing, 'always', 'nth=K', or a probability)"
+                )
+            if not 0.0 < prob <= 1.0:
+                raise FaultPlanError(f"probability out of (0, 1] in {part!r}")
+            rules.append(_FaultRule(site, prob=prob))
+    return rules
+
+
+def _active_plan() -> Optional[_PlanState]:
+    """The parsed plan for the CURRENT env value (re-parsed on change)."""
+    global _plan_cache
+    raw = os.environ.get(ENV_VAR, "")
+    if not raw:
+        _plan_cache = None
+        return None
+    if _plan_cache is None or _plan_cache.raw != raw:
+        _plan_cache = _PlanState(raw=raw, rules=parse_plan(raw))
+    return _plan_cache
+
+
+def _seeded_draw(site: str, count: int) -> float:
+    """Deterministic uniform [0, 1) draw keyed by (seed, site, count)."""
+    from ..utils import rng as rng_mod
+
+    seed = rng_mod.get_seed()
+    digest = hashlib.sha256(f"{seed}:{site}:{count}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / float(1 << 64)
+
+
+def maybe_inject(site: str, **attrs) -> None:
+    """Raise the site's structured exception if the active fault plan says
+    this call fails.  Called by with_fallback at every site entry (and by
+    a few deep injection points inside primaries).  No-op without a plan.
+    """
+    spec = SITES[site]  # KeyError = unregistered site, a programming error
+    plan = _active_plan()
+    if plan is None:
+        return
+    count = _counters.get(site, 0) + 1
+    _counters[site] = count
+    fire = False
+    for rule in plan.rules:
+        if rule.site != "all" and rule.site != site:
+            continue
+        if rule.nth is not None:
+            fire = count == rule.nth
+        elif rule.prob is not None:
+            fire = _seeded_draw(site, count) < rule.prob
+        else:
+            fire = True
+        if fire:
+            break
+    if not fire:
+        return
+    _injected.append({"site": site, "call": count})
+    raise spec.exc(
+        f"injected fault at site '{site}' (call #{count}, "
+        f"{ENV_VAR}={plan.raw})",
+        site=site,
+        injected=True,
+    )
+
+
+def site_spec(site: str) -> SiteSpec:
+    """The SiteSpec for a registered name; KeyError on unknown sites."""
+    return SITES[site]
+
+
+def invocation_count(site: str) -> int:
+    """How many times the site has been entered (injection bookkeeping
+    counts even with no plan active? no — counters only advance while a
+    plan is active, so this reads as 'injectable calls seen')."""
+    return _counters.get(site, 0)
+
+
+def injected_log() -> List[dict]:
+    """All faults fired so far ({site, call} dicts, in firing order)."""
+    return list(_injected)
+
+
+def reset() -> None:
+    """Clear counters and the fired-fault log (test isolation)."""
+    global _plan_cache
+    _counters.clear()
+    _injected.clear()
+    _plan_cache = None
+
+
+def plan_summary() -> dict:
+    """The run report's fault-plan echo: the raw plan (or None), the
+    registered site list, and every fault fired so far."""
+    raw = os.environ.get(ENV_VAR, "") or None
+    return {
+        "plan": raw,
+        "sites": list(SITES),
+        "injected": injected_log(),
+    }

@@ -58,6 +58,16 @@ def environment_stamp() -> dict:
     return env
 
 
+def _fault_section() -> dict:
+    """The fault-plan echo (CLI satellite): plan, sites, injected log."""
+    try:
+        from ..resilience import faults
+
+        return faults.plan_summary()
+    except Exception:
+        return {"plan": None, "sites": [], "injected": []}
+
+
 def _scope_tree(node) -> dict:
     return {
         child.name: {
@@ -80,6 +90,9 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
 
     info = _run_info()
     result = info.pop("result", {})
+    # the output gate's verdict (resilience/gate.py); absent when the
+    # gate was disabled or no partition ran in this stream
+    gate_verdict = info.pop("output_gate", {"checked": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -94,9 +107,43 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     try:
         from ..parallel import mesh
 
-        comm = {"caveat": mesh.COMM_CAVEAT, "records": mesh.comm_records()}
+        comm = {
+            "caveat": mesh.COMM_CAVEAT,
+            "records": mesh.comm_records(),
+            # opened-vs-traced lets report consumers spot cache-hit
+            # phases (opened but zero traced rows) explicitly
+            "phase_opens": mesh.phase_opens(),
+        }
     except Exception:  # mesh pulls in jax; stay robust without a backend
         comm = {"caveat": "comm accounting unavailable", "records": []}
+
+    # distributed finalize: per-scope min/avg/max across processes (the
+    # kaminpar-dist/timer.cc analog); on one process min == avg == max.
+    # This is itself a host-side collective — the `collective`
+    # degradation site covers it: a sick link degrades the report to
+    # local-only timers instead of hanging or dying.  Runs BEFORE the
+    # event lists below are snapshotted so its own `degraded` event (if
+    # any) lands in this report.
+    from ..resilience import CollectiveTimeout, with_fallback
+
+    def _aggregate():
+        try:
+            return timer.aggregate_across_processes()
+        except (TypeError, AttributeError, KeyError, IndexError,
+                AssertionError, NameError):
+            # programming-shaped errors are bugs, not degradations —
+            # they must stay loud (docs/static_analysis.md hazard note)
+            raise
+        except Exception as e:
+            # infra-shaped failures (backend/link/timeout) degrade
+            raise CollectiveTimeout(
+                f"timer aggregation failed: {type(e).__name__}: {e}"
+            ) from e
+
+    agg = with_fallback(
+        _aggregate, lambda exc: None, site="collective",
+        where="report-timers",
+    )
 
     report: Dict[str, Any] = {
         "schema_version": SCHEMA_VERSION,
@@ -109,14 +156,16 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         "events": [e.to_dict() for e in _events()],
         "counters": statistics.as_dict() if statistics.enabled() else {},
         "lane_gather": lane_gather.probe_status(),
+        # resilience sections: the active fault plan (and every injected
+        # fault), each degradation the policy wrapper recorded, and the
+        # output gate's verdict — the run report is the audit trail of
+        # what degraded and whether the postcondition still held
+        "faults": _fault_section(),
+        "degraded": [e.to_dict() for e in _events("degraded")],
+        "output_gate": gate_verdict,
     }
-
-    # distributed finalize: per-scope min/avg/max across processes (the
-    # kaminpar-dist/timer.cc analog); on one process min == avg == max
-    try:
-        report["timers_aggregated"] = timer.aggregate_across_processes()
-    except Exception:
-        pass
+    if agg is not None:
+        report["timers_aggregated"] = agg
 
     from ..utils import heap_profiler
 

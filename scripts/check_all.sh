@@ -1,28 +1,46 @@
 #!/usr/bin/env bash
-# The one-command commit gate: tpulint, run-report schema check, and
-# the ROADMAP.md tier-1 pytest command.  Exits nonzero on the first
+# The one-command commit gate: tpulint, run-report schema check, a
+# chaos smoke run (every fault site injected once; the run must still
+# produce a gate-valid partition and a schema-valid report), and the
+# ROADMAP.md tier-1 pytest command.  Exits nonzero on the first
 # failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
-#         --fast skips the tier-1 pytest stage (lint + schema only,
-#         the same pair the pre-commit hooks run).
+#         --fast skips the tier-1 pytest stage (lint + schema + chaos
+#         smoke; lint + schema are the pair the pre-commit hooks run).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/3] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/4] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/3] run-report schema (producer selftest) =="
+echo "== [2/4] run-report schema (producer selftest) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
+echo "== [3/4] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+rm -f /tmp/_kmp_chaos_report.json
+KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
+    "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
+    --report-json /tmp/_kmp_chaos_report.json || exit 1
+python scripts/check_report_schema.py /tmp/_kmp_chaos_report.json || exit 1
+python - <<'EOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_chaos_report.json"))
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], f"chaos run failed the gate: {gate}"
+assert r["faults"]["plan"] == "all:nth=1", r["faults"]
+print(f"chaos smoke OK: {len(r['degraded'])} degraded event(s), "
+      f"gate valid, cut={gate['cut_recomputed']}")
+EOF
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [3/3] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [4/4] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [3/3] tier-1 pytest (ROADMAP.md) =="
+echo "== [4/4] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

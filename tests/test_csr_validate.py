@@ -1,0 +1,126 @@
+"""csr.validate(): structural invariant checker (ISSUE 3 satellite).
+
+Covers host and device graphs, the dtype/padding policy, and the
+KAMINPAR_TPU_ASSERTS=1 gating used by the output gate.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs import csr
+from kaminpar_tpu.graphs.csr import CSRInvariantError, device_graph_from_host
+from kaminpar_tpu.graphs.factories import make_grid_graph
+from kaminpar_tpu.graphs.host import HostGraph
+
+
+def _host():
+    return make_grid_graph(4, 4)
+
+
+def test_valid_host_graph_passes():
+    csr.validate(_host())
+
+
+def test_valid_device_graph_passes():
+    csr.validate(device_graph_from_host(_host()))
+
+
+def test_valid_compressed_graph_passes():
+    from kaminpar_tpu.graphs.compressed import compress_host_graph
+
+    csr.validate(compress_host_graph(_host()))
+
+
+def test_ragged_offsets_rejected():
+    g = _host()
+    xadj = g.xadj.copy()
+    xadj[2] = xadj[3] + 5  # non-monotone
+    bad = HostGraph(xadj=xadj, adjncy=g.adjncy,
+                    node_weights=None, edge_weights=None)
+    with pytest.raises(CSRInvariantError, match="non-decreasing"):
+        csr.validate(bad)
+
+
+def test_offset_start_and_end_rejected():
+    g = _host()
+    xadj = g.xadj.copy()
+    xadj[0] = 1
+    bad = HostGraph(xadj=xadj, adjncy=g.adjncy,
+                    node_weights=None, edge_weights=None)
+    with pytest.raises(CSRInvariantError, match="start at 0"):
+        csr.validate(bad)
+    xadj = g.xadj.copy()
+    xadj[-1] -= 1
+    bad = HostGraph(xadj=xadj, adjncy=g.adjncy,
+                    node_weights=None, edge_weights=None)
+    with pytest.raises(CSRInvariantError):
+        csr.validate(bad)
+
+
+def test_out_of_range_neighbor_rejected():
+    g = _host()
+    adj = g.adjncy.copy()
+    adj[0] = g.n + 3
+    bad = HostGraph(xadj=g.xadj, adjncy=adj,
+                    node_weights=None, edge_weights=None)
+    with pytest.raises(CSRInvariantError, match="out of"):
+        csr.validate(bad)
+
+
+def test_asymmetry_rejected():
+    g = _host()
+    adj = g.adjncy.copy()
+    # retarget one directed edge; its reverse twin is now missing
+    adj[0] = (adj[0] + 2) % g.n
+    bad = HostGraph(xadj=g.xadj, adjncy=adj,
+                    node_weights=None, edge_weights=None)
+    with pytest.raises(CSRInvariantError, match="symmetry"):
+        csr.validate(bad)
+    csr.validate(bad, undirected=False)  # directed view is fine
+
+
+def test_dtype_policy_rejected():
+    # the HostGraph constructor coerces dtypes, so a policy violation
+    # only arises from post-construction mutation (or a foreign object)
+    bad = _host()
+    bad.adjncy = bad.adjncy.astype(np.int64)
+    with pytest.raises(CSRInvariantError, match="dtype"):
+        csr.validate(bad)
+
+
+def test_device_padding_violations_rejected():
+    import jax.numpy as jnp
+
+    dg = device_graph_from_host(_host())
+    # corrupt a pad edge: point it at a real node with nonzero weight
+    m = int(dg.m)
+    bad = dataclasses.replace(
+        dg, edge_w=dg.edge_w.at[dg.m_pad - 1].set(7)
+    )
+    with pytest.raises(CSRInvariantError, match="pad edges"):
+        csr.validate(bad)
+    bad = dataclasses.replace(
+        dg, node_w=dg.node_w.at[dg.n_pad - 1].set(1)
+    )
+    with pytest.raises(CSRInvariantError, match="pad nodes"):
+        csr.validate(bad)
+    bad = dataclasses.replace(dg, dst=dg.dst.at[m].set(0))
+    with pytest.raises(CSRInvariantError, match="parked"):
+        csr.validate(bad)
+    del jnp
+
+
+def test_maybe_validate_gated_by_env(monkeypatch):
+    g = _host()
+    adj = g.adjncy.copy()
+    adj[0] = g.n + 3
+    bad = HostGraph(xadj=g.xadj, adjncy=adj,
+                    node_weights=None, edge_weights=None)
+    monkeypatch.delenv(csr.ASSERTS_ENV, raising=False)
+    csr.maybe_validate(bad)  # gate closed: free, no exception
+    monkeypatch.setenv(csr.ASSERTS_ENV, "1")
+    assert csr.asserts_enabled()
+    with pytest.raises(CSRInvariantError, match="at upload"):
+        csr.maybe_validate(bad, where="upload")

@@ -1,0 +1,182 @@
+"""Malformed-input hardening for the graph parsers (ISSUE 3 satellite).
+
+Contract: a corrupted METIS/ParHiP file surfaces as GraphFormatError
+naming the line (text) or byte offset (binary) — never as an
+IndexError / OverflowError / struct error from deep inside numpy, and
+never as a silent half-parsed graph that fails later.
+"""
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu.graphs.factories import make_grid_graph
+from kaminpar_tpu.io import GraphFormatError, parse_metis, parse_parhip
+from kaminpar_tpu.io.metis import write_metis
+from kaminpar_tpu.io.parhip import write_parhip
+
+
+# ---------------------------------------------------------------------------
+# METIS: targeted corruption fixtures
+# ---------------------------------------------------------------------------
+
+GOOD_METIS = "4 4\n2 3\n1 3\n1 2 4\n3\n"
+
+
+def test_good_metis_parses():
+    g = parse_metis(GOOD_METIS)
+    assert g.n == 4 and g.m == 8
+
+
+@pytest.mark.parametrize(
+    "text,needle",
+    [
+        ("", "empty"),
+        ("4\n", "header"),
+        ("x 4\n2 3\n1 3\n1 2 4\n3\n", "non-integer header"),
+        ("-4 4\n", "negative"),
+        ("4 999999\n2 3\n1 3\n1 2 4\n3\n", "file is only"),
+        ("4 4\n2 3\n1 3\n", "truncated"),  # node lines missing
+        ("4 4\n2 3\n1 x\n1 2 4\n3\n", "non-integer token"),
+        ("4 4\n2 3\n1 99999999999999999999999\n1 2 4\n3\n", "overflow"),
+        ("4 4\n2 3\n1 3\n1 2 9\n3\n", "out of range"),  # neighbor 9 > n
+        ("4 4\n2 3\n1 3\n1 2 0\n3\n", "out of range"),  # ids are 1-based
+        ("4 5\n2 3\n1 3\n1 2 4\n3\n", "header claims"),  # m mismatch
+        ("4 4 011\n2 3\n1 3\n1 2 4\n3\n", "malformed adjacency"),
+        # fmt=11 makes token counts odd
+        ("4 4 10\n-1 2 3\n1 1 3\n1 1 2 4\n1 3\n", "negative node weight"),
+    ],
+)
+def test_metis_corruptions_raise_structured(text, needle):
+    with pytest.raises(GraphFormatError) as ei:
+        parse_metis(text)
+    assert needle in str(ei.value)
+
+
+def test_metis_error_names_the_line():
+    with pytest.raises(GraphFormatError) as ei:
+        parse_metis("% comment\n4 4\n2 3\n1 3\n1 2 bad\n3\n")
+    assert ei.value.line == 5  # original file line, comments included
+
+
+def test_load_metis_attaches_path(tmp_path):
+    from kaminpar_tpu.io import load_metis
+
+    p = tmp_path / "broken.metis"
+    p.write_text("4 4\n2 3\n1 x\n1 2 4\n3\n")
+    with pytest.raises(GraphFormatError) as ei:
+        load_metis(str(p))
+    assert ei.value.path == str(p)
+    assert "broken.metis" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# ParHiP: targeted corruption fixtures
+# ---------------------------------------------------------------------------
+
+
+def _good_parhip_bytes(tmp_path) -> bytes:
+    g = make_grid_graph(6, 6)
+    path = tmp_path / "g.parhip"
+    write_parhip(g, str(path))
+    return path.read_bytes()
+
+
+def test_good_parhip_roundtrip(tmp_path):
+    data = _good_parhip_bytes(tmp_path)
+    g = parse_parhip(data)
+    assert g.n == 36
+
+
+def test_parhip_truncated_header():
+    with pytest.raises(GraphFormatError) as ei:
+        parse_parhip(b"\x00" * 10)
+    assert "header" in str(ei.value) and ei.value.offset == 10
+
+
+def test_parhip_truncated_body(tmp_path):
+    data = _good_parhip_bytes(tmp_path)
+    for cut in (30, len(data) // 2, len(data) - 4):
+        with pytest.raises(GraphFormatError) as ei:
+            parse_parhip(data[:cut])
+        assert "truncated" in str(ei.value)
+        assert ei.value.offset == cut
+
+
+def test_parhip_non_monotone_offsets(tmp_path):
+    data = bytearray(_good_parhip_bytes(tmp_path))
+    # offsets are uint32 starting at byte 24: swap two to break order
+    off = np.frombuffer(bytes(data[24 : 24 + 4 * 37]), dtype=np.uint32)
+    off = off.copy()
+    off[3], off[4] = off[10], off[2]
+    data[24 : 24 + 4 * 37] = off.tobytes()
+    with pytest.raises(GraphFormatError) as ei:
+        parse_parhip(bytes(data))
+    assert "non-monotone" in str(ei.value) or "aligned" in str(ei.value)
+
+
+def test_parhip_out_of_range_adjncy(tmp_path):
+    data = bytearray(_good_parhip_bytes(tmp_path))
+    adj_start = 24 + 4 * 37  # header + (n+1) uint32 offsets
+    data[adj_start : adj_start + 4] = np.uint32(999).tobytes()
+    with pytest.raises(GraphFormatError) as ei:
+        parse_parhip(bytes(data))
+    assert "out of range" in str(ei.value)
+    assert ei.value.offset == adj_start
+
+
+# ---------------------------------------------------------------------------
+# fuzz: seeded random corruption must never escape GraphFormatError
+# ---------------------------------------------------------------------------
+
+
+def _assert_structured_or_ok(parse, blob):
+    try:
+        parse(blob)
+    except GraphFormatError:
+        pass  # structured: exactly the contract
+    # any other exception type propagates and fails the test
+
+
+def test_metis_fuzz_corruption(tmp_path):
+    g = make_grid_graph(8, 8)
+    path = tmp_path / "f.metis"
+    write_metis(g, str(path))
+    base = path.read_text()
+    rng = np.random.default_rng(1234)
+    junk = "x-%57 \n"
+    for _ in range(150):
+        chars = list(base)
+        for _ in range(int(rng.integers(1, 6))):
+            pos = int(rng.integers(0, len(chars)))
+            chars[pos] = junk[int(rng.integers(0, len(junk)))]
+        _assert_structured_or_ok(parse_metis, "".join(chars))
+
+
+def test_metis_fuzz_truncation(tmp_path):
+    g = make_grid_graph(8, 8)
+    path = tmp_path / "f.metis"
+    write_metis(g, str(path))
+    base = path.read_text()
+    rng = np.random.default_rng(99)
+    for _ in range(40):
+        cut = int(rng.integers(0, len(base)))
+        _assert_structured_or_ok(parse_metis, base[:cut])
+
+
+def test_parhip_fuzz_corruption(tmp_path):
+    base = _good_parhip_bytes(tmp_path)
+    rng = np.random.default_rng(4321)
+    for _ in range(150):
+        blob = bytearray(base)
+        for _ in range(int(rng.integers(1, 6))):
+            pos = int(rng.integers(0, len(blob)))
+            blob[pos] = int(rng.integers(0, 256))
+        _assert_structured_or_ok(parse_parhip, bytes(blob))
+
+
+def test_parhip_fuzz_truncation(tmp_path):
+    base = _good_parhip_bytes(tmp_path)
+    rng = np.random.default_rng(77)
+    for _ in range(40):
+        cut = int(rng.integers(0, len(base)))
+        _assert_structured_or_ok(parse_parhip, base[:cut])

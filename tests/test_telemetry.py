@@ -365,6 +365,35 @@ def test_comm_accounting_shape_keyed_with_caveat():
         mesh.reset_comm_log()
 
 
+def test_comm_table_marks_cache_hit_phases():
+    """A phase opened with ZERO traced collectives is an executable-cache
+    hit — comm_table must say so explicitly instead of leaving it
+    indistinguishable from a silent phase (ADVICE round 5 low #4)."""
+    from kaminpar_tpu.parallel import mesh
+
+    mesh.reset_comm_log()
+    try:
+        with mesh.comm_phase("warm"):
+            mesh.account_collective("psum(x)", 128, shape=(4, 8))
+        # second opening: program cached, nothing traces
+        with mesh.comm_phase("warm"):
+            pass
+        with mesh.comm_phase("cold-cache-hit"):
+            pass  # opened, traced nothing at all
+        assert mesh.phase_opens() == {"warm": 2, "cold-cache-hit": 1}
+        assert mesh.cache_hit_phases() == ["cold-cache-hit"]
+        table = mesh.comm_table()
+        assert "cold-cache-hit" in table and "cache-hit" in table
+        # the traced row notes its extra (cached) openings
+        assert "opened 2x" in table
+        from kaminpar_tpu.telemetry.report import build_run_report
+
+        report = build_run_report()
+        assert report["comm"]["phase_opens"]["warm"] == 2
+    finally:
+        mesh.reset_comm_log()
+
+
 def test_dist_run_populates_comm_records():
     from kaminpar_tpu.parallel import dKaMinPar, make_mesh, mesh
 
