@@ -197,7 +197,7 @@ def _measure_utilization():
     return out
 
 
-def main() -> None:
+def _bench_line() -> dict:
     import numpy as np
 
     _init_platform()
@@ -364,7 +364,30 @@ def main() -> None:
             k: v for k, v in best_report.items()
             if k not in ("timers_aggregated", "heap")
         }
+    return line
+
+
+def main() -> None:
+    """Print the BENCH JSON line as the SOLE stdout line.
+
+    Harness parsing used to depend on "the last stdout line survives XLA
+    AOT loader warnings"; now every byte the measurement emits — python
+    prints AND C-level noise (XLA loaders, absl banners) — is routed to
+    stderr at the file-descriptor level, and only the final JSON line is
+    written to the real stdout."""
+    import sys
+
+    sys.stdout.flush()
+    real_stdout = os.dup(1)
+    os.dup2(2, 1)  # fd-level: C/C++ writes to fd 1 land on stderr too
+    try:
+        line = _bench_line()
+    finally:
+        sys.stdout.flush()
+        os.dup2(real_stdout, 1)
+        os.close(real_stdout)
     print(json.dumps(line))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -268,6 +268,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     from . import telemetry
     from .utils import heap_profiler, statistics
 
+    if args.diff_base and not args.report_json:
+        # fail BEFORE the (possibly long) run, like the fault-plan echo:
+        # the user asked for a regression gate that could never fire
+        print("error: --diff-base requires --report-json", file=sys.stderr)
+        return 2
+
     if args.heap_profile:
         heap_profiler.enable()
     if args.statistics:
@@ -361,7 +367,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.statistics and not args.quiet:
         print(statistics.render())
 
-    telemetry.export_cli_outputs(
+    # non-zero when --diff-base found a regression against the baseline
+    # report (telemetry/diff.py); output files are still written below
+    rc = telemetry.export_cli_outputs(
         args,
         extra_run={"io_seconds": round(io_s, 3),
                    "partition_seconds": round(wall, 3)},
@@ -384,7 +392,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         io_mod.write_block_sizes(
             args.output_block_sizes, partition, ctx.partition.k
         )
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -119,6 +119,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     from . import telemetry
     from .parallel import dKaMinPar, make_mesh
 
+    if args.diff_base and not args.report_json:
+        # fail BEFORE the run (cli.py twin): a regression gate that can
+        # never fire must not cost a full partition first
+        print("error: --diff-base requires --report-json", file=sys.stderr)
+        return 2
     telemetry.enable_if_requested(args)
     # fault-plan echo + startup validation (cli.py twin): chaos runs
     # must be unmistakable, and a typo'd plan must fail before the run
@@ -169,7 +174,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             print(comm_table())
 
-    telemetry.export_cli_outputs(
+    # non-zero when --diff-base found a regression against the baseline
+    # report (telemetry/diff.py); output files are still written below
+    rc = telemetry.export_cli_outputs(
         args,
         extra_run={"io_seconds": round(io_s, 3),
                    "partition_seconds": round(wall, 3)},
@@ -178,7 +185,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.output:
         io_mod.write_partition(args.output, partition)
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 
 from ..graphs.csr import DeviceGraph
+from ..telemetry import progress as progress_mod
 from .segments import (
     ACC_DTYPE,
     INT32_MIN,
@@ -160,6 +161,68 @@ def overload_balance_round(
 
 
 @partial(jax.jit, static_argnames=("k", "max_rounds"))
+def _overload_balance_impl(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    seed: jax.Array,
+    max_rounds: int = 8,
+    stats=None,
+):
+    """Balancing rounds until feasible or stalled (OverloadBalancer::
+    balance analog).  `stats` is an optional progress buffer (see
+    telemetry/progress.py); None keeps the jaxpr identical to the
+    uninstrumented loop.  The record variant carries the violation mass
+    so the series costs no extra reduction: the body computes it once
+    per round and the loop condition reuses the carried scalar."""
+
+    def _violation(part):
+        bw = _block_weights(graph, part, k)
+        return jnp.sum(
+            jnp.maximum(bw - max_block_weights.astype(ACC_DTYPE), 0)
+        )
+
+    def _round(i, part):
+        salt = (seed.astype(jnp.int32) * 48271 + i * 1566083941) & 0x7FFFFFFF
+        return overload_balance_round(
+            graph, part, k, max_block_weights, salt
+        )
+
+    part0 = jnp.clip(partition, 0, k - 1)
+    if stats is None:
+        def cond(state):
+            i, part, moved = state
+            return (i < max_rounds) & (_violation(part) > 0) & (moved != 0)
+
+        def body(state):
+            i, part, _ = state
+            part, moved = _round(i, part)
+            return (i + 1, part, moved)
+
+        _, part, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), part0, jnp.int32(1))
+        )
+        return part
+
+    def cond(state):
+        i, part, moved, stats, over = state
+        return (i < max_rounds) & (over > 0) & (moved != 0)
+
+    def body(state):
+        i, part, _, stats, _ = state
+        part, moved = _round(i, part)
+        over = _violation(part)
+        stats = progress_mod.record(stats, i, moved, over)
+        return (i + 1, part, moved, stats, over)
+
+    _, part, _, stats, _ = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), part0, jnp.int32(1), stats, _violation(part0)),
+    )
+    return part, stats
+
+
 def overload_balance(
     graph: DeviceGraph,
     partition: jax.Array,
@@ -168,31 +231,19 @@ def overload_balance(
     seed: jax.Array,
     max_rounds: int = 8,
 ) -> jax.Array:
-    """Run balancing rounds until feasible or stalled (OverloadBalancer::
-    balance analog)."""
-
-    def cond(state):
-        i, part, moved = state
-        bw = _block_weights(graph, part, k)
-        over = jnp.sum(jnp.maximum(bw - max_block_weights.astype(ACC_DTYPE), 0))
-        return (i < max_rounds) & (over > 0) & (moved != 0)
-
-    def body(state):
-        i, part, _ = state
-        salt = (seed.astype(jnp.int32) * 48271 + i * 1566083941) & 0x7FFFFFFF
-        part, moved = overload_balance_round(
-            graph, part, k, max_block_weights, salt
-        )
-        return (i + 1, part, moved)
-
-    _, part, _ = lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.clip(partition, 0, k - 1), jnp.int32(1))
+    """Public entry: runs the fused loop, emitting a per-round progress
+    series (moved nodes, residual violation mass) when telemetry is on."""
+    return progress_mod.instrumented(
+        lambda stats: _overload_balance_impl(
+            graph, partition, k, max_block_weights, seed, max_rounds, stats
+        ),
+        "balancer", ("moved", "violation"), rows=max_rounds,
+        direction="overload",
     )
-    return part
 
 
 @partial(jax.jit, static_argnames=("k", "max_rounds"))
-def underload_balance(
+def _underload_balance_impl(
     graph: DeviceGraph,
     partition: jax.Array,
     k: int,
@@ -200,13 +251,20 @@ def underload_balance(
     min_block_weights: jax.Array,
     seed: jax.Array,
     max_rounds: int = 8,
-) -> jax.Array:
+    stats=None,
+):
     """UnderloadBalancer analog: pull weight into blocks below their min
     weight, taking the cheapest movers from blocks with surplus
-    (weight > min)."""
+    (weight > min).  `stats`: optional progress buffer; the record
+    variant carries the deficit mass like _overload_balance_impl."""
 
-    def body(state):
-        i, part, _ = state
+    def _deficit_mass(part):
+        bw = _block_weights(graph, part, k)
+        return jnp.sum(
+            jnp.maximum(min_block_weights.astype(ACC_DTYPE) - bw, 0)
+        )
+
+    def _round(i, part):
         salt = (seed.astype(jnp.int32) * 16807 + i * 1566083941) & 0x7FFFFFFF
         n_pad = graph.n_pad
         node_ids = jnp.arange(n_pad, dtype=jnp.int32)
@@ -266,20 +324,64 @@ def underload_balance(
         accept = accept_out & accept_in
         new_part = jnp.where(accept, target, part)
         # moved-node count <= n, ID domain  # tpulint: disable=R3
-        return (i + 1, new_part, jnp.sum(accept, dtype=jnp.int32))
+        return new_part, jnp.sum(accept, dtype=jnp.int32)
+
+    part0 = jnp.clip(partition, 0, k - 1)
+    if stats is None:
+        def cond(state):
+            i, part, moved = state
+            return (
+                (i < max_rounds) & (_deficit_mass(part) > 0) & (moved != 0)
+            )
+
+        def body(state):
+            i, part, _ = state
+            part, moved = _round(i, part)
+            return (i + 1, part, moved)
+
+        _, part, _ = lax.while_loop(
+            cond, body, (jnp.int32(0), part0, jnp.int32(1))
+        )
+        return part
 
     def cond(state):
-        i, part, moved = state
-        bw = _block_weights(graph, part, k)
-        deficit = jnp.sum(
-            jnp.maximum(min_block_weights.astype(ACC_DTYPE) - bw, 0)
-        )
+        i, part, moved, stats, deficit = state
         return (i < max_rounds) & (deficit > 0) & (moved != 0)
 
-    _, part, _ = lax.while_loop(
-        cond, body, (jnp.int32(0), jnp.clip(partition, 0, k - 1), jnp.int32(1))
+    def body(state):
+        i, part, _, stats, _ = state
+        part, moved = _round(i, part)
+        deficit = _deficit_mass(part)
+        stats = progress_mod.record(stats, i, moved, deficit)
+        return (i + 1, part, moved, stats, deficit)
+
+    _, part, _, stats, _ = lax.while_loop(
+        cond, body,
+        (jnp.int32(0), part0, jnp.int32(1), stats, _deficit_mass(part0)),
     )
-    return part
+    return part, stats
+
+
+def underload_balance(
+    graph: DeviceGraph,
+    partition: jax.Array,
+    k: int,
+    max_block_weights: jax.Array,
+    min_block_weights: jax.Array,
+    seed: jax.Array,
+    max_rounds: int = 8,
+) -> jax.Array:
+    """Public entry (see overload_balance): per-round moved nodes and
+    residual deficit mass land on the progress stream when telemetry is
+    enabled."""
+    return progress_mod.instrumented(
+        lambda stats: _underload_balance_impl(
+            graph, partition, k, max_block_weights, min_block_weights,
+            seed, max_rounds, stats,
+        ),
+        "balancer", ("moved", "violation"), rows=max_rounds,
+        direction="underload",
+    )
 
 
 def host_balance(

@@ -34,6 +34,7 @@ from jax import lax
 
 from ..context import JetRefinementContext
 from ..graphs.csr import DeviceGraph
+from ..telemetry import progress as progress_mod
 from .balancer import overload_balance_round
 from .metrics import edge_cut
 from .segments import (
@@ -344,6 +345,7 @@ def _jet_chunk(
     max_fruitless: int,
     balancer_rounds: int,
     plans=None,
+    stats=None,
 ):
     """A bounded chunk of Jet iterations in one device program.
 
@@ -351,7 +353,12 @@ def _jet_chunk(
     while_loop; at ~33M-edge shapes the multi-minute single launch
     reproducibly killed the TPU worker.  The host now drives the
     iteration loop in chunks, reading back the fruitless counter between
-    chunks (one scalar sync per `chunk` iterations)."""
+    chunks (one scalar sync per `chunk` iterations).
+
+    `stats` is an optional progress buffer (telemetry/progress.py),
+    row-indexed by the GLOBAL iteration `i0 + j` so it threads across
+    chunks unchanged; None leaves the jaxpr identical to the
+    uninstrumented loop."""
 
     def is_feasible(p):
         bw = jax.ops.segment_sum(
@@ -360,13 +367,13 @@ def _jet_chunk(
         return jnp.all(bw <= max_block_weights.astype(ACC_DTYPE))
 
     def iter_cond(state):
-        j, fruitless, part, lock, best, best_cut, conn = state
+        j, fruitless, part, lock, best, best_cut, conn, stats = state
         # `limit` is traced, so a short remainder chunk reuses the same
         # compiled program instead of triggering a second trace
         return (j < limit) & (fruitless < max_fruitless)
 
     def iter_body(state):
-        j, fruitless, part, lock, best, best_cut, conn = state
+        j, fruitless, part, lock, best, best_cut, conn, stats = state
         i = i0 + j
         salt = (
             seed.astype(jnp.int32) * 31321 + rnd * 2221 + i * 1566083941
@@ -404,14 +411,23 @@ def _jet_chunk(
         is_best = (cut <= best_cut) & is_feasible(part)
         best = jnp.where(is_best, part, best)
         best_cut = jnp.where(is_best, cut, best_cut)
-        return (j + 1, fruitless, new_part, lock, best, best_cut, conn)
+        if stats is not None:  # trace-time guard (None adds no carry)
+            # cut of the state entering iteration i; moved = locked
+            # (accepted) movers of this iteration; fruitless after the
+            # improvement test — the convergence picture Jet's paper
+            # plots (and the reference's statistics registry prints)
+            stats = progress_mod.record(
+                stats, i, cut, jnp.sum(lock), fruitless
+            )
+        return (j + 1, fruitless, new_part, lock, best, best_cut, conn,
+                stats)
 
-    _, fruitless, part, lock, best, best_cut, conn = lax.while_loop(
+    _, fruitless, part, lock, best, best_cut, conn, stats = lax.while_loop(
         iter_cond,
         iter_body,
-        (jnp.int32(0), fruitless, part, lock, best, best_cut, conn),
+        (jnp.int32(0), fruitless, part, lock, best, best_cut, conn, stats),
     )
-    return part, lock, best, best_cut, fruitless, conn
+    return part, lock, best, best_cut, fruitless, conn, stats
 
 
 @partial(jax.jit, static_argnames=("k",))
@@ -507,6 +523,7 @@ def _jet_refine_impl(
         chunk = 1
     elif m_pad > MAX_FUSED_EDGE_SLOTS // 2:
         chunk = min(chunk, 2)
+    rec = progress_mod.capture()
     for rnd in range(num_rounds):
         if num_rounds > 1:
             gain_temp = initial_gain_temp + (
@@ -521,16 +538,20 @@ def _jet_refine_impl(
             # table is maintained incrementally and stays valid across
             # rounds whenever the round ended on its best partition
             conn = _jet_build_conn(graph, part, k, plans)
+        # per-round progress buffer, row-indexed by the global iteration
+        # so it rides across host-driven chunks without a host pull
+        stats = progress_mod.new_buffer(max_iterations, 3) if rec else None
+        t0 = progress_mod.now()
         i = 0
         closed = False
         while i < max_iterations:
-            part, lock, best, best_cut, fruitless, conn = _jet_chunk(
+            part, lock, best, best_cut, fruitless, conn, stats = _jet_chunk(
                 graph, part, lock, best, best_cut, fruitless, conn,
                 jnp.int32(i), k, max_block_weights,
                 jnp.float32(gain_temp), jnp.float32(fruitless_threshold),
                 seed, jnp.int32(rnd),
                 jnp.int32(min(chunk, max_iterations - i)), wdeg,
-                max_fruitless, balancer_rounds, plans,
+                max_fruitless, balancer_rounds, plans, stats,
             )
             i += chunk
             # the readback is a blocking device sync; skip it when the
@@ -557,6 +578,13 @@ def _jet_refine_impl(
             best, best_cut = _jet_round_close(
                 graph, part, best, best_cut, k, max_block_weights,
                 conn=conn, wdeg=wdeg,
+            )
+        if rec:
+            # ONE host pull per round, after the loop exited (the chunk
+            # driver's fruitless readback already synced the stream)
+            progress_mod.emit(
+                "jet", ("cut", "moved", "fruitless"), stats, t0,
+                round=rnd, best_cut=int(best_cut),
             )
         # rollback to best (jet_refiner.cc:221-227): the round continues
         # from the best partition seen

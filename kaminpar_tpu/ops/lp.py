@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..graphs.csr import DeviceGraph
+from ..telemetry import progress as progress_mod
 from .segments import (
     ACC_DTYPE,
     INT32_MIN,
@@ -480,16 +481,18 @@ def _lp_cluster_impl(
     num_iterations: int | None,
     has_communities: bool,
     plans=None,
-) -> jax.Array:
+    stats=None,
+):
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     comm = communities if has_communities else None
-    labels, weights = _lp_cluster_fused_rounds(
-        graph, max_cluster_weight, seed, comm, cfg, iters, plans
+    labels, weights, stats = _lp_cluster_fused_rounds(
+        graph, max_cluster_weight, seed, comm, cfg, iters, plans, stats
     )
-    return _lp_cluster_postpasses_traced(
+    labels = _lp_cluster_postpasses_traced(
         graph, labels, weights, max_cluster_weight, seed, cfg,
         has_communities,
     )
+    return labels if stats is None else (labels, stats)
 
 
 def _lp_cluster_postpasses_traced(
@@ -539,6 +542,12 @@ def _lp_cluster_chunked(
     labels = jnp.arange(n_pad, dtype=jnp.int32)
     weights = graph.node_w.astype(ACC_DTYPE)
     active = jnp.ones(n_pad, dtype=bool)
+    # progress capture, host-side: the chunked driver already reads the
+    # convergence scalar back every round, so the series costs one more
+    # scalar readback per round (telemetry-enabled runs only)
+    rec = progress_mod.capture()
+    t0 = progress_mod.now()
+    moved_series, active_series = [], []
     for i in range(iters):
         off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
         salt = (jnp.asarray(seed, jnp.int32) * 131071 + off) & 0x7FFFFFFF
@@ -546,8 +555,16 @@ def _lp_cluster_chunked(
             graph, labels, weights, max_cluster_weight, active,
             salt, jnp.int32(i), cfg, comm, plans,
         )
+        if rec:
+            moved_series.append(int(moved))
+            active_series.append(int(jnp.sum(active)))
         if int(moved) == 0:
             break
+    if rec:
+        progress_mod.emit_host(
+            "lp", {"moved": moved_series, "active": active_series},
+            t0=t0, phase="cluster", launch="chunked",
+        )
     return _lp_cluster_postpasses(
         graph, labels, weights, max_cluster_weight, seed, cfg,
         has_communities,
@@ -580,30 +597,39 @@ def _lp_cluster_round_launch(
 
 def _lp_cluster_fused_rounds(
     graph, max_cluster_weight, seed, comm, cfg: LPConfig, iters: int,
-    plans=None,
+    plans=None, stats=None,
 ):
-    """The fused multi-round clustering loop (one launch)."""
+    """The fused multi-round clustering loop (one launch).
+
+    `stats` is an optional progress buffer (telemetry/progress.py)
+    threaded through the carry; None (the default) leaves the jaxpr
+    bitwise-identical to the uninstrumented loop — the zero-overhead-
+    when-disabled contract tests/test_telemetry.py pins."""
     n_pad = graph.n_pad
     labels0 = jnp.arange(n_pad, dtype=jnp.int32)
     weights0 = graph.node_w.astype(ACC_DTYPE)
     active0 = jnp.ones(n_pad, dtype=bool)
 
     def cond(state):
-        i, _, _, _, moved = state
+        i, _, _, _, moved, _ = state
         return (i < iters) & (moved != 0)
 
     def body(state):
-        i, labels, weights, active, _ = state
+        i, labels, weights, active, _, stats = state
         salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
         labels, weights, active, moved = _round_with_delta(
             graph, labels, weights, max_cluster_weight, active, salt,
             cfg, comm, i, plans=plans,
         )
-        return (i + 1, labels, weights, active, moved)
+        if stats is not None:  # trace-time guard (None adds no carry)
+            stats = progress_mod.record(
+                stats, i, moved, jnp.sum(active)
+            )
+        return (i + 1, labels, weights, active, moved, stats)
 
-    init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1))
-    _, labels, weights, _, _ = lax.while_loop(cond, body, init)
-    return labels, weights
+    init = (jnp.int32(0), labels0, weights0, active0, jnp.int32(1), stats)
+    _, labels, weights, _, _, stats = lax.while_loop(cond, body, init)
+    return labels, weights, stats
 
 
 def lp_cluster(
@@ -646,15 +672,19 @@ def lp_cluster(
         )
     if communities is None:
         communities = jnp.zeros(graph.n_pad, dtype=jnp.int32)
-    return _lp_cluster_impl(
-        graph,
-        max_cluster_weight,
-        seed,
-        communities,
-        cfg,
-        num_iterations,
-        has_comm,
-        plans,
+    return progress_mod.instrumented(
+        lambda stats: _lp_cluster_impl(
+            graph,
+            max_cluster_weight,
+            seed,
+            communities,
+            cfg,
+            num_iterations,
+            has_comm,
+            plans,
+            stats,
+        ),
+        "lp", ("moved", "active"), rows=iters, phase="cluster",
     )
 
 
@@ -693,11 +723,14 @@ def lp_refine(
         cfg = replace(cfg, allow_tie_moves=False, refinement=True)
     plans = maybe_edge_plans(graph)  # eager: host readbacks (see lp_cluster)
     if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
+        rec = progress_mod.capture()
+        t0 = progress_mod.now()
         part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
         bw = jax.ops.segment_sum(
             graph.node_w.astype(ACC_DTYPE), part, num_segments=k
         )
         active = jnp.ones(graph.n_pad, dtype=bool)
+        moved_series, active_series = [], []
         for i in range(iters):
             # equivalent to the fused while_loop's traced int32-wraparound
             # `i * 1566083941`: the final & 0x7FFFFFFF drops bit 31, and
@@ -709,11 +742,23 @@ def lp_refine(
                 graph, part, bw, max_block_weights, active, salt,
                 jnp.int32(i), cfg, plans
             )
+            if rec:
+                moved_series.append(int(moved))
+                active_series.append(int(jnp.sum(active)))
             if int(moved) == 0:
                 break
+        if rec:
+            progress_mod.emit_host(
+                "lp", {"moved": moved_series, "active": active_series},
+                t0=t0, phase="refine", launch="chunked",
+            )
         return part
-    return _lp_refine_fused(
-        graph, partition, k, max_block_weights, seed, cfg, iters, plans
+    return progress_mod.instrumented(
+        lambda stats: _lp_refine_fused(
+            graph, partition, k, max_block_weights, seed, cfg, iters,
+            plans, stats,
+        ),
+        "lp", ("moved", "active"), rows=iters, phase="refine",
     )
 
 
@@ -727,11 +772,14 @@ def _lp_refine_fused(
     cfg: LPConfig = LPConfig(refinement=True),
     num_iterations: int | None = None,
     plans=None,
-) -> jax.Array:
+    stats=None,
+):
     """LP refinement (analog of LabelPropagationRefiner,
     kaminpar-shm/refinement/lp/lp_refiner.cc): the LP kernel with clusters
     fixed to the k blocks, moves restricted to strictly positive gain under
-    the per-block max weights.  Returns the refined partition."""
+    the per-block max weights.  Returns the refined partition (plus the
+    progress buffer when one was threaded in — see
+    _lp_cluster_fused_rounds on the stats/None contract)."""
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     if not cfg.refinement:
         cfg = replace(cfg, allow_tie_moves=False, refinement=True)
@@ -742,21 +790,25 @@ def _lp_refine_fused(
     )
     active0 = jnp.ones(n_pad, dtype=bool)
     def cond(state):
-        i, _, _, _, moved = state
+        i, _, _, _, moved, _ = state
         return (i < iters) & (moved != 0)
 
     def body(state):
-        i, part, bw, active, _ = state
+        i, part, bw, active, _, stats = state
         salt = (seed.astype(jnp.int32) * 92821 + i * 1566083941) & 0x7FFFFFFF
         part, bw, active, moved = _round_with_delta(
             graph, part, bw, max_block_weights, active, salt, cfg, None, i,
             plans=plans,
         )
-        return (i + 1, part, bw, active, moved)
+        if stats is not None:  # trace-time guard (None adds no carry)
+            stats = progress_mod.record(
+                stats, i, moved, jnp.sum(active)
+            )
+        return (i + 1, part, bw, active, moved, stats)
 
-    init = (jnp.int32(0), part0, bw0, active0, jnp.int32(1))
-    _, part, _, _, _ = lax.while_loop(cond, body, init)
-    return part
+    init = (jnp.int32(0), part0, bw0, active0, jnp.int32(1), stats)
+    _, part, _, _, _, stats = lax.while_loop(cond, body, init)
+    return part if stats is None else (part, stats)
 
 
 def cluster_isolated_nodes(

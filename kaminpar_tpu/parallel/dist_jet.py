@@ -39,6 +39,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..context import JetRefinementContext
+from ..telemetry import progress as progress_mod
 from ..ops.segments import (
     ACC_DTYPE,
     INT32_MIN,
@@ -132,13 +133,14 @@ def _jet_iteration_dist(
     jax.jit,
     static_argnames=(
         "mesh", "k", "num_rounds", "max_iterations", "max_fruitless",
-        "balancer_rounds",
+        "balancer_rounds", "record",
     ),
 )
 def _dist_jet_impl(
     mesh, graph, partition, k, cap, seed,
     initial_gain_temp, final_gain_temp, fruitless_threshold,
     num_rounds, max_iterations, max_fruitless, balancer_rounds,
+    record=False,
 ):
     def per_device(src_l, dst_l, dstloc_l, ew_l, nw_l, n, ghost_gid_l,
                    send_idx_l, recv_map_l, part0, cap, seed):
@@ -170,7 +172,7 @@ def _dist_jet_impl(
         )
 
         def round_body(rnd, carry):
-            part_l, ghost, best_l, best_cut = carry
+            part_l, ghost, best_l, best_cut, round_stats = carry
             gain_temp = jnp.where(
                 num_rounds > 1,
                 initial_gain_temp
@@ -185,7 +187,8 @@ def _dist_jet_impl(
                 return (i < max_iterations) & (fruitless < max_fruitless)
 
             def iter_body(state):
-                i, fruitless, part_l, ghost, lock_l, best_l, best_cut = state
+                (i, fruitless, part_l, ghost, lock_l, best_l, best_cut,
+                 stats) = state
                 salt = (
                     seed.astype(jnp.int32) * 31321
                     + rnd * 2221
@@ -235,32 +238,49 @@ def _dist_jet_impl(
                 is_best = (cut <= best_cut) & is_feasible(part_l)
                 best_l = jnp.where(is_best, part_l, best_l)
                 best_cut = jnp.where(is_best, cut, best_cut)
+                if stats is not None:  # trace-time guard (no extra carry)
+                    # cut and fruitless are already psum'd/replicated, so
+                    # the series adds NO collectives; rows are indexed by
+                    # the global iteration across rounds
+                    stats = progress_mod.record(
+                        stats, rnd * max_iterations + i, cut, fruitless
+                    )
                 return (
-                    i + 1, fruitless, part_l, ghost, lock_l, best_l, best_cut
+                    i + 1, fruitless, part_l, ghost, lock_l, best_l,
+                    best_cut, stats
                 )
 
             lock0 = jnp.zeros(n_loc, dtype=jnp.int32)
-            (_, _, part_l, ghost, _, best_l, best_cut) = lax.while_loop(
+            (_, _, part_l, ghost, _, best_l, best_cut,
+             round_stats) = lax.while_loop(
                 iter_cond,
                 iter_body,
                 (
                     jnp.int32(0), jnp.int32(0), part_l, ghost, lock0,
-                    best_l, best_cut,
+                    best_l, best_cut, round_stats,
                 ),
             )
             # rollback to best; re-sync ghosts from it
             ghost_best = halo_exchange(best_l, send_idx_l, recv_map_l,
                                        ghost.shape[0])
-            return (best_l, ghost_best, best_l, best_cut)
+            return (best_l, ghost_best, best_l, best_cut, round_stats)
 
-        _, _, best_l, _ = lax.fori_loop(
-            0, num_rounds, round_body, (part_l0, ghost0, part_l0, best_cut0)
+        stats0 = (
+            progress_mod.new_buffer(num_rounds * max_iterations, 2)
+            if record else None
+        )
+        _, _, best_l, _, stats = lax.fori_loop(
+            0, num_rounds, round_body,
+            (part_l0, ghost0, part_l0, best_cut0, stats0),
         )
         # ONE O(n) gather at loop exit
         account_collective(
             "all_gather(partition)", best_l.size * 4, shape=best_l.shape
         )
-        return lax.all_gather(best_l, NODE_AXIS, tiled=True)
+        gathered = lax.all_gather(best_l, NODE_AXIS, tiled=True)
+        if stats is None:
+            return gathered
+        return gathered, stats
 
     return _shard_map(
         per_device,
@@ -270,7 +290,7 @@ def _dist_jet_impl(
             P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
             P(), P(), P(),
         ),
-        out_specs=P(),
+        out_specs=(P(), P()) if record else P(),
         check_vma=False,
     )(
         graph.src, graph.dst, graph.dst_local, graph.edge_w, graph.node_w,
@@ -311,18 +331,23 @@ def dist_jet_refine(
         if ctx.num_fruitless_iterations > 0
         else 2**30
     )
-    return _dist_jet_impl(
-        graph.src.sharding.mesh,
-        graph,
-        jnp.clip(jnp.asarray(partition, jnp.int32), 0, k - 1),
-        k,
-        jnp.asarray(max_block_weights, ACC_DTYPE),
-        jnp.asarray(seed),
-        jnp.float32(t0),
-        jnp.float32(t1),
-        jnp.float32(ctx.fruitless_threshold),
-        int(rounds),
-        int(max_iterations),
-        int(max_fruitless),
-        int(balancer_rounds),
+    return progress_mod.instrumented(
+        lambda rec: _dist_jet_impl(
+            graph.src.sharding.mesh,
+            graph,
+            jnp.clip(jnp.asarray(partition, jnp.int32), 0, k - 1),
+            k,
+            jnp.asarray(max_block_weights, ACC_DTYPE),
+            jnp.asarray(seed),
+            jnp.float32(t0),
+            jnp.float32(t1),
+            jnp.float32(ctx.fruitless_threshold),
+            int(rounds),
+            int(max_iterations),
+            int(max_fruitless),
+            int(balancer_rounds),
+            record=rec,
+        ),
+        "dist-jet", ("cut", "fruitless"),
+        rounds=int(rounds), iterations_per_round=int(max_iterations),
     )

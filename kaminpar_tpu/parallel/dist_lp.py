@@ -45,6 +45,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from ..ops.lp import LPConfig
+from ..telemetry import progress as progress_mod
 from ..ops.segments import (
     ACC_DTYPE,
     accept_prefix_by_capacity,
@@ -276,11 +277,20 @@ def _dist_lp_loop(
     cfg: LPConfig,
     iters: int,
     movable: Optional[jax.Array] = None,
-) -> jax.Array:
-    """shard_map'd multi-round loop; returns replicated labels [n_pad].
+    record: bool = False,
+):
+    """shard_map'd multi-round loop; returns replicated labels [n_pad]
+    (plus a replicated progress buffer when `record`).
 
     `movable` (replicated bool[n_pad], optional) freezes nodes where False
-    — used by the HEM+LP hybrid to pin matched pairs."""
+    — used by the HEM+LP hybrid to pin matched pairs.
+
+    `record` threads a per-round progress buffer through the carry
+    (telemetry/progress.py).  The recorded stat — globally-wanting
+    movers — is the already-psum'd convergence scalar, so the
+    instrumented trace adds NO collectives; the buffer is replicated and
+    rides the existing exit gather's launch.  False (the default) keeps
+    the jaxpr identical to the uninstrumented loop."""
     if movable is None:
         movable = jnp.ones(graph.n_pad, dtype=bool)
     g_loc = graph.g_loc
@@ -296,27 +306,31 @@ def _dist_lp_loop(
         # the ghosts' labels (labels0 is replicated only HERE, at entry)
         labels_l0 = lax.dynamic_slice(labels0, (offset,), (n_loc,))
         ghost_lab0 = labels0[jnp.clip(ghost_gid_l, 0, labels0.shape[0] - 1)]
+        stats0 = progress_mod.new_buffer(iters, 1) if record else None
 
         def cond(state):
-            i, _, _, _, _, moved = state
+            i, _, _, _, _, moved, _ = state
             return (i < iters) & (moved != 0)
 
         def body(state):
-            i, labels_l, ghost_lab, weights, active_l, _ = state
+            i, labels_l, ghost_lab, weights, active_l, _, stats = state
             salt = (seed.astype(jnp.int32) * 131071 + i * 1566083941) & 0x7FFFFFFF
             labels_l, ghost_lab, weights, active_l, moved = _dist_lp_round(
                 src_l, dst_l, dstloc_l, ew_l, nw_l, n, labels_l, ghost_lab,
                 send_idx_l, recv_map_l, weights, cap, active_l, movable_l,
                 salt, cfg,
             )
-            return (i + 1, labels_l, ghost_lab, weights, active_l, moved)
+            if stats is not None:  # trace-time guard (None adds no carry)
+                stats = progress_mod.record(stats, i, moved)
+            return (i + 1, labels_l, ghost_lab, weights, active_l, moved,
+                    stats)
 
         active0 = jnp.ones(n_loc, dtype=bool)
         init = (
             jnp.int32(0), labels_l0, ghost_lab0, weights0, active0,
-            jnp.int32(1),
+            jnp.int32(1), stats0,
         )
-        _, labels_l, _, _, _, _ = lax.while_loop(cond, body, init)
+        _, labels_l, _, _, _, _, stats = lax.while_loop(cond, body, init)
         # ONE O(n) gather at loop exit — the per-round collectives above
         # are all O(interface)
         from .mesh import account_collective
@@ -324,7 +338,10 @@ def _dist_lp_loop(
         account_collective(
             "all_gather(labels)", labels_l.size * 4, shape=labels_l.shape
         )
-        return lax.all_gather(labels_l, NODE_AXIS, tiled=True)
+        gathered = lax.all_gather(labels_l, NODE_AXIS, tiled=True)
+        if stats is None:
+            return gathered
+        return gathered, stats
 
     mapped = _shard_map(
         per_device,
@@ -334,7 +351,7 @@ def _dist_lp_loop(
             P(NODE_AXIS), P(), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
             P(), P(), P(), P(), P(),
         ),
-        out_specs=P(),
+        out_specs=(P(), P()) if record else P(),
         check_vma=False,
     )
     return mapped(
@@ -344,9 +361,9 @@ def _dist_lp_loop(
     )
 
 
-@partial(jax.jit, static_argnames=("mesh", "cfg", "num_iterations"))
+@partial(jax.jit, static_argnames=("mesh", "cfg", "num_iterations", "record"))
 def _dist_lp_cluster_impl(mesh, graph, max_cluster_weight, seed, cfg,
-                          num_iterations):
+                          num_iterations, record=False):
     n_pad = graph.n_pad
     labels0 = jnp.arange(n_pad, dtype=jnp.int32)
     weights0 = graph.node_w.astype(ACC_DTYPE)  # cluster c starts = node c
@@ -354,7 +371,8 @@ def _dist_lp_cluster_impl(mesh, graph, max_cluster_weight, seed, cfg,
         jnp.asarray(max_cluster_weight, ACC_DTYPE), (n_pad,)
     )
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
-    return _dist_lp_loop(mesh, graph, labels0, weights0, cap, seed, cfg, iters)
+    return _dist_lp_loop(mesh, graph, labels0, weights0, cap, seed, cfg,
+                         iters, record=record)
 
 
 def dist_lp_cluster(
@@ -370,16 +388,20 @@ def dist_lp_cluster(
     isolated-node clustering) run host-side on the replicated result —
     see dist_singleton_postpasses (the dist driver applies them per
     level)."""
-    return _dist_lp_cluster_impl(
-        graph.src.sharding.mesh, graph, jnp.asarray(max_cluster_weight),
-        jnp.asarray(seed), cfg, num_iterations,
+    return progress_mod.instrumented(
+        lambda rec: _dist_lp_cluster_impl(
+            graph.src.sharding.mesh, graph,
+            jnp.asarray(max_cluster_weight), jnp.asarray(seed), cfg,
+            num_iterations, record=rec,
+        ),
+        "dist-lp", ("moved",), phase="cluster",
     )
 
 
-@partial(jax.jit, static_argnames=("mesh", "cfg", "num_iterations"))
+@partial(jax.jit, static_argnames=("mesh", "cfg", "num_iterations", "record"))
 def _dist_lp_cluster_from_impl(mesh, graph, labels0, movable,
                                max_cluster_weight, seed, cfg,
-                               num_iterations):
+                               num_iterations, record=False):
     n_pad = graph.n_pad
     labels0 = jnp.asarray(labels0, jnp.int32)
     weights0 = jax.ops.segment_sum(
@@ -393,7 +415,7 @@ def _dist_lp_cluster_from_impl(mesh, graph, labels0, movable,
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
     return _dist_lp_loop(
         mesh, graph, labels0, weights0, cap, seed, cfg, iters,
-        movable=movable,
+        movable=movable, record=record,
     )
 
 
@@ -408,16 +430,20 @@ def dist_lp_cluster_from(
 ) -> jax.Array:
     """LP clustering from a given initial clustering with frozen nodes
     (`movable == False`).  Used by the HEM+LP hybrid clusterer."""
-    return _dist_lp_cluster_from_impl(
-        graph.src.sharding.mesh, graph, labels0, movable,
-        jnp.asarray(max_cluster_weight), jnp.asarray(seed), cfg,
-        num_iterations,
+    return progress_mod.instrumented(
+        lambda rec: _dist_lp_cluster_from_impl(
+            graph.src.sharding.mesh, graph, labels0, movable,
+            jnp.asarray(max_cluster_weight), jnp.asarray(seed), cfg,
+            num_iterations, record=rec,
+        ),
+        "dist-lp", ("moved",), phase="cluster-from",
     )
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "cfg", "num_iterations"))
+@partial(jax.jit,
+         static_argnames=("mesh", "k", "cfg", "num_iterations", "record"))
 def _dist_lp_refine_impl(mesh, graph, partition, k, max_block_weights, seed,
-                         cfg, num_iterations):
+                         cfg, num_iterations, record=False):
     part0 = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
     # replicated block weights via one psum'd local segment-sum
     def local_bw(nw_l, part):
@@ -439,7 +465,8 @@ def _dist_lp_refine_impl(mesh, graph, partition, k, max_block_weights, seed,
     )(graph.node_w, part0)
     cap = jnp.asarray(max_block_weights, ACC_DTYPE)
     iters = num_iterations if num_iterations is not None else cfg.num_iterations
-    return _dist_lp_loop(mesh, graph, part0, bw0, cap, seed, cfg, iters)
+    return _dist_lp_loop(mesh, graph, part0, bw0, cap, seed, cfg, iters,
+                         record=record)
 
 
 def dist_lp_refine(
@@ -456,10 +483,13 @@ def dist_lp_refine(
     need strictly positive gain under per-block max weights."""
     if not cfg.refinement:
         cfg = dataclasses.replace(cfg, refinement=True, allow_tie_moves=False)
-    return _dist_lp_refine_impl(
-        graph.src.sharding.mesh, graph, partition, k,
-        jnp.asarray(max_block_weights), jnp.asarray(seed), cfg,
-        num_iterations,
+    return progress_mod.instrumented(
+        lambda rec: _dist_lp_refine_impl(
+            graph.src.sharding.mesh, graph, partition, k,
+            jnp.asarray(max_block_weights), jnp.asarray(seed), cfg,
+            num_iterations, record=rec,
+        ),
+        "dist-lp", ("moved",), phase="refine",
     )
 
 

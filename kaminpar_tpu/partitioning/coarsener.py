@@ -64,6 +64,15 @@ class Coarsener:
     def coarsen(self) -> bool:
         """One coarsening step; returns False when converged (shrink factor
         below convergence_threshold, abstract_cluster_coarsener.cc:118-142)."""
+        from ..telemetry import progress as progress_mod
+
+        # label this level's LP progress series (the timer path alone
+        # repeats across levels; PASCO-style coarsening-quality curves
+        # need the level number)
+        with progress_mod.tag(level=self.level):
+            return self._coarsen_level()
+
+    def _coarsen_level(self) -> bool:
         c_ctx = self.ctx.coarsening
         max_cluster_weight = max(
             1,

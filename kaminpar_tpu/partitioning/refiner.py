@@ -54,13 +54,27 @@ class RefinerPipeline:
         level: int = 0,
         num_levels: int = 1,
     ) -> jax.Array:
-        from ..resilience import with_fallback
-        from ..utils import statistics
+        from ..telemetry import progress as progress_mod
         from ..ops.segments import pad_k_bucket
 
         k, max_block_weights, min_block_weights = pad_k_bucket(
             self.k, max_block_weights, min_block_weights
         )
+        # label every refiner's progress series with the uncoarsening
+        # level — the timer path repeats per level, the tag does not
+        with progress_mod.tag(level=level):
+            return self._refine_tagged(
+                graph, partition, k, max_block_weights, min_block_weights,
+                seed, level, num_levels,
+            )
+
+    def _refine_tagged(
+        self, graph, partition, k, max_block_weights, min_block_weights,
+        seed, level, num_levels,
+    ):
+        from ..resilience import with_fallback
+        from ..utils import statistics
+
         for i, algorithm in enumerate(self.ctx.refinement.algorithms):
             salt = jnp.int32((seed * 2654435761 + i * 40503 + level) & 0x7FFFFFFF)
             if algorithm == RefinementAlgorithm.NOOP:

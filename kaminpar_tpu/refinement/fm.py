@@ -26,6 +26,7 @@ import numpy as np
 from ..context import FMRefinementContext
 from ..graphs.csr import DeviceGraph, host_graph_from_device
 from ..graphs.host import HostGraph
+from ..telemetry import progress as progress_mod
 from .gains import create_host_gain_cache
 
 
@@ -58,12 +59,28 @@ def fm_refine_host(
         node_w = graph.node_weight_array()
         edge_w = graph.edge_weight_array()
         rng = np.random.default_rng(seed)
+        rec = progress_mod.capture()
+        t0 = progress_mod.now()
+        gains, moves, prefixes = [], [], []
         for _ in range(max(1, ctx.num_iterations)):
-            improvement = _fm_pass(
+            improvement, n_moves, best_prefix = _fm_pass(
                 graph, part, node_w, edge_w, max_bw, k, ctx, rng
             )
+            if rec:
+                gains.append(int(improvement))
+                moves.append(int(n_moves))
+                prefixes.append(int(best_prefix))
             if improvement <= 0:
                 break
+        if rec:
+            # host algorithm: per-pass series, same stream and shape as
+            # the device loops' buffers (gain = committed cut delta,
+            # moved = attempted moves, best_prefix = kept moves)
+            progress_mod.emit_host(
+                "fm",
+                {"gain": gains, "moved": moves, "best_prefix": prefixes},
+                t0=t0, engine="numpy",
+            )
         return part
 
     if os.environ.get("KAMINPAR_TPU_NO_NATIVE_FM", "") == "1":
@@ -79,6 +96,7 @@ def fm_refine_host(
         def _native_fm() -> np.ndarray:
             from .. import native
 
+            t0 = progress_mod.now()
             # native localized BATCH FM (fm.cpp — the reference's
             # parallel localized scheme minus threads: seeded regions
             # grown against a delta gain overlay, best prefixes
@@ -97,6 +115,13 @@ def fm_refine_host(
                 # so the policy wrapper routes it — NOT as zero gain
                 raise RefinerRefused(
                     f"native FM refused to run at n={graph.n}, k={k}"
+                )
+            if progress_mod.capture():
+                # the C engine reports one total: a single-point series
+                # keeps native and numpy runs alignable in the report
+                progress_mod.emit_host(
+                    "fm", {"gain": [int(improvement)]}, t0=t0,
+                    engine="native",
                 )
             return part
 
@@ -117,7 +142,9 @@ def fm_refine_host(
     return jnp.asarray(padded)
 
 
-def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
+def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng):
+    """One FM pass; returns (committed gain, attempted moves, kept
+    best-prefix length) — the per-pass progress triple."""
     n = graph.n
     src = graph.edge_sources()
     bw = np.zeros(k, dtype=np.int64)
@@ -127,7 +154,7 @@ def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
     cut_edge = part[src] != part[graph.adjncy]
     border = np.unique(src[cut_edge])
     if len(border) == 0:
-        return 0
+        return 0, 0, 0
 
     cache = create_host_gain_cache(graph, part, k)
     pq = []
@@ -193,4 +220,4 @@ def _fm_pass(graph, part, node_w, edge_w, max_bw, k, ctx, rng) -> int:
         part[u] = b
         bw[t] -= node_w[u]
         bw[b] += node_w[u]
-    return best_delta
+    return best_delta, len(moves), best_len

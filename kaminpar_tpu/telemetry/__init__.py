@@ -41,6 +41,7 @@ _lock = threading.Lock()
 _epoch = time.perf_counter()
 _spans: List["Span"] = []
 _events: List["Event"] = []
+_progress: List["ProgressSeries"] = []
 _run_info: Dict[str, Any] = {}
 _tids: Dict[int, int] = {}
 
@@ -79,6 +80,32 @@ class Event:
         return {"name": self.name, "t": self.t, "attrs": self.attrs}
 
 
+@dataclass
+class ProgressSeries:
+    """Per-iteration convergence series of one algorithm loop run
+    (telemetry/progress.py): parallel same-length lists keyed by stat
+    name, plus the dotted scope path of the enclosing timer scope."""
+
+    kind: str  # "lp", "jet", "fm", "balancer", "dist-lp", "dist-jet"
+    path: str  # dotted scope path at emit time (timer-tree aligned)
+    t0: float  # loop entry, seconds since the run epoch (0 if unknown)
+    t1: float  # emit time, seconds since the run epoch
+    iterations: int
+    series: Dict[str, List[Any]] = field(default_factory=dict)
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "path": self.path,
+            "t0": self.t0,
+            "t1": self.t1,
+            "iterations": self.iterations,
+            "series": self.series,
+            "attrs": self.attrs,
+        }
+
+
 def enabled() -> bool:
     return _enabled
 
@@ -86,6 +113,14 @@ def enabled() -> bool:
 def enable() -> None:
     global _enabled
     _enabled = True
+    # compile-cost accounting listens on jax.monitoring; installation is
+    # idempotent and the listeners no-op while telemetry is disabled
+    try:
+        from . import compile_account
+
+        compile_account.install()
+    except Exception:
+        pass
 
 
 def disable() -> None:
@@ -104,9 +139,16 @@ def reset() -> None:
     with _lock:
         _spans.clear()
         _events.clear()
+        _progress.clear()
         _run_info.clear()
         _tids.clear()
         _epoch = time.perf_counter()
+    try:
+        from . import compile_account
+
+        compile_account.reset()
+    except Exception:
+        pass
 
 
 def jsonable(v: Any) -> Any:
@@ -156,6 +198,48 @@ def event(name: str, **attrs: Any) -> None:
     clean = {k: jsonable(v) for k, v in attrs.items() if v is not None}
     with _lock:
         _events.append(Event(name, time.perf_counter() - _epoch, clean))
+
+
+def current_scope_path() -> str:
+    """Dotted path of the open timer-scope stack ("" at top level) —
+    progress series and compile-cost records align to the same paths
+    the scope tree and the spans use."""
+    try:
+        from ..utils.timer import GLOBAL_TIMER
+
+        return ".".join(n.name for n in GLOBAL_TIMER._stack[1:])
+    except Exception:
+        return ""
+
+
+def record_progress(kind: str, series: Dict[str, list], iterations: int,
+                    t0: float | None = None, **attrs: Any) -> None:
+    """Record one per-iteration convergence series (progress.emit*)."""
+    if not _enabled:
+        return
+    t1 = time.perf_counter() - _epoch
+    clean = {k: jsonable(v) for k, v in attrs.items() if v is not None}
+    entry = ProgressSeries(
+        kind=kind,
+        path=current_scope_path(),
+        t0=(t0 - _epoch) if t0 is not None else t1,
+        t1=t1,
+        iterations=int(iterations),
+        series={str(k): jsonable(v) for k, v in series.items()},
+        attrs=clean,
+    )
+    with _lock:
+        _progress.append(entry)
+
+
+def progress_series(kind: str | None = None) -> List["ProgressSeries"]:
+    """Recorded convergence series (named to avoid shadowing the
+    `telemetry.progress` submodule)."""
+    with _lock:
+        out = list(_progress)
+    if kind is not None:
+        out = [p for p in out if p.kind == kind]
+    return out
 
 
 def annotate(**kv: Any) -> None:
@@ -215,6 +299,22 @@ def add_cli_args(parser) -> None:
         "comm table, events; schema: "
         "kaminpar_tpu/telemetry/run_report.schema.json); enables telemetry",
     )
+    parser.add_argument(
+        "--diff-base", default=None, metavar="BASE.report.json",
+        help="after the run, diff this run's --report-json against a "
+        "baseline report (telemetry.diff) and exit non-zero past the "
+        "regression thresholds; requires --report-json",
+    )
+    parser.add_argument(
+        "--diff-wall-threshold", type=float, default=None, metavar="FRAC",
+        help="fractional wall-time regression tolerated by --diff-base "
+        "(default 0.10)",
+    )
+    parser.add_argument(
+        "--diff-cut-threshold", type=float, default=None, metavar="FRAC",
+        help="fractional edge-cut regression tolerated by --diff-base "
+        "(default 0.10)",
+    )
 
 
 def enable_if_requested(args) -> None:
@@ -223,9 +323,13 @@ def enable_if_requested(args) -> None:
         enable()
 
 
-def export_cli_outputs(args, extra_run=None, quiet: bool = False) -> None:
+def export_cli_outputs(args, extra_run=None, quiet: bool = False) -> int:
     """Write the files requested via add_cli_args (no-op without flags).
-    Collective on multi-host runs — call from every process."""
+    Collective on multi-host runs — call from every process.
+
+    Returns a process exit code: 0 normally; with --diff-base, the
+    telemetry.diff verdict against the baseline report (non-zero on a
+    regression past the thresholds, primary process only)."""
     primary = is_primary_process()
     if getattr(args, "trace_out", None):
         from .chrome_trace import write_chrome_trace
@@ -239,3 +343,23 @@ def export_cli_outputs(args, extra_run=None, quiet: bool = False) -> None:
         write_run_report(args.report_json, extra_run=extra_run)
         if not quiet and primary:
             print(f"REPORT written to {args.report_json}")
+    if getattr(args, "diff_base", None):
+        if not getattr(args, "report_json", None):
+            import sys
+
+            print("error: --diff-base requires --report-json",
+                  file=sys.stderr)
+            return 2
+        if not primary:
+            return 0
+        from .diff import main as diff_main
+
+        argv = [args.diff_base, args.report_json]
+        if getattr(args, "diff_wall_threshold", None) is not None:
+            argv += ["--wall-threshold", str(args.diff_wall_threshold)]
+        if getattr(args, "diff_cut_threshold", None) is not None:
+            argv += ["--cut-threshold", str(args.diff_cut_threshold)]
+        if quiet:
+            argv.append("--quiet")
+        return diff_main(argv)
+    return 0

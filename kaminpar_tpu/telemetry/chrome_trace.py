@@ -2,15 +2,19 @@
 
 Serializes the telemetry stream to the Chrome trace-event JSON object
 format (the `{"traceEvents": [...]}` flavor Perfetto and chrome://tracing
-both accept): every closed span becomes a complete ("ph": "X") event and
-every one-shot decision an instant ("ph": "i") event.
+both accept): every closed span becomes a complete ("ph": "X") event,
+every one-shot decision an instant ("ph": "i") event, and every
+algorithm-progress series (telemetry/progress.py) a counter ("ph": "C")
+track — moved nodes / cut / fruitless counters render as per-iteration
+curves under the phase that produced them.
 
 Multi-host runs get one track per process: each process's local stream is
 gathered with the same `process_allgather` machinery the distributed
 timer finalize uses (utils/timer.aggregate_across_processes), and the
-exporter emits the union with per-process `pid`s plus `process_name`
-metadata — the Perfetto analog of the reference's per-PE timer rows
-(kaminpar-dist/timer.cc).
+exporter emits the union with per-process `pid`s plus `process_name` /
+`thread_name` metadata ("ph": "M") so Perfetto labels tracks by RANK
+instead of bare pids — the Perfetto analog of the reference's per-PE
+timer rows (kaminpar-dist/timer.cc).
 """
 
 from __future__ import annotations
@@ -19,6 +23,7 @@ import json
 from typing import List, Tuple
 
 from . import events as _events
+from . import progress_series as _progress_series
 from . import spans as _spans
 
 
@@ -26,6 +31,7 @@ def _local_payload() -> dict:
     return {
         "spans": [s.to_dict() for s in _spans()],
         "events": [e.to_dict() for e in _events()],
+        "progress": [p.to_dict() for p in _progress_series()],
     }
 
 
@@ -67,19 +73,64 @@ def gather_payloads() -> List[Tuple[int, dict]]:
     return out
 
 
+def _counter_events(pid: int, series: dict) -> List[dict]:
+    """Counter ("ph": "C") events for one progress series: iteration
+    values spread uniformly over the loop's [t0, t1] wall window (the
+    per-iteration device timestamps never leave the fused loop — the
+    spread places the curve under the right span without inventing
+    precision the buffer does not have)."""
+    out: List[dict] = []
+    t0 = float(series.get("t0", 0.0))
+    t1 = max(float(series.get("t1", t0)), t0)
+    names = list(series.get("series", {}).keys())
+    n = int(series.get("iterations", 0))
+    if not names or n <= 0:
+        return out
+    kind = series.get("kind", "progress")
+    step = (t1 - t0) / n
+    for stat in names:
+        vals = series["series"][stat]
+        for i, v in enumerate(vals[:n]):
+            out.append(
+                {
+                    "ph": "C",
+                    "cat": "progress",
+                    "name": f"{kind}.{stat}",
+                    "ts": round((t0 + (i + 1) * step) * 1e6, 3),
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {stat: v},
+                }
+            )
+    return out
+
+
 def chrome_trace() -> dict:
     """The trace-event JSON object for the current stream."""
     trace_events: List[dict] = []
     for pid, payload in gather_payloads():
+        # rank-labeled metadata tracks: on multi-host runs the pid IS
+        # the process index, so "rank N" reads directly in Perfetto
         trace_events.append(
             {
                 "ph": "M",
                 "name": "process_name",
                 "pid": pid,
                 "tid": 0,
-                "args": {"name": f"kaminpar-tpu process {pid}"},
+                "args": {"name": f"kaminpar-tpu rank {pid}"},
             }
         )
+        tids = sorted({int(s.get("tid", 0)) for s in payload["spans"]} | {0})
+        for t in tids:
+            trace_events.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": t,
+                    "args": {"name": "main" if t == 0 else f"worker-{t}"},
+                }
+            )
         for s in payload["spans"]:
             trace_events.append(
                 {
@@ -106,6 +157,8 @@ def chrome_trace() -> dict:
                     "args": e.get("attrs", {}),
                 }
             )
+        for series in payload.get("progress", []):
+            trace_events.extend(_counter_events(pid, series))
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 

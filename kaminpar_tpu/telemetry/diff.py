@@ -1,0 +1,284 @@
+"""Run-report diff and regression gate.
+
+`python -m kaminpar_tpu.telemetry.diff BASE.report.json CAND.report.json`
+aligns two run reports (schema v1 or v2) by dotted scope path and by
+progress series, prints the wall / cut / convergence deltas, and exits
+non-zero when the candidate regresses past the configurable thresholds
+— the mechanical answer to "are these two runs the same solver?" that
+the reference's parseable timer output only enables by hand.
+
+Gated (exit 1 on regression):
+  * edge cut:        cand.result.cut  > base * (1 + --cut-threshold)
+  * feasibility:     base feasible but cand infeasible
+  * total wall:      cand wall > base * (1 + --wall-threshold), with an
+                     absolute --min-wall-s floor so micro-run noise
+                     cannot trip the gate
+
+Informational (printed, never gated):
+  * per-scope wall deltas (scope_tree alignment, largest first)
+  * compile vs execute split deltas (schema v2 `compile` section)
+  * progress-series convergence deltas: iterations to converge and, for
+    series carrying a `cut` stat, the final per-series cut
+
+Exit codes: 0 pass, 1 regression, 2 usage/IO error.  check_all.sh runs
+the self-diff (identical reports, expect 0) and a perturbed diff
+(expect 1) as a CI self-test; the CLIs wire it via `--diff-base`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_WALL_THRESHOLD = 0.10
+DEFAULT_CUT_THRESHOLD = 0.10
+DEFAULT_MIN_WALL_S = 0.05
+
+
+def load_report(path: str) -> dict:
+    with open(path) as f:
+        report = json.load(f)
+    if not isinstance(report, dict) or "schema_version" not in report:
+        raise ValueError(f"{path}: not a run report (no schema_version)")
+    return report
+
+
+def total_wall_s(report: dict) -> Optional[float]:
+    """Total partitioning wall: the CLI's measured seconds when present,
+    else the scope tree's top-level elapsed sum."""
+    run = report.get("run", {})
+    for key in ("partition_seconds", "wall_seconds"):
+        v = run.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    tree = report.get("scope_tree", {})
+    if tree:
+        return sum(
+            float(node.get("elapsed_s", 0.0)) for node in tree.values()
+        )
+    return None
+
+
+def flatten_scopes(tree: dict, prefix: str = "") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for name, node in tree.items():
+        path = f"{prefix}.{name}" if prefix else name
+        out[path] = float(node.get("elapsed_s", 0.0))
+        out.update(flatten_scopes(node.get("children", {}), path))
+    return out
+
+
+def _progress_key(entry: dict) -> Tuple:
+    attrs = entry.get("attrs", {})
+    return (
+        entry.get("kind", ""),
+        entry.get("path", ""),
+        attrs.get("level"),
+        attrs.get("round"),
+    )
+
+
+def align_progress(base: dict, cand: dict) -> List[Tuple[dict, dict]]:
+    """Pair progress series by (kind, path, level, round), in order of
+    appearance within each key (k-th occurrence pairs with k-th)."""
+    def grouped(report):
+        groups: Dict[Tuple, List[dict]] = {}
+        for entry in report.get("progress", []) or []:
+            groups.setdefault(_progress_key(entry), []).append(entry)
+        return groups
+
+    gb, gc = grouped(base), grouped(cand)
+    pairs: List[Tuple[dict, dict]] = []
+    for key, bs in gb.items():
+        cs = gc.get(key, [])
+        for b, c in zip(bs, cs):
+            pairs.append((b, c))
+    return pairs
+
+
+def _final(series: dict, name: str) -> Optional[float]:
+    vals = series.get("series", {}).get(name)
+    if vals:
+        return float(vals[-1])
+    return None
+
+
+def _pct(new: float, old: float) -> str:
+    if old == 0:
+        return "n/a" if new == 0 else "+inf"
+    return f"{100.0 * (new - old) / abs(old):+.1f}%"
+
+
+def diff_reports(
+    base: dict,
+    cand: dict,
+    wall_threshold: float = DEFAULT_WALL_THRESHOLD,
+    cut_threshold: float = DEFAULT_CUT_THRESHOLD,
+    min_wall_s: float = DEFAULT_MIN_WALL_S,
+) -> Tuple[List[str], List[str]]:
+    """Returns (report lines, gated failures); empty failures = pass."""
+    lines: List[str] = []
+    failures: List[str] = []
+
+    # -- result: cut + feasibility (the gate's primary signal) -----------
+    rb, rc = base.get("result", {}), cand.get("result", {})
+    cut_b, cut_c = rb.get("cut"), rc.get("cut")
+    if isinstance(cut_b, int) and isinstance(cut_c, int):
+        lines.append(f"cut: {cut_b} -> {cut_c} ({_pct(cut_c, cut_b)})")
+        if cut_c > cut_b * (1.0 + cut_threshold):
+            failures.append(
+                f"cut regressed {_pct(cut_c, cut_b)} "
+                f"(threshold +{cut_threshold * 100:.0f}%)"
+            )
+    if rb.get("feasible") is True and rc.get("feasible") is False:
+        failures.append("feasibility regressed: base feasible, cand not")
+
+    # -- total wall ------------------------------------------------------
+    # gate on EXECUTE wall when both reports meter compile time (schema
+    # v2): raw wall embeds XLA compile whose run-to-run jitter exceeds
+    # 10% on small runs, so gating it false-positives on identical code;
+    # subtracting each report's own compile_s compares what the solver
+    # actually did (and makes injected raw-wall regressions MORE
+    # visible, since the compile constant cancels)
+    wb, wc = total_wall_s(base), total_wall_s(cand)
+    if wb is not None and wc is not None:
+        lines.append(f"wall: {wb:.3f}s -> {wc:.3f}s ({_pct(wc, wb)})")
+        cb = base.get("compile", {}).get("totals", {}).get("compile_s")
+        cc = cand.get("compile", {}).get("totals", {}).get("compile_s")
+        if cb is not None and cc is not None:
+            wb_x = max(wb - float(cb), 0.0)
+            wc_x = max(wc - float(cc), 0.0)
+            lines.append(
+                f"wall minus compile: {wb_x:.3f}s -> {wc_x:.3f}s "
+                f"({_pct(wc_x, wb_x)}) [gated]"
+            )
+        else:
+            wb_x, wc_x = wb, wc
+        if wc_x > wb_x * (1.0 + wall_threshold) and (wc_x - wb_x) > min_wall_s:
+            failures.append(
+                f"execute wall regressed {_pct(wc_x, wb_x)} "
+                f"(threshold +{wall_threshold * 100:.0f}%, "
+                f"floor {min_wall_s}s)"
+            )
+
+    # -- per-scope walls (informational) ---------------------------------
+    sb = flatten_scopes(base.get("scope_tree", {}))
+    sc = flatten_scopes(cand.get("scope_tree", {}))
+    deltas = [
+        (abs(sc[p] - sb[p]), p, sb[p], sc[p])
+        for p in sorted(set(sb) & set(sc))
+        if max(sb[p], sc[p]) >= min_wall_s and sc[p] != sb[p]
+    ]
+    for _, path, b, c in sorted(deltas, reverse=True)[:8]:
+        lines.append(f"  scope {path}: {b:.3f}s -> {c:.3f}s ({_pct(c, b)})")
+    only_b, only_c = set(sb) - set(sc), set(sc) - set(sb)
+    if only_b:
+        lines.append(f"  scopes only in base: {sorted(only_b)[:5]}")
+    if only_c:
+        lines.append(f"  scopes only in cand: {sorted(only_c)[:5]}")
+
+    # -- compile split (schema v2; informational) ------------------------
+    tb = base.get("compile", {}).get("totals")
+    tc = cand.get("compile", {}).get("totals")
+    if tb and tc:
+        lines.append(
+            f"compile: {tb.get('compile_s', 0.0):.3f}s "
+            f"({tb.get('compiles', 0)}x) -> "
+            f"{tc.get('compile_s', 0.0):.3f}s ({tc.get('compiles', 0)}x); "
+            f"persistent cache {tb.get('persistent_cache_hits', 0)}/"
+            f"{tb.get('persistent_cache_misses', 0)} -> "
+            f"{tc.get('persistent_cache_hits', 0)}/"
+            f"{tc.get('persistent_cache_misses', 0)} hit/miss"
+        )
+
+    # -- progress convergence (schema v2; informational) -----------------
+    pairs = align_progress(base, cand)
+    for b, c in pairs:
+        key = _progress_key(b)
+        label = f"{key[0]}@{key[1] or '(top)'}"
+        if key[2] is not None:
+            label += f" level={key[2]}"
+        if key[3] is not None:
+            label += f" round={key[3]}"
+        ib, ic = b.get("iterations", 0), c.get("iterations", 0)
+        msg = f"  progress {label}: iters {ib} -> {ic}"
+        fb, fc = _final(b, "cut"), _final(c, "cut")
+        if fb is not None and fc is not None:
+            msg += f", final cut {fb:.0f} -> {fc:.0f} ({_pct(fc, fb)})"
+        mb, mc = _final(b, "moved"), _final(c, "moved")
+        if mb is not None and mc is not None:
+            msg += f", final moved {mb:.0f} -> {mc:.0f}"
+        if ib != ic or (fb, mb) != (fc, mc):
+            lines.append(msg)
+    nb = len(base.get("progress", []) or [])
+    nc = len(cand.get("progress", []) or [])
+    if nb or nc:
+        lines.append(
+            f"progress series: {nb} base / {nc} cand, {len(pairs)} aligned"
+        )
+    return lines, failures
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kaminpar_tpu.telemetry.diff",
+        description="diff two run reports; exit 1 on wall/cut regression",
+    )
+    ap.add_argument("base", help="baseline run report (--report-json)")
+    ap.add_argument("cand", help="candidate run report")
+    ap.add_argument(
+        "--wall-threshold", type=float, default=DEFAULT_WALL_THRESHOLD,
+        help="fractional total-wall regression tolerated (default 0.10)",
+    )
+    ap.add_argument(
+        "--cut-threshold", type=float, default=DEFAULT_CUT_THRESHOLD,
+        help="fractional edge-cut regression tolerated (default 0.10)",
+    )
+    ap.add_argument(
+        "--min-wall-s", type=float, default=DEFAULT_MIN_WALL_S,
+        help="absolute wall-delta floor below which the wall gate never "
+        "fires (default 0.05 s)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the verdict as one JSON line instead of text",
+    )
+    ap.add_argument("--quiet", action="store_true", help="verdict only")
+    args = ap.parse_args(argv)
+
+    try:
+        base = load_report(args.base)
+        cand = load_report(args.cand)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    lines, failures = diff_reports(
+        base, cand,
+        wall_threshold=args.wall_threshold,
+        cut_threshold=args.cut_threshold,
+        min_wall_s=args.min_wall_s,
+    )
+    if args.json:
+        print(json.dumps({
+            "base": args.base,
+            "cand": args.cand,
+            "pass": not failures,
+            "failures": failures,
+            "detail": lines,
+        }))
+    else:
+        if not args.quiet:
+            for line in lines:
+                print(line)
+        for f in failures:
+            print(f"REGRESSION: {f}", file=sys.stderr)
+        print(f"DIFF {'FAIL' if failures else 'OK'} "
+              f"({len(failures)} regression(s))")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,196 @@
+"""Algorithm-progress capture: per-iteration convergence series.
+
+PR 1's spans show *where time goes*; this layer shows *what the
+algorithms are doing* while it passes.  LP, Jet, FM and the balancers
+run fully fused inside `lax.while_loop`, so the per-iteration state the
+reference's statistics registry would print (moved nodes, cut, fruitless
+counter, balancer violation mass — kaminpar-common/statistics lineage,
+"Tera-Scale Multilevel Graph Partitioning" §6) is computed on device
+every round and then thrown away.  Here each instrumented loop threads a
+fixed-size stat buffer through its carry:
+
+  * `new_buffer(rows, stats)` allocates an i-indexed (rows, stats)
+    ACC_DTYPE buffer filled with the UNWRITTEN sentinel;
+  * `record(buf, i, *stats)` writes row `i` device-side
+    (`.at[i].set(..., mode="drop")` — iterations beyond the buffer are
+    dropped, never clamped onto another row);
+  * `emit(kind, names, buf, t0)` pulls the buffer ONCE at loop exit
+    (host-side, outside jit — no new host syncs inside traced code, so
+    tpulint R1/R2 stay clean) and records a ProgressSeries on the
+    telemetry stream.
+
+Zero-overhead-when-disabled contract: the buffer rides the carry as an
+optional pytree leaf.  Callers pass `None` when `capture()` is false,
+and every `record()` site is guarded by `if buf is not None` — a
+trace-time python branch — so the disabled jaxpr is IDENTICAL to the
+uninstrumented loop (no extra carry, no retrace; pinned by
+tests/test_telemetry.py's jaxpr-equality test).  Because the buffer is
+an ordinary argument, the jit cache keys the two variants apart by
+pytree structure; toggling telemetry can never serve a stale trace.
+
+Drivers label series with loop-external context (coarsening level,
+uncoarsening level, v-cycle) via the `tag(...)` context manager; the
+tags ride into the series' attrs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Sequence
+
+from . import enabled as _telemetry_enabled
+from . import record_progress
+
+# Rows are indexed by the loop counter; the sentinel marks never-written
+# rows (early convergence) so emit() can trim the tail.  All recorded
+# stats are counts/cuts >= 0, so any negative sentinel is unambiguous.
+UNWRITTEN = -(2**31)
+
+ENV_VAR = "KAMINPAR_TPU_PROGRESS"
+
+# driver-pushed context tags (level, round, ...) merged into every
+# series emitted while the tag scope is open
+_tags: Dict[str, Any] = {}
+
+
+def capture() -> bool:
+    """Whether loops should thread stat buffers through their carries.
+
+    True iff telemetry is enabled and KAMINPAR_TPU_PROGRESS is not 0 —
+    read at TRACE time by the non-jit entry points, which pass the
+    buffer (or None) down as an ordinary argument."""
+    if os.environ.get(ENV_VAR, "") == "0":
+        return False
+    return _telemetry_enabled()
+
+
+@contextmanager
+def tag(**kv: Any):
+    """Label series emitted inside with driver context (level=3, ...)."""
+    saved = {k: _tags.get(k) for k in kv}
+    _tags.update(kv)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                _tags.pop(k, None)
+            else:
+                _tags[k] = v
+
+
+def current_tags() -> Dict[str, Any]:
+    return dict(_tags)
+
+
+def new_buffer(rows: int, stats: int):
+    """Device-side (rows, stats) stat buffer (ACC_DTYPE, sentinel-filled).
+
+    `rows` is a static python int — size it to the loop's iteration
+    budget so every iteration has a row; extra iterations drop."""
+    import jax.numpy as jnp
+
+    from ..dtypes import ACC_DTYPE
+
+    return jnp.full((max(int(rows), 1), stats), UNWRITTEN, dtype=ACC_DTYPE)
+
+
+def record(buf, i, *stats):
+    """Write row `i` (traced) of the buffer; out-of-range rows drop.
+
+    Device-side, call inside the loop body ONLY under an
+    `if buf is not None` trace-time guard."""
+    import jax.numpy as jnp
+
+    row = jnp.stack([jnp.asarray(s).astype(buf.dtype) for s in stats])
+    return buf.at[i].set(row, mode="drop")
+
+
+def emit(kind: str, names: Sequence[str], buf, t0: float | None = None,
+         **attrs: Any) -> None:
+    """Pull a stat buffer (ONE host transfer) and record the series.
+
+    Call from host-side driver code after the loop exits, never from
+    jit-TRACED code (the pull is a device sync and would fail on a
+    tracer).  Calling from inside an open timer scope is by design —
+    that is where the series' dotted path comes from; the pull just
+    must not sit lexically inside a `with scoped_timer(...)` block of a
+    driver module, which tpulint R1 polices (these emit sites live in
+    the ops modules, outside the drivers' span blocks).  No-op when
+    `buf` is None, the loop never ran (all-sentinel buffer — e.g. an
+    already-feasible balancer), or telemetry got disabled meanwhile."""
+    if buf is None or not _telemetry_enabled():
+        return
+    import numpy as np
+
+    arr = np.asarray(buf)
+    # select written rows (loop order is preserved): buffers indexed by
+    # a global counter across rounds legitimately leave sentinel gaps
+    # when a round early-exits, so compress rather than prefix-slice
+    arr = arr[arr[:, 0] != UNWRITTEN]
+    n = arr.shape[0]
+    if n == 0:
+        # the loop body never executed (e.g. the balancer's feasibility
+        # check was true on entry) — an empty series carries no
+        # information and would bloat multi-level reports
+        return
+    series = {
+        name: arr[:, j].tolist() for j, name in enumerate(names)
+    }
+    merged = dict(_tags)
+    merged.update({k: v for k, v in attrs.items() if v is not None})
+    record_progress(kind, series, iterations=n, t0=t0, **merged)
+
+
+def emit_host(kind: str, series: Dict[str, Sequence], t0: float | None = None,
+              **attrs: Any) -> None:
+    """Record a series assembled host-side (the FM refiner, chunked
+    device loops that already read back their convergence scalar)."""
+    if not _telemetry_enabled():
+        return
+    n = max((len(v) for v in series.values()), default=0)
+    merged = dict(_tags)
+    merged.update({k: v for k, v in attrs.items() if v is not None})
+    record_progress(
+        kind, {k: list(v) for k, v in series.items()},
+        iterations=n, t0=t0, **merged,
+    )
+
+
+def now() -> float:
+    """Loop-entry timestamp for emit(t0=...) (host clock, run-relative
+    conversion happens in record_progress)."""
+    return time.perf_counter()
+
+
+def instrumented(call, kind: str, names: Sequence[str],
+                 rows: int | None = None, **attrs: Any):
+    """Run one instrumented loop entry point, centralizing the capture
+    dance every public wrapper would otherwise repeat: decide capture,
+    allocate the buffer, invoke, unpack, emit, return the bare result.
+
+    `call` receives ONE argument and must honor the stats/None contract
+    the loops implement:
+
+      * `rows` given  — the argument is a fresh `(rows, len(names))`
+        buffer (or None when capture is off); the impl threads it
+        through its carry and returns `(result, stats)` when it got a
+        buffer, else just `result`.
+      * `rows` None   — the argument is the capture BOOL (for shard_map
+        impls that must allocate the buffer inside the traced region,
+        keyed by a static `record` flag); same return contract.
+    """
+    rec = capture()
+    t0 = now()
+    if rows is not None:
+        stats = new_buffer(rows, len(names)) if rec else None
+        out = call(stats)
+    else:
+        out = call(rec)
+    if not rec:
+        return out
+    result, stats = out
+    emit(kind, names, stats, t0, **attrs)
+    return result

@@ -22,9 +22,10 @@ from typing import Any, Dict, Optional
 
 from . import events as _events
 from . import jsonable
+from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -56,6 +57,19 @@ def environment_stamp() -> dict:
         env.setdefault("device_count", 0)
         env.setdefault("process_count", 1)
     return env
+
+
+def _compile_section() -> dict:
+    """Compile-cost aggregate (trace/lower/compile seconds per phase,
+    cache hit/miss totals); empty-but-well-formed when the monitoring
+    listeners never installed (telemetry enabled mid-run)."""
+    try:
+        from . import compile_account
+
+        return compile_account.snapshot()
+    except Exception:
+        return {"caveat": "compile accounting unavailable",
+                "totals": {}, "phases": {}}
 
 
 def _fault_section() -> dict:
@@ -163,6 +177,13 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         "faults": _fault_section(),
         "degraded": [e.to_dict() for e in _events("degraded")],
         "output_gate": gate_verdict,
+        # schema v2: per-iteration convergence series from the
+        # instrumented device loops (telemetry/progress.py) and the
+        # compile-cost split (telemetry/compile_account.py) — together
+        # they answer "what did the algorithms do" and "was the slow
+        # part compile or execute"
+        "progress": [p.to_dict() for p in _progress_series()],
+        "compile": _compile_section(),
     }
     if agg is not None:
         report["timers_aggregated"] = agg

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Render (and sanity-check) the recorded BENCH trajectory.
+
+The harness records one `BENCH_rNN.json` per round: the bench.py exit
+status, output tail, and the parsed BENCH line (which, since the
+telemetry layer landed, embeds the schema-validated run report).  This
+tool turns the checked-in trajectory into a table — cut, vs_baseline,
+wall seconds, and the compile split when a round carries a v2 report —
+so "did round N regress round N-1" is a read, not an archaeology dig.
+
+Usage:
+  python scripts/bench_trend.py [--dir REPO] [--json]
+  python scripts/bench_trend.py --check     # CI: structural validation
+
+`--check` exits non-zero when a recorded round is malformed (unreadable
+JSON, rc==0 without a parsed BENCH line, parsed line missing the metric
+fields) — cut/wall movements between rounds are PRINTED, not gated:
+rounds run on different code by design, and the per-PR regression gate
+is `telemetry.diff` on like-for-like reports (scripts/check_all.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REQUIRED_PARSED_KEYS = ("metric", "value", "unit")
+
+
+def load_rounds(repo: str) -> List[Tuple[str, dict]]:
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    return [(p, json.load(open(p))) for p in paths]
+
+
+def check_round(path: str, entry: Any) -> List[str]:
+    errors: List[str] = []
+    name = os.path.basename(path)
+    if not isinstance(entry, dict):
+        return [f"{name}: not a JSON object"]
+    for key in ("n", "cmd", "rc"):
+        if key not in entry:
+            errors.append(f"{name}: missing key {key!r}")
+    rc = entry.get("rc")
+    parsed = entry.get("parsed")
+    if rc == 0:
+        if not isinstance(parsed, dict):
+            errors.append(f"{name}: rc==0 but no parsed BENCH line")
+        else:
+            for key in REQUIRED_PARSED_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: parsed BENCH line missing {key!r}"
+                    )
+            report = parsed.get("report")
+            if report is not None and (
+                not isinstance(report, dict)
+                or "schema_version" not in report
+            ):
+                errors.append(
+                    f"{name}: embedded report lacks schema_version"
+                )
+    return errors
+
+
+def _row(path: str, entry: dict) -> Dict[str, Any]:
+    parsed = entry.get("parsed") or {}
+    report = parsed.get("report") or {}
+    compile_totals = report.get("compile", {}).get("totals", {})
+    return {
+        "round": os.path.basename(path),
+        "rc": entry.get("rc"),
+        "cut": parsed.get("value"),
+        "vs_baseline": parsed.get("vs_baseline"),
+        "total_s": parsed.get("total_seconds"),
+        "coarsening_s": parsed.get("lp_coarsening_seconds"),
+        "platform": parsed.get("platform"),
+        "compile_s": compile_totals.get("compile_s"),
+        "schema": report.get("schema_version"),
+    }
+
+
+def _fmt(v: Optional[Any]) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.3g}"
+    return str(v)
+
+
+def render(rows: List[Dict[str, Any]]) -> str:
+    cols = ("round", "rc", "cut", "vs_baseline", "total_s",
+            "coarsening_s", "compile_s", "platform", "schema")
+    table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = [
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        for row in table
+    ]
+    # movement annotations between consecutive parsed rounds
+    prev = None
+    for r in rows:
+        if prev and r["cut"] and prev["cut"]:
+            delta = 100.0 * (r["cut"] - prev["cut"]) / prev["cut"]
+            if abs(delta) >= 5.0:
+                lines.append(
+                    f"note: {prev['round']} -> {r['round']} cut moved "
+                    f"{delta:+.1f}%"
+                )
+        if r["cut"] is not None:
+            prev = r
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render / validate the BENCH_r*.json trajectory"
+    )
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding BENCH_r*.json (default: this repo)",
+    )
+    ap.add_argument("--json", action="store_true", help="emit rows as JSON")
+    ap.add_argument(
+        "--check", action="store_true",
+        help="CI mode: exit non-zero on structurally malformed rounds",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        rounds = load_rounds(args.dir)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if not rounds:
+        print(f"no BENCH_r*.json under {args.dir}", file=sys.stderr)
+        return 0 if not args.check else 1
+
+    errors: List[str] = []
+    for path, entry in rounds:
+        errors.extend(check_round(path, entry))
+    rows = [_row(p, e) for p, e in rounds if isinstance(e, dict)]
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(render(rows))
+    if errors:
+        for e in errors:
+            print(f"TREND VIOLATION {e}", file=sys.stderr)
+    if args.check:
+        print(f"trend check: {len(rounds)} round(s), "
+              f"{len(errors)} violation(s)")
+        return 1 if errors else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,25 +1,27 @@
 #!/usr/bin/env bash
 # The one-command commit gate: tpulint, run-report schema check, a
 # chaos smoke run (every fault site injected once; the run must still
-# produce a gate-valid partition and a schema-valid report), and the
+# produce a gate-valid partition and a schema-valid report), the
+# telemetry.diff regression-gate self-test + BENCH-trend check, and the
 # ROADMAP.md tier-1 pytest command.  Exits nonzero on the first
 # failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
 #         --fast skips the tier-1 pytest stage (lint + schema + chaos
-#         smoke; lint + schema are the pair the pre-commit hooks run).
+#         smoke + diff self-test; lint + schema are the pair the
+#         pre-commit hooks run).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/4] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/5] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/4] run-report schema (producer selftest) =="
+echo "== [2/5] run-report schema (producer selftest, v1 + v2) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/4] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/5] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -31,16 +33,41 @@ r = json.load(open("/tmp/_kmp_chaos_report.json"))
 gate = r["output_gate"]
 assert gate["checked"] and gate["valid"], f"chaos run failed the gate: {gate}"
 assert r["faults"]["plan"] == "all:nth=1", r["faults"]
+assert r["progress"], "v2 report carries no progress series"
+# a fresh process always backend-compiles, so a zero count here means
+# the accounting silently stopped recording, not a warm cache
+assert r["compile"]["totals"]["compiles"] > 0, r["compile"]["totals"]
 print(f"chaos smoke OK: {len(r['degraded'])} degraded event(s), "
-      f"gate valid, cut={gate['cut_recomputed']}")
+      f"gate valid, cut={gate['cut_recomputed']}, "
+      f"{len(r['progress'])} progress series")
 EOF
 
+echo "== [4/5] telemetry.diff self-test + BENCH trend =="
+# identical reports must pass (rc 0)...
+python -m kaminpar_tpu.telemetry.diff \
+    /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
+# ...and an injected 50% wall + cut regression must FAIL (rc 1)
+python - <<'EOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_chaos_report.json"))
+r["result"]["cut"] = int(r["result"]["cut"] * 1.5) + 10
+run = r.setdefault("run", {})
+run["partition_seconds"] = float(run.get("partition_seconds", 1.0)) * 1.5 + 1.0
+json.dump(r, open("/tmp/_kmp_chaos_report_perturbed.json", "w"))
+EOF
+if python -m kaminpar_tpu.telemetry.diff \
+    /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report_perturbed.json; then
+    echo "ERROR: telemetry.diff accepted an injected 50% regression" >&2
+    exit 1
+fi
+python scripts/bench_trend.py --check || exit 1
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [4/4] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [5/5] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [4/4] tier-1 pytest (ROADMAP.md) =="
+echo "== [5/5] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

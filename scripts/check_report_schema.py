@@ -81,6 +81,46 @@ def validate_instance(instance: Any, schema: dict, path: str = "$") -> List[str]
     return errors
 
 
+def version_checks(report: Any) -> List[str]:
+    """Schema_version-conditional requirements the dependency-free
+    validator subset cannot express (no if/then): v2 reports must carry
+    the `progress` and `compile` sections; v1 reports remain valid
+    without them during the transition."""
+    errors: List[str] = []
+    if isinstance(report, dict) and report.get("schema_version") == 2:
+        for key in ("progress", "compile"):
+            if key not in report:
+                errors.append(
+                    f"$: schema_version 2 requires section {key!r}"
+                )
+    return errors
+
+
+def _minimal_v1_report() -> dict:
+    """A minimal schema_version-1 report (the pre-progress/compile
+    layout) — the transition fixture --selftest validates alongside the
+    live v2 producer, so v1 artifacts (old BENCH lines, archived
+    --report-json files) keep validating."""
+    return {
+        "schema_version": 1,
+        "environment": {
+            "version": "0", "python": "3", "platform": "cpu",
+            "device_count": 1, "process_count": 1, "jax_version": "0",
+        },
+        "run": {"preset": "default", "seed": 1, "k": 2},
+        "result": {"cut": 0, "imbalance": 0.0, "feasible": True},
+        "scope_tree": {},
+        "levels": [],
+        "comm": {"caveat": "none", "records": []},
+        "events": [],
+        "counters": {},
+        "lane_gather": {"mode": "not-probed"},
+        "faults": {"plan": None, "sites": [], "injected": []},
+        "degraded": [],
+        "output_gate": {"checked": False},
+    }
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
@@ -110,8 +150,9 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--selftest", action="store_true",
-        help="generate a minimal report from the live producer and "
-        "validate it (no report file needed)",
+        help="generate a minimal report from the live producer (schema "
+        "v2) and validate it plus the embedded v1 transition fixture "
+        "(no report file needed)",
     )
     args = ap.parse_args(argv)
 
@@ -134,6 +175,22 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
+        # live producer must emit v2 (progress + compile sections)
+        if report.get("schema_version") != 2:
+            print(
+                f"SCHEMA VIOLATION $: selftest producer emitted "
+                f"schema_version {report.get('schema_version')!r}, "
+                f"expected 2",
+                file=sys.stderr,
+            )
+            return 1
+        # transition coverage: the v1 layout must STILL validate
+        v1 = _minimal_v1_report()
+        v1_errors = validate_instance(v1, schema) + version_checks(v1)
+        if v1_errors:
+            for e in v1_errors:
+                print(f"SCHEMA VIOLATION (v1 fixture) {e}", file=sys.stderr)
+            return 1
     elif args.report is None:
         ap.error("a report file is required unless --selftest is given")
     else:
@@ -142,7 +199,7 @@ def main(argv=None) -> int:
         with open(args.report) as f:
             report = json.load(f)
 
-    errors = validate_instance(report, schema)
+    errors = validate_instance(report, schema) + version_checks(report)
     if errors:
         for e in errors:
             print(f"SCHEMA VIOLATION {e}", file=sys.stderr)
