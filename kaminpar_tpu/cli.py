@@ -40,21 +40,9 @@ from .utils.logger import OutputLevel
 # Context <-> plain dict (for -C config files and --dump-config)
 # ---------------------------------------------------------------------------
 
-def context_to_dict(obj: Any) -> Any:
-    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        return {
-            f.name: context_to_dict(getattr(obj, f.name))
-            for f in dataclasses.fields(obj)
-        }
-    if isinstance(obj, enum.Enum):
-        return obj.value
-    if isinstance(obj, np.ndarray):
-        return obj.tolist()
-    if isinstance(obj, (list, tuple)):
-        return [context_to_dict(x) for x in obj]
-    if isinstance(obj, float) and obj == float("inf"):
-        return "inf"
-    return obj
+# re-exported from context.py (historical home; the checkpoint ctx
+# fingerprint needs it below the CLI layer)
+from .context import context_to_dict  # noqa: F401,E402
 
 
 def apply_dict_to_context(ctx: Any, data: Dict[str, Any]) -> None:
@@ -170,6 +158,33 @@ def build_parser() -> argparse.ArgumentParser:
         "see docs/robustness.md)",
     )
     p.add_argument(
+        "--checkpoint-dir", default=None, metavar="DIR",
+        help="write atomic pipeline-barrier checkpoints (versioned, "
+        "checksummed manifest) under DIR; a preempted run can then "
+        "--resume without re-running completed levels "
+        "(docs/robustness.md)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="re-enter the pipeline at the stage recorded in "
+        "--checkpoint-dir (graph + config fingerprints must match, "
+        "else a clean restart); requires --checkpoint-dir",
+    )
+    p.add_argument(
+        "--time-budget", type=float, default=None, metavar="SECS",
+        help="anytime mode: wind down at the next pipeline barrier once "
+        "SECS of partitioning have elapsed and return the best "
+        "gate-valid partition reached (report annotated anytime: true)",
+    )
+    p.add_argument(
+        "--budget-grace", type=float, default=None, metavar="SECS",
+        help="declared wind-down allowance on top of --time-budget for "
+        "the mandatory tail (extension, gate/repair, final checkpoint; "
+        "default 30).  Advisory: reported in the anytime section so "
+        "operators can size preemption windows; the tail is not "
+        "forcibly interrupted",
+    )
+    p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
@@ -245,6 +260,14 @@ def make_context(args: argparse.Namespace) -> Context:
         ctx.debug.dump_dir = args.debug_dump_dir
     if args.no_repair:
         ctx.resilience.repair = False
+    if args.checkpoint_dir:
+        ctx.resilience.checkpoint_dir = args.checkpoint_dir
+    if args.resume:
+        ctx.resilience.resume = True
+    if args.time_budget is not None:
+        ctx.resilience.time_budget = args.time_budget
+    if args.budget_grace is not None:
+        ctx.resilience.budget_grace = args.budget_grace
     if args.seed is not None:  # -C config may set the seed; flag wins
         ctx.seed = args.seed
     return ctx
@@ -264,6 +287,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.k is None and args.max_block_weights is None:
         print("error: need -k or -B/--max-block-weights", file=sys.stderr)
         return 1
+    if args.resume and not args.checkpoint_dir:
+        print("error: --resume requires --checkpoint-dir", file=sys.stderr)
+        return 2
+
+    # preemption routing (resilience/deadline.py): SIGTERM/SIGINT wind
+    # the pipeline down at its next barrier and still produce a valid
+    # partition + final checkpoint; a second signal forces the classic
+    # behavior (handled by the emergency path below)
+    from .resilience import deadline as deadline_mod
+
+    deadline_mod.install_signal_handlers()
 
     from . import telemetry
     from .utils import heap_profiler, statistics
@@ -344,16 +378,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         ctx.partition.setup_min_block_weights(args.min_epsilon)
 
     t0 = time.perf_counter()
-    partition = partitioner.compute_partition(
-        k=args.k,
-        epsilon=args.epsilon,
-        max_block_weights=(
-            np.asarray(args.max_block_weights, dtype=np.int64)
-            if args.max_block_weights
-            else None
-        ),
-        seed=args.seed,
-    )
+    try:
+        partition = partitioner.compute_partition(
+            k=args.k,
+            epsilon=args.epsilon,
+            max_block_weights=(
+                np.asarray(args.max_block_weights, dtype=np.int64)
+                if args.max_block_weights
+                else None
+            ),
+            seed=args.seed,
+        )
+    except KeyboardInterrupt:
+        # a forced interrupt (second SIGINT) can surface from deep
+        # inside a jitted while_loop with timer scopes still open;
+        # close them so the emergency run report stays schema-valid,
+        # then write whatever observability artifacts were requested
+        return _emergency_interrupt_exit(args, t0)
     wall = time.perf_counter() - t0
 
     if not args.quiet:
@@ -393,6 +434,49 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.output_block_sizes, partition, ctx.partition.k
         )
     return rc
+
+
+def _emergency_interrupt_exit(args, t0: float) -> int:
+    """The hard-interrupt path (shared by cli and dcli): unwind open
+    timer scopes — SIGINT during a jitted while_loop used to leave them
+    open, making the emergency report schema-invalid — annotate the
+    interruption, and export any requested report/trace before exiting
+    with the conventional 130."""
+    from . import telemetry
+    from .resilience import deadline as deadline_mod
+
+    closed = timer.GLOBAL_TIMER.unwind()
+    if telemetry.enabled():
+        anytime = {
+            "anytime": True,
+            "reason": "keyboard-interrupt",
+            "elapsed_s": round(time.perf_counter() - t0, 3),
+        }
+        if deadline_mod.stage_reached():
+            anytime["stage"] = deadline_mod.stage_reached()
+        telemetry.annotate(anytime=anytime)
+        if "result" not in telemetry.run_info():
+            # no partition was produced; the schema-required result
+            # section carries an explicit no-result sentinel (cut -1,
+            # infeasible) rather than going missing — run.interrupted
+            # marks the report for downstream consumers (telemetry.diff)
+            telemetry.annotate(
+                result={"cut": -1, "imbalance": 0.0, "feasible": False}
+            )
+        telemetry.export_cli_outputs(
+            args,
+            extra_run={"interrupted": True,
+                       "partition_seconds": round(
+                           time.perf_counter() - t0, 3)},
+            quiet=args.quiet,
+        )
+    print(
+        f"interrupted: {closed} open timer scope(s) closed"
+        + (", emergency report written" if getattr(args, "report_json", None)
+           else ""),
+        file=sys.stderr,
+    )
+    return 130
 
 
 if __name__ == "__main__":

@@ -409,10 +409,24 @@ class ResilienceContext:
     (one O(n + m) host pass; also killable per-run via
     KAMINPAR_TPU_OUTPUT_GATE=0); `repair` lets the gate fix balance
     violations with the greedy host pass (--no-repair disables repair
-    but keeps the check, so violations still surface in telemetry)."""
+    but keeps the check, so violations still surface in telemetry).
+
+    Preemption safety (resilience/checkpoint.py, resilience/deadline.py):
+    `checkpoint_dir` enables atomic barrier snapshots there; `resume`
+    re-enters at the recorded stage when the directory holds a matching
+    manifest; `time_budget` (> 0) arms a monotonic deadline checked
+    cooperatively at the pipeline barriers — on expiry the run winds
+    down and returns a gate-valid partition annotated `anytime: true`;
+    `budget_grace` is the DECLARED wind-down allowance on top of it —
+    advisory (reported in the anytime section for operators sizing
+    preemption windows), the mandatory tail is not forcibly killed."""
 
     output_gate: bool = True
     repair: bool = True
+    checkpoint_dir: str = ""
+    resume: bool = False
+    time_budget: float = 0.0
+    budget_grace: float = 30.0
 
 
 @dataclass
@@ -427,6 +441,31 @@ class DebugContext:
     dump_graph_hierarchy: bool = False
     dump_partition_hierarchy: bool = False
     dump_dir: str = "."
+
+
+def context_to_dict(obj):
+    """Context tree (any dataclass tree, really) -> plain nested dict:
+    enums to values, numpy arrays to lists, inf to "inf".  Lives here —
+    below the CLI — because library-level consumers need it too (TOML
+    round-tripping in cli.py, the checkpoint ctx fingerprint in
+    resilience/checkpoint.py)."""
+    import dataclasses as _dc
+    import enum as _enum
+
+    if _dc.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: context_to_dict(getattr(obj, f.name))
+            for f in _dc.fields(obj)
+        }
+    if isinstance(obj, _enum.Enum):
+        return obj.value
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (list, tuple)):
+        return [context_to_dict(x) for x in obj]
+    if isinstance(obj, float) and obj == float("inf"):
+        return "inf"
+    return obj
 
 
 @dataclass

@@ -80,6 +80,28 @@ def build_parser() -> argparse.ArgumentParser:
         help="print the per-phase collective-traffic account "
         "(trace-time accounting; see docs/observability.md)",
     )
+    p.add_argument(
+        "--checkpoint-dir", default=None, metavar="DIR",
+        help="write atomic pipeline-barrier checkpoints under DIR "
+        "(rank 0 writes; barrier-consistent stage ids); dist resume "
+        "currently restores completed `result` snapshots only "
+        "(docs/robustness.md)",
+    )
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume from --checkpoint-dir (fingerprint-validated; "
+        "mismatch degrades to a clean restart)",
+    )
+    p.add_argument(
+        "--time-budget", type=float, default=None, metavar="SECS",
+        help="anytime mode: wind down at the next pipeline barrier once "
+        "SECS have elapsed and return the best partition reached",
+    )
+    p.add_argument(
+        "--budget-grace", type=float, default=None, metavar="SECS",
+        help="declared (advisory, reported-not-enforced) wind-down "
+        "allowance on top of --time-budget (default 30)",
+    )
     from . import telemetry
 
     telemetry.add_cli_args(p)
@@ -143,6 +165,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"FAULTS plan={fault_plan} (fault injection ACTIVE; "
                 "see the report's 'faults' section)"
             )
+    if args.resume and not args.checkpoint_dir:
+        print("error: --resume requires --checkpoint-dir", file=sys.stderr)
+        return 2
     mesh = make_mesh(args.num_devices)
     solver = dKaMinPar(args.preset, mesh=mesh)
     solver.set_graph(graph)
@@ -150,10 +175,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         # instance-scoped: compute_partition applies and restores it
         solver.set_output_level(OutputLevel.QUIET)
 
+    # preemption routing + checkpoint/budget knobs (cli.py twin); the
+    # dist driver reads them from the shm resilience context
+    from .resilience import deadline as deadline_mod
+
+    deadline_mod.install_signal_handlers()
+    res_ctx = solver.ctx.shm.resilience
+    if args.checkpoint_dir:
+        res_ctx.checkpoint_dir = args.checkpoint_dir
+    if args.resume:
+        res_ctx.resume = True
+    if args.time_budget is not None:
+        res_ctx.time_budget = args.time_budget
+    if args.budget_grace is not None:
+        res_ctx.budget_grace = args.budget_grace
+
     t0 = time.perf_counter()
-    partition = solver.compute_partition(
-        k=args.k, epsilon=args.epsilon, seed=args.seed
-    )
+    try:
+        partition = solver.compute_partition(
+            k=args.k, epsilon=args.epsilon, seed=args.seed
+        )
+    except KeyboardInterrupt:
+        from .cli import _emergency_interrupt_exit
+
+        return _emergency_interrupt_exit(args, t0)
     wall = time.perf_counter() - t0
 
     if not args.quiet:

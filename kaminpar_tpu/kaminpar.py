@@ -45,6 +45,9 @@ class KaMinPar:
         self.ctx = ctx
         self._graph: Optional[HostGraph] = None
         self.output_level = OutputLevel.APPLICATION
+        # set by compute_partition when a run wound down early under a
+        # deadline/preemption (resilience/deadline.py); None otherwise
+        self.last_anytime: Optional[dict] = None
 
     # -- graph ingestion (KaMinPar::borrow_and_mutate_graph / copy_graph) --
     def set_graph(self, graph, validate: bool = False) -> "KaMinPar":
@@ -152,7 +155,8 @@ class KaMinPar:
         # telemetry shares the timer's nesting caveat: when this run is
         # embedded in another pipeline (shm IP inside the dist driver),
         # the outer run owns the stream and its annotations
-        if timer.GLOBAL_TIMER.idle():
+        owns_stream = timer.GLOBAL_TIMER.idle()
+        if owns_stream:
             telemetry.reset()
             telemetry.annotate(
                 preset=ctx.preset_name,
@@ -164,6 +168,39 @@ class KaMinPar:
             )
         from .partitioning import debug
         from .utils.logger import output_level as global_output_level
+
+        # preemption safety: the run that OWNS the stream (same idle-timer
+        # guard as the telemetry annotations) may arm a deadline budget
+        # and a checkpoint manager; nested IP runs inside the dist driver
+        # never do — a checkpoint must not record an inner pipeline's
+        # stage as the outer run's.
+        from .resilience import checkpoint as ckpt_mod
+        from .resilience import deadline as deadline_mod
+
+        mgr = None
+        res_ctx = ctx.resilience
+        self.last_anytime = None  # stale verdicts must not survive a rerun
+        if owns_stream:
+            # self-heal leftover state from an exceptional unwind of a
+            # previous run in this process (a stale manager or deadline
+            # must not govern this run), arm the configured budget while
+            # PRESERVING a preemption signal that arrived before the run
+            # (deadline.begin_run), and build/validate the checkpoint
+            # manager (create_manager: mismatch/corruption degrade to a
+            # logged clean restart)
+            ckpt_mod.deactivate()
+            deadline_mod.begin_run(
+                res_ctx.time_budget or None, res_ctx.budget_grace
+            )
+            mgr = ckpt_mod.create_manager(res_ctx, self._graph, ctx)
+            if mgr is not None:
+                ckpt_mod.activate(mgr)
+        if not owns_stream:
+            # nested run (shm IP inside the dist driver): blind the
+            # barrier hook for the duration — inner drivers must neither
+            # rewrite the outer run's manifest with their own stage nor
+            # consume its resume state (unsuspended in the finally below)
+            ckpt_mod.suspend()
 
         debug.dump_toplevel_graph(ctx, graph)
         # the logger is process-global; apply this instance's level only
@@ -179,7 +216,17 @@ class KaMinPar:
                 # isolated-node preprocessing (kaminpar.cc:392-404)
                 num_isolated = count_isolated_nodes(graph)
                 still_compressed = isinstance(graph, CompressedHostGraph)
+                resumed_result = (
+                    mgr.take_result_resume() if mgr is not None else None
+                )
                 if (
+                    resumed_result is not None
+                    and resumed_result.shape == (graph.n,)
+                ):
+                    # a run preempted AFTER its output gate left a final
+                    # `result` snapshot: nothing to recompute
+                    partition = resumed_result
+                elif (
                     num_isolated
                     and graph.n > num_isolated
                     and still_compressed
@@ -222,6 +269,8 @@ class KaMinPar:
                     partition = self._partition_core_resilient(graph, ctx)
         finally:
             set_output_level(prior_level)
+            if not owns_stream:
+                ckpt_mod.unsuspend()
 
         # strict-balance output gate (resilience/gate.py): validate the
         # partition invariants host-side and repair balance violations,
@@ -233,11 +282,42 @@ class KaMinPar:
         from .resilience import gate as output_gate
 
         if output_gate.gate_enabled() and ctx.resilience.output_gate:
-            owns_stream = timer.GLOBAL_TIMER.idle()
             with timer.scoped_timer("output-gate"):
                 partition = output_gate.apply(
                     self, graph, partition, ctx, annotate=owns_stream
                 )
+
+        # final barrier: a `result` snapshot AFTER the gate, so a
+        # preemption between here and the caller resumes instantly; then
+        # stamp the anytime/checkpoint sections into the run report and
+        # release the run-scoped preemption state
+        if owns_stream:
+            if mgr is not None and mgr.enabled:
+                final_part = partition
+                ckpt_mod.barrier(
+                    "result", scheme="facade",
+                    payload=lambda: {"state": {
+                        "partition": np.asarray(final_part, dtype=np.int32)
+                    }},
+                )
+            if deadline_mod.triggered():
+                self.last_anytime = deadline_mod.state()
+                telemetry.annotate(anytime=self.last_anytime)
+                from .utils.logger import log_warning
+
+                # .get(): a driverless path (e.g. the all-isolated-nodes
+                # branch) crosses no barrier, so stage/reason may be absent
+                log_warning(
+                    "ANYTIME result: wound down at stage "
+                    f"'{self.last_anytime.get('stage') or 'start'}' "
+                    f"({self.last_anytime.get('reason')}); partition is "
+                    "gate-validated but lower-effort"
+                )
+            else:
+                self.last_anytime = None
+            if mgr is not None:
+                telemetry.annotate(checkpoint=mgr.summary())
+            ckpt_mod.deactivate()
 
         debug.dump_toplevel_partition(ctx, partition)
         from .utils.assertions import AssertionLevel, kassert

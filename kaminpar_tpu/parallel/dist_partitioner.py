@@ -159,7 +159,8 @@ class dKaMinPar:
         from .. import telemetry
         from ..utils.logger import output_level, set_output_level
 
-        if timer.GLOBAL_TIMER.idle():
+        owns_stream = timer.GLOBAL_TIMER.idle()
+        if owns_stream:
             from .mesh import reset_comm_log
 
             # per-run observability: without these resets, a second
@@ -179,13 +180,45 @@ class dKaMinPar:
                 devices=int(self.mesh.devices.size),
                 graph={"n": int(graph.n), "m": int(graph.m)},
             )
+
+        # preemption safety (kaminpar.py twin): the stream-owning run may
+        # arm a deadline and a checkpoint manager; stage ids below are
+        # derived from loop indices every rank computes identically
+        # (barrier-consistent), and the manager lets only rank 0 write.
+        from ..resilience import checkpoint as ckpt_mod
+        from ..resilience import deadline as deadline_mod
+
+        mgr = None
+        res_ctx = self.ctx.shm.resilience
+        if owns_stream:
+            # same arm-and-maybe-resume policy as the shm facade
+            # (checkpoint.create_manager / deadline.begin_run keep the
+            # two from drifting apart)
+            ckpt_mod.deactivate()
+            deadline_mod.begin_run(
+                res_ctx.time_budget or None, res_ctx.budget_grace
+            )
+            mgr = ckpt_mod.create_manager(res_ctx, graph, self.ctx)
+            if mgr is not None:
+                ckpt_mod.activate(mgr)
+
         prior_level = output_level()
         try:
             set_output_level(
                 getattr(self, "_output_level", prior_level)
             )
             with timer.scoped_timer("dist-partitioning"):
-                partition = self._partition(graph, k)
+                # a run preempted after its final barrier resumes
+                # instantly from the `result` snapshot; mid-pipeline dist
+                # stages are recorded for the audit trail but re-enter at
+                # the start (docs/robustness.md documents the limit)
+                resumed = (
+                    mgr.take_result_resume() if mgr is not None else None
+                )
+                if resumed is not None and resumed.shape == (graph.n,):
+                    partition = resumed
+                else:
+                    partition = self._partition(graph, k)
 
             if self._is_compressed(graph) and self._fine_dg is not None:
                 # still-compressed input: cut from the finest-level
@@ -230,7 +263,7 @@ class dKaMinPar:
                     (res["block_weights"] <= ctx.partition.max_block_weights)
                     .all()
                 )
-            if timer.GLOBAL_TIMER.idle():  # nested runs don't own the stream
+            if owns_stream:  # nested runs don't own the stream
                 telemetry.annotate(
                     result={
                         "cut": int(cut),
@@ -238,6 +271,22 @@ class dKaMinPar:
                         "feasible": feasible,
                     }
                 )
+            if owns_stream:
+                if mgr is not None and mgr.enabled:
+                    final_part = partition
+                    ckpt_mod.barrier(
+                        "result", scheme="dist-facade",
+                        payload=lambda: {"state": {
+                            "partition": np.asarray(
+                                final_part, dtype=np.int32
+                            ),
+                        }},
+                    )
+                if deadline_mod.triggered():
+                    telemetry.annotate(anytime=deadline_mod.state())
+                if mgr is not None:
+                    telemetry.annotate(checkpoint=mgr.summary())
+                ckpt_mod.deactivate()
             log(
                 f"RESULT cut={cut} imbalance={imbalance:.6f} "
                 f"k={k} devices={self.mesh.devices.size}"
@@ -305,6 +354,13 @@ class dKaMinPar:
                 coarse, cmap = contracted
                 levels.append((dg, cmap, current))
                 current = coarse
+                from ..resilience import checkpoint as ckpt
+
+                if not ckpt.barrier(
+                    "dist-coarsen", level=len(levels), scheme="dist",
+                    agree=True,  # next level clusters collectively
+                ):
+                    break  # deadline wind-down: stop deepening
 
         # mesh-subgroup replication (deep_multilevel.cc:79-153 +
         # replicator.cc analog): the graph is too small for the whole
@@ -362,6 +418,9 @@ class dKaMinPar:
                     cut = self._host_cut(self._plain(current), cand)
                     if best_cut is None or cut < best_cut:
                         partition, best_cut = cand, cut
+        from ..resilience import checkpoint as ckpt
+
+        ckpt.barrier("dist-initial", level=len(levels), scheme="dist")
 
         # uncoarsening + distributed refinement (deep_multilevel.cc:181+):
         # project up, refine at the current k, and in DEEP mode extend the
@@ -394,6 +453,12 @@ class dKaMinPar:
                             refiner, dg, fine_host, partition, current_k,
                             spans, seed ^ (0x9E37 + current_k), level,
                         )
+                part_now, k_now = partition, current_k
+                ckpt.barrier(
+                    "dist-uncoarsen", level=level, scheme="dist",
+                    payload=lambda: _ckpt_partition_payload(part_now),
+                    meta={"current_k": int(k_now)},
+                )
         # final extensions to k (finest level)
         if deep and (levels or replicated) and current_k < k:
             if levels:
@@ -726,6 +791,15 @@ class dKaMinPar:
         level,
     ) -> np.ndarray:
         from .mesh import comm_phase
+        from ..resilience import deadline as deadline_mod
+
+        if deadline_mod.agreed_stop():
+            # anytime wind-down: skip the optional collective refinement
+            # round — by the AGREED verdict, so every rank skips or none
+            # does (a divergent skip would deadlock the collectives);
+            # projection/extension (mandatory for a valid k-way result)
+            # still run in the caller
+            return partition
 
         full = np.zeros(dg.n_pad, dtype=np.int32)
         full[: fine_host.n] = partition
@@ -803,3 +877,9 @@ class dKaMinPar:
 def dist_edge_cut_of(graph: DistGraph, labels) -> int:
     """Convenience wrapper mirroring dist::metrics::edge_cut."""
     return int(dist_edge_cut(graph, labels))
+
+
+def _ckpt_partition_payload(partition) -> dict:
+    """Checkpoint barrier payload: the current (already host-side)
+    partition — deferred by the barrier, so disabled runs build nothing."""
+    return {"state": {"partition": np.asarray(partition, dtype=np.int32)}}

@@ -235,3 +235,77 @@ class Coarsener:
         self.current = level.fine_graph
         self.current_n = level.fine_n
         return level.fine_graph, fine_part
+
+
+# ---------------------------------------------------------------------------
+# hierarchy checkpointing (resilience/checkpoint.py): one coarsening level
+# serialized as its coarse host CSR + projection map, and the inverse —
+# shared by the deep and kway drivers
+# ---------------------------------------------------------------------------
+
+
+def newest_level_snapshot(coarsener: Coarsener) -> dict:
+    """Serialize the just-contracted level: the coarse graph's host CSR
+    plus the fine->coarse projection map — everything a resume needs to
+    rebuild this hierarchy step without re-clustering/re-contracting.
+    Pulls the level off device; call only with checkpointing enabled."""
+    from ..graphs.csr import host_graph_from_device
+
+    lvl = coarsener.levels[-1]
+    hg = host_graph_from_device(lvl.coarse.graph)
+    return {
+        "xadj": hg.xadj,
+        "adjncy": hg.adjncy,
+        "node_w": hg.node_weight_array(),
+        "edge_w": hg.edge_weight_array(),
+        "cmap": np.asarray(lvl.coarse.cmap),
+        "dims": np.asarray(
+            [lvl.fine_n, lvl.coarse_n, lvl.coarse_m], dtype=np.int64
+        ),
+    }
+
+
+def restore_levels(coarsener: Coarsener, dgraph: DeviceGraph, arrays: dict) -> int:
+    """Rebuild the coarsener hierarchy from `level-<i>` snapshots:
+    re-upload each saved coarse CSR and reattach the projection maps.
+    The pad policy is deterministic (graphs/csr.pad_size), so rebuilt
+    device graphs land in the same shape buckets as the originals and
+    saved cmaps/partitions line up slot-for-slot.  Returns the number of
+    levels restored."""
+    from ..graphs.csr import device_graph_from_host
+    from ..graphs.host import HostGraph
+    from ..ops.contraction import CoarseGraph
+
+    level_names = sorted(
+        (nm for nm in arrays if nm.startswith("level-")),
+        key=lambda s: int(s.split("-", 1)[1]),
+    )
+    graphs = [dgraph]
+    for nm in level_names:
+        a = arrays[nm]
+        fine_n, coarse_n, coarse_m = (int(x) for x in a["dims"])
+        hg = HostGraph(
+            xadj=a["xadj"],
+            adjncy=a["adjncy"],
+            node_weights=a["node_w"],
+            edge_weights=a["edge_w"] if a["edge_w"].size else None,
+        )
+        dg = device_graph_from_host(hg)
+        coarse = CoarseGraph(
+            graph=dg,
+            cmap=jnp.asarray(np.asarray(a["cmap"], dtype=np.int32)),
+        )
+        coarsener.levels.append(
+            CoarseningLevel(
+                fine_graph=graphs[-1],
+                coarse=coarse,
+                fine_n=fine_n,
+                coarse_n=coarse_n,
+                coarse_m=coarse_m,
+            )
+        )
+        graphs.append(dg)
+    if coarsener.levels:
+        coarsener.current = graphs[-1]
+        coarsener.current_n = coarsener.levels[-1].coarse_n
+    return len(level_names)

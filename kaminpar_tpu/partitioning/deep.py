@@ -75,6 +75,7 @@ class DeepMultilevelPartitioner:
         rng = rng_mod.host_rng(ctx.seed ^ 0xDEE9)
 
         from . import debug
+        from ..resilience import checkpoint as ckpt
 
         with timer.scoped_timer("device-upload"):
             from ..graphs.compressed import CompressedHostGraph
@@ -98,62 +99,111 @@ class DeepMultilevelPartitioner:
         threshold = max(2 * ctx.coarsening.contraction_limit, 2)
         from ..utils.heap_profiler import sample_device_memory
 
-        with timer.scoped_timer("coarsening"):
-            while coarsener.current_n > threshold:
-                if not coarsener.coarsen():
-                    break
-                sample_device_memory()  # per-level live-HBM peak
-                log_progress(
-                    f"deep coarsening level {coarsener.level}: "
-                    f"n={coarsener.current_n}"
-                )
-                if ctx.debug.dump_graph_hierarchy:
-                    debug.dump_graph_hierarchy(
-                        ctx,
-                        host_graph_from_device(coarsener.current),
-                        coarsener.level,
-                    )
+        # --- checkpoint resume: rebuild the recorded hierarchy/state and
+        # re-enter at the recorded stage (no completed level re-runs) ---
+        resume = ckpt.take_resume("deep")
+        stage = None
+        partition = None
+        spans: List[_BlockSpan] = []
+        current_k = 0
+        num_levels = None
+        if resume is not None:
+            stage, partition, spans, current_k, num_levels, rng = (
+                self._restore_from_checkpoint(resume, coarsener, dgraph, rng)
+            )
 
-        # --- initial bipartition of the coarsest graph (:185) ---
-        with timer.scoped_timer("initial-partitioning"):
-            coarsest_host = host_graph_from_device(coarsener.current)
-            debug.dump_coarsest_graph(ctx, coarsest_host)
-            k0, k1 = split_k(input_k)
-            spans = [_BlockSpan(0, k0), _BlockSpan(k0, k1)] if input_k > 1 else [
-                _BlockSpan(0, 1)
-            ]
-            if input_k == 1:
-                part_host = np.zeros(coarsest_host.n, dtype=np.int32)
-            else:
-                max_w = bipartition_max_block_weights(
-                    ctx, 0, input_k, coarsest_host.total_node_weight
+        if stage is None or stage == "coarsen":
+            with timer.scoped_timer("coarsening"):
+                while coarsener.current_n > threshold:
+                    if not coarsener.coarsen():
+                        break
+                    sample_device_memory()  # per-level live-HBM peak
+                    log_progress(
+                        f"deep coarsening level {coarsener.level}: "
+                        f"n={coarsener.current_n}"
+                    )
+                    if ctx.debug.dump_graph_hierarchy:
+                        debug.dump_graph_hierarchy(
+                            ctx,
+                            host_graph_from_device(coarsener.current),
+                            coarsener.level,
+                        )
+                    if not ckpt.barrier(
+                        "coarsen", level=coarsener.level, scheme="deep",
+                        payload=lambda: self._ckpt_level_payload(coarsener),
+                        keep=[
+                            f"level-{j}" for j in range(coarsener.level - 1)
+                        ],
+                        meta=self._ckpt_meta(current_k, num_levels, rng),
+                    ):
+                        # deadline wind-down: stop deepening the
+                        # hierarchy; IP + projection below stay mandatory
+                        break
+
+        if stage in (None, "coarsen"):
+            # --- initial bipartition of the coarsest graph (:185) ---
+            with timer.scoped_timer("initial-partitioning"):
+                coarsest_host = host_graph_from_device(coarsener.current)
+                debug.dump_coarsest_graph(ctx, coarsest_host)
+                k0, k1 = split_k(input_k)
+                spans = (
+                    [_BlockSpan(0, k0), _BlockSpan(k0, k1)]
+                    if input_k > 1
+                    else [_BlockSpan(0, 1)]
                 )
-                part_host = (
-                    InitialMultilevelBipartitioner(ctx.initial_partitioning)
-                    .bipartition(coarsest_host, max_w, rng)
-                    .astype(np.int32)
-                )
-            current_k = len(spans)
-            self._spans = spans
-            debug.dump_coarsest_partition(ctx, part_host)
-            padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
-            padded[: coarsest_host.n] = part_host
-            partition = jnp.asarray(padded)
+                if input_k == 1:
+                    part_host = np.zeros(coarsest_host.n, dtype=np.int32)
+                else:
+                    max_w = bipartition_max_block_weights(
+                        ctx, 0, input_k, coarsest_host.total_node_weight
+                    )
+                    part_host = (
+                        InitialMultilevelBipartitioner(
+                            ctx.initial_partitioning
+                        )
+                        .bipartition(coarsest_host, max_w, rng)
+                        .astype(np.int32)
+                    )
+                current_k = len(spans)
+                self._spans = spans
+                debug.dump_coarsest_partition(ctx, part_host)
+                padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
+                padded[: coarsest_host.n] = part_host
+                partition = jnp.asarray(padded)
+            num_levels = coarsener.level + 1
+            ckpt.barrier(
+                "initial", level=coarsener.level, scheme="deep",
+                payload=lambda: self._ckpt_state_payload(
+                    partition, coarsener.current_n, spans
+                ),
+                keep=[f"level-{j}" for j in range(coarsener.level)],
+                meta=self._ckpt_meta(current_k, num_levels, rng),
+            )
 
         # --- uncoarsen: refine / extend / repeat (:275-365) ---
-        num_levels = coarsener.level + 1
+        if num_levels is None:
+            num_levels = coarsener.level + 1
         with timer.scoped_timer("uncoarsening"):
             level = coarsener.level
-            partition, spans, current_k = self._extend_and_refine(
-                coarsener.current,
-                coarsener.current_n,
-                partition,
-                spans,
-                current_k,
-                rng,
-                level,
-                num_levels,
-            )
+            if stage != "uncoarsen":
+                partition, spans, current_k = self._extend_and_refine(
+                    coarsener.current,
+                    coarsener.current_n,
+                    partition,
+                    spans,
+                    current_k,
+                    rng,
+                    level,
+                    num_levels,
+                )
+                ckpt.barrier(
+                    "uncoarsen", level=level, scheme="deep",
+                    payload=lambda: self._ckpt_state_payload(
+                        partition, coarsener.current_n, spans
+                    ),
+                    keep=[f"level-{j}" for j in range(level)],
+                    meta=self._ckpt_meta(current_k, num_levels, rng),
+                )
             while not coarsener.empty():
                 fine_graph, partition = coarsener.uncoarsen(partition)
                 sample_device_memory()  # per-level live-HBM peak
@@ -174,6 +224,16 @@ class DeepMultilevelPartitioner:
                         np.asarray(partition)[: coarsener.current_n],
                         level,
                     )
+                part_now = partition
+                spans_now = spans
+                ckpt.barrier(
+                    "uncoarsen", level=level, scheme="deep",
+                    payload=lambda: self._ckpt_state_payload(
+                        part_now, coarsener.current_n, spans_now
+                    ),
+                    keep=[f"level-{j}" for j in range(level)],
+                    meta=self._ckpt_meta(current_k, num_levels, rng),
+                )
 
         # final extensions to input_k if not there yet
         while current_k < input_k:
@@ -190,6 +250,81 @@ class DeepMultilevelPartitioner:
             np.asarray(self.ctx.partition.max_block_weights), where="deep",
         )
         return np.asarray(partition)[: graph.n]
+
+    # -- checkpoint payloads / restore (resilience/checkpoint.py) -------
+
+    def _ckpt_level_payload(self, coarsener: Coarsener) -> dict:
+        """The just-contracted level as a named snapshot (the barrier
+        defers this payload, so it costs nothing with checkpointing
+        disabled)."""
+        from .coarsener import newest_level_snapshot
+
+        return {f"level-{coarsener.level - 1}": newest_level_snapshot(coarsener)}
+
+    def _ckpt_state_payload(self, partition, n: int, spans) -> dict:
+        return {
+            "state": {
+                "partition": np.asarray(partition)[:n].astype(np.int32),
+                "spans": np.asarray(
+                    [[s.first, s.count] for s in spans], dtype=np.int64
+                ),
+            }
+        }
+
+    def _ckpt_meta(self, current_k, num_levels, rng) -> dict:
+        return {
+            "current_k": int(current_k),
+            "num_levels": None if num_levels is None else int(num_levels),
+            "rng_state": rng.bit_generator.state,
+        }
+
+    def _restore_from_checkpoint(self, resume, coarsener, dgraph, rng):
+        """Rebuild the coarsener hierarchy (coarsener.restore_levels) and
+        the driver state recorded at the checkpointed barrier: partition,
+        block spans, current_k, and the host RNG stream."""
+        from .coarsener import restore_levels
+
+        arrays = resume["arrays"]
+        meta = resume.get("meta", {})
+        stage = resume["stage"]
+        num_restored = restore_levels(coarsener, dgraph, arrays)
+
+        partition = None
+        spans: List[_BlockSpan] = []
+        current_k = 0
+        if "state" in arrays:
+            st = arrays["state"]
+            part_host = np.asarray(st["partition"], dtype=np.int32)
+            padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
+            padded[: part_host.shape[0]] = part_host
+            partition = jnp.asarray(padded)
+            spans = [
+                _BlockSpan(int(f), int(c))
+                for f, c in np.asarray(st["spans"]).tolist()
+            ]
+            current_k = int(meta.get("current_k", len(spans)))
+            self._spans = spans
+        if meta.get("rng_state"):
+            rng = np.random.default_rng(0)
+            rng.bit_generator.state = meta["rng_state"]
+        from .. import telemetry
+
+        telemetry.event(
+            "resume",
+            scheme="deep",
+            stage=stage,
+            level=resume.get("level"),
+            levels_restored=num_restored,
+        )
+        log_progress(
+            f"resumed deep pipeline at {stage}"
+            f"{'' if resume.get('level') is None else ':' + str(resume['level'])}"
+            f" ({num_restored} hierarchy level(s) restored)"
+        )
+        return (
+            stage, partition, spans, current_k,
+            meta.get("num_levels"), rng,
+        )
 
     # ------------------------------------------------------------------
     def _extend_and_refine(

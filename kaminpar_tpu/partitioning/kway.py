@@ -33,10 +33,20 @@ class KWayMultilevelPartitioner:
     def __init__(self, ctx: Context):
         self.ctx = ctx
 
+    @staticmethod
+    def _ckpt_state_payload(partition, n: int) -> dict:
+        """Checkpoint barrier payload: the current partition pulled to
+        host (deliberate, checkpoint-only transfer — the barrier defers
+        this call, so disabled runs pull nothing)."""
+        return {"state": {
+            "partition": np.asarray(partition)[:n].astype(np.int32),
+        }}
+
     def partition(self, graph: HostGraph) -> np.ndarray:
         ctx = self.ctx
         k = ctx.partition.k
         rng = rng_mod.host_rng(ctx.seed)
+        from ..resilience import checkpoint as ckpt
 
         with timer.scoped_timer("device-upload"):
             dgraph = device_graph_from_host(graph)
@@ -59,53 +69,113 @@ class KWayMultilevelPartitioner:
 
         coarsener = Coarsener(ctx, dgraph, graph.n)
         threshold = max(k * ctx.coarsening.contraction_limit, 1)
-        with timer.scoped_timer("coarsening"):
-            while coarsener.current_n > threshold:
-                if not coarsener.coarsen():
-                    break
-                log_progress(
-                    f"coarsening level {coarsener.level}: "
-                    f"n={coarsener.current_n}"
-                )
-                if ctx.debug.dump_graph_hierarchy:
-                    debug.dump_graph_hierarchy(
-                        ctx,
-                        host_graph_from_device(coarsener.current),
-                        coarsener.level,
-                    )
 
-        # --- initial partitioning on host (rb to k) ---
-        with timer.scoped_timer("initial-partitioning"):
+        # checkpoint resume (resilience/checkpoint.py): rebuild the
+        # recorded hierarchy/partition and skip completed stages
+        from .coarsener import newest_level_snapshot, restore_levels
+
+        resume = ckpt.take_resume("kway")
+        stage = None
+        partition = None
+        num_levels = None
+        if resume is not None:
+            stage = resume["stage"]
+            meta = resume.get("meta", {})
+            restored = restore_levels(coarsener, dgraph, resume["arrays"])
+            num_levels = meta.get("num_levels")
+            st = resume["arrays"].get("state")
+            if st is not None:
+                padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
+                part_host = np.asarray(st["partition"], dtype=np.int32)
+                padded[: part_host.shape[0]] = part_host
+                partition = jnp.asarray(padded)
             from .. import telemetry
 
             telemetry.event(
-                "initial-partitioning",
-                n=int(coarsener.current_n),
-                k=int(k),
-                levels=int(coarsener.level),
+                "resume", scheme="kway", stage=stage,
+                level=resume.get("level"), levels_restored=restored,
             )
-            coarsest_host = host_graph_from_device(coarsener.current)
-            debug.dump_coarsest_graph(ctx, coarsest_host)
-            init_part = recursive_bipartition(coarsest_host, k, ctx, rng)
-            debug.dump_coarsest_partition(ctx, init_part)
-            part_padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
-            part_padded[: coarsest_host.n] = init_part
-            partition = jnp.asarray(part_padded)
+
+        if stage is None or stage == "coarsen":
+            with timer.scoped_timer("coarsening"):
+                while coarsener.current_n > threshold:
+                    if not coarsener.coarsen():
+                        break
+                    log_progress(
+                        f"coarsening level {coarsener.level}: "
+                        f"n={coarsener.current_n}"
+                    )
+                    if ctx.debug.dump_graph_hierarchy:
+                        debug.dump_graph_hierarchy(
+                            ctx,
+                            host_graph_from_device(coarsener.current),
+                            coarsener.level,
+                        )
+                    if not ckpt.barrier(
+                        "coarsen", level=coarsener.level, scheme="kway",
+                        payload=lambda: {
+                            f"level-{coarsener.level - 1}":
+                                newest_level_snapshot(coarsener)
+                        },
+                        keep=[
+                            f"level-{j}" for j in range(coarsener.level - 1)
+                        ],
+                    ):
+                        break  # deadline wind-down
+
+        if stage in (None, "coarsen"):
+            # --- initial partitioning on host (rb to k) ---
+            with timer.scoped_timer("initial-partitioning"):
+                from .. import telemetry
+
+                telemetry.event(
+                    "initial-partitioning",
+                    n=int(coarsener.current_n),
+                    k=int(k),
+                    levels=int(coarsener.level),
+                )
+                coarsest_host = host_graph_from_device(coarsener.current)
+                debug.dump_coarsest_graph(ctx, coarsest_host)
+                init_part = recursive_bipartition(coarsest_host, k, ctx, rng)
+                debug.dump_coarsest_partition(ctx, init_part)
+                part_padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
+                part_padded[: coarsest_host.n] = init_part
+                partition = jnp.asarray(part_padded)
+            num_levels = coarsener.level + 1
+            ckpt.barrier(
+                "initial", level=coarsener.level, scheme="kway",
+                payload=lambda: self._ckpt_state_payload(
+                    partition, coarsener.current_n
+                ),
+                keep=[f"level-{j}" for j in range(coarsener.level)],
+                meta={"num_levels": num_levels},
+            )
 
         # --- uncoarsening + refinement (kway_multilevel.cc:70-89) ---
         refiner = RefinerPipeline(ctx, k)
-        num_levels = coarsener.level + 1
+        if num_levels is None:
+            num_levels = coarsener.level + 1
         with timer.scoped_timer("uncoarsening"):
             level = coarsener.level
-            partition = refiner.refine(
-                coarsener.current,
-                partition,
-                max_bw,
-                min_bw,
-                seed=ctx.seed,
-                level=level,
-                num_levels=num_levels,
-            )
+            if stage != "uncoarsen":
+                partition = refiner.refine(
+                    coarsener.current,
+                    partition,
+                    max_bw,
+                    min_bw,
+                    seed=ctx.seed,
+                    level=level,
+                    num_levels=num_levels,
+                )
+                part_now = partition
+                ckpt.barrier(
+                    "uncoarsen", level=level, scheme="kway",
+                    payload=lambda: self._ckpt_state_payload(
+                        part_now, coarsener.current_n
+                    ),
+                    keep=[f"level-{j}" for j in range(level)],
+                    meta={"num_levels": num_levels},
+                )
             while not coarsener.empty():
                 fine_graph, partition = coarsener.uncoarsen(partition)
                 level -= 1
@@ -124,6 +194,15 @@ class KWayMultilevelPartitioner:
                         np.asarray(partition)[: coarsener.current_n],
                         level,
                     )
+                part_now = partition
+                ckpt.barrier(
+                    "uncoarsen", level=level, scheme="kway",
+                    payload=lambda: self._ckpt_state_payload(
+                        part_now, coarsener.current_n
+                    ),
+                    keep=[f"level-{j}" for j in range(level)],
+                    meta={"num_levels": num_levels},
+                )
 
         # strict balance backstop on the finest level
         partition = refiner.enforce_balance_host(

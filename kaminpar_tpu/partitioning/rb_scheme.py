@@ -32,7 +32,15 @@ class RBMultilevelPartitioner:
         with timer.scoped_timer("recursive-bipartitioning"):
             part = recursive_bipartition(graph, k, ctx, rng)
 
-        if ctx.partitioning.rb_enable_kway_toplevel_refinement:
+        # barrier (resilience/checkpoint.py): rb has no multilevel
+        # hierarchy to snapshot — the bisection tree IS the work — but
+        # the deadline/preemption wind-down still gates the optional
+        # top-level polish (the facade's result checkpoint covers
+        # durability)
+        from ..resilience import checkpoint as ckpt
+
+        proceed = ckpt.barrier("rb-toplevel", scheme="rb")
+        if proceed and ctx.partitioning.rb_enable_kway_toplevel_refinement:
             with timer.scoped_timer("toplevel-refinement"):
                 dgraph = device_graph_from_host(graph)
                 padded = np.zeros(dgraph.n_pad, dtype=np.int32)

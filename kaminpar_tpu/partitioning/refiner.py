@@ -72,10 +72,22 @@ class RefinerPipeline:
         self, graph, partition, k, max_block_weights, min_block_weights,
         seed, level, num_levels,
     ):
+        from ..resilience import deadline as deadline_mod
         from ..resilience import with_fallback
         from ..utils import statistics
 
         for i, algorithm in enumerate(self.ctx.refinement.algorithms):
+            # anytime wind-down (resilience/deadline.py): once the budget
+            # expires or a preemption signal arrived, stop STARTING
+            # refiner steps — the drivers' enforce_balance_host and the
+            # output gate keep the balance guarantee on the best
+            # partition reached so far
+            if deadline_mod.should_stop():
+                log_debug(
+                    f"deadline: skipping {algorithm.value} at level "
+                    f"{level} (wind-down)"
+                )
+                break
             salt = jnp.int32((seed * 2654435761 + i * 40503 + level) & 0x7FFFFFFF)
             if algorithm == RefinementAlgorithm.NOOP:
                 continue

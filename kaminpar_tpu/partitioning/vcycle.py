@@ -34,20 +34,48 @@ class VcycleDeepMultilevelPartitioner:
     def partition(self, graph: HostGraph) -> np.ndarray:
         ctx = self.ctx
         k = ctx.partition.k
+        from ..resilience import checkpoint as ckpt
 
-        # initial partition via one full deep multilevel run
-        deep_ctx = ctx.copy()
-        from ..context import PartitioningMode
+        # checkpoint resume (resilience/checkpoint.py): a `vcycle` stage
+        # holds the partition after a completed cycle — skip the initial
+        # deep run and every finished cycle.  A kill DURING the initial
+        # deep run instead left a `deep`-scheme checkpoint, which the
+        # embedded deep driver below resumes on its own.
+        resume = ckpt.take_resume("vcycle")
+        start_cycle = 0
+        part = None
+        if resume is not None and "state" in resume["arrays"]:
+            part = np.asarray(
+                resume["arrays"]["state"]["partition"], dtype=np.int32
+            )
+            start_cycle = int(resume.get("level") or 0) + 1
+            from .. import telemetry
 
-        deep_ctx.partitioning.mode = PartitioningMode.DEEP
-        deep_ctx.partition = ctx.partition  # share the configured weights
-        part = DeepMultilevelPartitioner(deep_ctx).partition(graph)
+            telemetry.event(
+                "resume", scheme="vcycle", stage=resume["stage"],
+                level=resume.get("level"),
+            )
+
+        if part is None:
+            # initial partition via one full deep multilevel run
+            deep_ctx = ctx.copy()
+            from ..context import PartitioningMode
+
+            deep_ctx.partitioning.mode = PartitioningMode.DEEP
+            deep_ctx.partition = ctx.partition  # share the configured weights
+            part = DeepMultilevelPartitioner(deep_ctx).partition(graph)
 
         from .. import telemetry
         from ..graphs.host import host_partition_metrics
 
         num_cycles = max(len(ctx.partitioning.vcycles), 1)
-        for cycle in range(num_cycles):
+        for cycle in range(start_cycle, num_cycles):
+            from ..resilience import deadline as deadline_mod
+
+            if deadline_mod.should_stop():
+                # anytime wind-down: cycles only improve an already-valid
+                # partition — stop starting new ones
+                break
             with timer.scoped_timer(f"vcycle-{cycle}"):
                 part = self._one_vcycle(graph, part, cycle)
             # cut per cycle only for plain CSR inputs (compressed graphs
@@ -59,6 +87,13 @@ class VcycleDeepMultilevelPartitioner:
                     cycle=cycle,
                     cut=int(host_partition_metrics(graph, part, k)["cut"]),
                 )
+            part_now = part
+            ckpt.barrier(
+                "vcycle", level=cycle, scheme="vcycle",
+                payload=lambda: {"state": {
+                    "partition": np.asarray(part_now, dtype=np.int32),
+                }},
+            )
         return part
 
     def _one_vcycle(

@@ -14,10 +14,21 @@ Three pieces (see docs/robustness.md for the operator view):
   * the **strict-balance output gate** — end-of-pipeline host validation
     of partition invariants with a greedy repair pass (gate.py), so
     ``KaMinPar.compute_partition``'s postcondition holds no matter which
-    paths degraded.
+    paths degraded;
+  * **preemption-safe checkpoint/resume** — atomic barrier snapshots of
+    the multilevel state under ``--checkpoint-dir`` with a versioned,
+    checksummed manifest, and ``--resume`` re-entry at the recorded
+    stage (checkpoint.py);
+  * the **deadline budget / anytime contract** — ``--time-budget`` plus
+    SIGTERM/SIGINT routing: cooperative wind-down at the same barriers,
+    returning a gate-valid partition annotated ``anytime: true`` instead
+    of a stack trace (deadline.py).
 """
 
 from .errors import (  # noqa: F401
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    CheckpointWriteFailed,
     CollectiveTimeout,
     DegradationError,
     DeviceOOM,
@@ -43,11 +54,16 @@ from .policy import (  # noqa: F401
     with_fallback,
 )
 from . import gate  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import deadline  # noqa: F401
 
 
 def reset() -> None:
-    """Reset injection counters and circuit breakers (test isolation)."""
+    """Reset injection counters, circuit breakers, the active checkpoint
+    manager, and any armed deadline (test isolation)."""
     from . import faults as _faults
 
     _faults.reset()
     reset_breakers()
+    checkpoint.deactivate()
+    deadline.clear()

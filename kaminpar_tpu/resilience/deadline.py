@@ -1,0 +1,246 @@
+"""Deadline budget + cooperative preemption (the anytime contract).
+
+``--time-budget SECS`` installs a *monotonic* deadline that the pipeline
+checks cooperatively at its natural barriers (coarsening levels, initial
+partitioning, per-level uncoarsening — the same barriers the checkpoint
+manager uses) and between refiner algorithm steps.  Nothing is ever
+interrupted mid-kernel: on expiry the drivers stop *starting* new
+optional work (further coarsening, refinement passes, v-cycles), finish
+the mandatory work that validity requires (projection, partition
+extension to k, balance enforcement, the output gate/repair), and the
+facade annotates the result ``anytime: true`` with the deepest stage
+reached.
+
+SIGTERM/SIGINT route through the same path: the CLI installs handlers
+that *request a stop* instead of raising, so a preemption notice yields
+a valid (possibly lower-quality) partition plus a final checkpoint
+instead of a stack trace.  A second signal of the same kind restores the
+default behavior (a determined Ctrl-C still kills the process; the CLI
+then unwinds open timer scopes and writes an emergency report — see
+cli.py / utils/timer.Timer.unwind).
+
+Module-global by design, like the fault harness and the telemetry
+stream: one deadline governs one process-wide run; ``clear()`` between
+runs (the facade does this) keeps sequential runs independent.
+"""
+
+from __future__ import annotations
+
+import signal
+import time
+from typing import Optional
+
+#: Default DECLARED wind-down grace on top of the budget: the allowance
+#: the mandatory tail (extension, gate/repair, final checkpoint, report)
+#: is expected to fit.  Advisory — reported in the anytime section so
+#: operators can size preemption windows; the cooperative tail is not
+#: forcibly interrupted.  Overridable via ctx.resilience.budget_grace.
+DEFAULT_GRACE_S = 30.0
+
+_budget_s: Optional[float] = None
+_grace_s: float = DEFAULT_GRACE_S
+_t0: Optional[float] = None
+_deadline: Optional[float] = None
+_stop = False
+_reason = ""
+_stage = ""
+_stage_at_stop = ""
+_announced = False
+_prev_handlers: dict = {}
+
+
+def install_budget(budget_s: float, grace_s: Optional[float] = None) -> None:
+    """Arm a fresh deadline ``budget_s`` seconds from now."""
+    global _budget_s, _grace_s, _t0, _deadline, _stop, _reason, _announced
+    _budget_s = float(budget_s)
+    _grace_s = float(grace_s) if grace_s is not None else DEFAULT_GRACE_S
+    _t0 = time.monotonic()
+    _deadline = _t0 + _budget_s
+    _stop = False
+    _reason = ""
+    _announced = False
+
+
+def clear() -> None:
+    """Disarm the deadline and any pending stop request (between runs)."""
+    global _budget_s, _t0, _deadline, _stop, _reason, _stage, _announced
+    global _stage_at_stop
+    _budget_s = None
+    _t0 = None
+    _deadline = None
+    _stop = False
+    _reason = ""
+    _stage = ""
+    _stage_at_stop = ""
+    _announced = False
+
+
+def begin_run(budget_s: Optional[float] = None,
+              grace_s: Optional[float] = None) -> None:
+    """Per-run reset used by the facades (shm and dist): clears stale
+    budget/stage state from a previous run, arms a fresh budget when one
+    is configured — but PRESERVES a pending preemption signal.  A
+    SIGTERM that arrived while the graph was still loading must wind
+    down the run that follows, not be silently discarded."""
+    pending = _stop and _reason in ("sigterm", "sigint")
+    reason = _reason
+    clear()
+    if budget_s is not None and budget_s > 0:
+        install_budget(budget_s, grace_s)
+    if pending:
+        request_stop(reason)
+
+
+def agreed_stop() -> bool:
+    """Cross-process-consistent wind-down verdict, for control flow that
+    gates COLLECTIVE work: every process must take the same branch or a
+    shard_map collective deadlocks mid-wind-down.  Per-rank clocks and
+    per-rank signal delivery can disagree by a barrier, so the local
+    verdicts are max-reduced; any rank stopping stops all.  On a single
+    process (this repo's usual mesh driver) it is exactly should_stop().
+    """
+    local = should_stop()
+    try:
+        from ..utils.platform import process_count
+
+        if process_count() <= 1:
+            return local
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flags = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([1 if local else 0], dtype=np.int32)
+            )
+        )
+        agreed = bool(flags.max())
+    except Exception:
+        return local
+    if agreed and not local:
+        request_stop("peer")  # keep local state coherent with the fleet
+    return agreed
+
+
+def request_stop(reason: str) -> None:
+    """Ask the pipeline to wind down at its next barrier (signal handlers,
+    tests).  Safe to call from a signal handler: sets flags only."""
+    global _stop, _reason
+    if not _stop:
+        _stop = True
+        _reason = reason
+
+
+def should_stop() -> bool:
+    """True once the budget has expired or a stop was requested.  The
+    first True transition emits a ``deadline`` telemetry event and a log
+    line (once), so the wind-down is visible in the run report."""
+    global _stop, _reason, _announced, _stage_at_stop
+    if not _stop and _deadline is not None and time.monotonic() >= _deadline:
+        _stop = True
+        _reason = _reason or "budget"
+    if _stop and not _announced:
+        _announced = True
+        _stage_at_stop = _stage  # where the wind-down actually began
+        _announce()
+    return _stop
+
+
+def _announce() -> None:
+    from .. import telemetry
+    from ..utils.logger import log_warning
+
+    telemetry.event(
+        "deadline",
+        reason=_reason,
+        stage=_stage or None,
+        budget_s=_budget_s,
+        elapsed_s=None if _t0 is None else round(time.monotonic() - _t0, 3),
+    )
+    log_warning(
+        f"deadline: winding down ({_reason}) at stage "
+        f"'{_stage or 'start'}' — finishing mandatory work only"
+    )
+
+
+def triggered() -> bool:
+    """True when the run wound down early (deadline or stop request)."""
+    return _stop
+
+
+def note_stage(stage: str) -> None:
+    """Record the deepest pipeline stage reached (barrier bookkeeping;
+    the `anytime` annotation reports it)."""
+    global _stage
+    _stage = stage
+
+
+def stage_reached() -> str:
+    return _stage
+
+
+def state() -> dict:
+    """The run report's `anytime` section for a wound-down run (None
+    values are omitted so the section validates against the schema's
+    typed optional properties)."""
+    d = {
+        "anytime": bool(_stop),
+        "reason": _reason or None,
+        "stage": _stage_at_stop or _stage or None,
+        "budget_s": _budget_s,
+        "grace_s": _grace_s if _budget_s is not None else None,
+        "elapsed_s": (
+            None if _t0 is None else round(time.monotonic() - _t0, 3)
+        ),
+    }
+    return {k: v for k, v in d.items() if v is not None or k == "anytime"}
+
+
+def grace_s() -> float:
+    return _grace_s
+
+
+def install_signal_handlers() -> None:
+    """Route SIGTERM/SIGINT into the cooperative wind-down (CLI entry
+    points only — a library must not hijack the host's signals).
+
+    First delivery requests a stop; a second delivery of the same signal
+    restores the previous handler and re-raises it, so a stuck run can
+    still be killed the classic way.  Idempotent."""
+    if _prev_handlers:
+        return
+
+    def _handler(signum, frame):
+        name = signal.Signals(signum).name
+        request_stop(name.lower())
+        # second delivery: give the signal back to its old handler
+        prev = _prev_handlers.get(signum, signal.SIG_DFL)
+        try:
+            signal.signal(signum, prev)
+        except (ValueError, OSError):
+            pass
+        # handlers may not log safely in all contexts; stderr write is
+        # async-signal-tolerant enough for a one-line notice
+        import sys
+
+        sys.stderr.write(
+            f"\n[{name}] wind-down requested: finishing at the next "
+            "pipeline barrier (send again to force)\n"
+        )
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        try:
+            _prev_handlers[signum] = signal.signal(signum, _handler)
+        except (ValueError, OSError):
+            # not the main thread / unsupported platform: skip silently,
+            # the cooperative budget path still works
+            _prev_handlers.pop(signum, None)
+
+
+def uninstall_signal_handlers() -> None:
+    """Restore the handlers replaced by install_signal_handlers (tests)."""
+    for signum, prev in list(_prev_handlers.items()):
+        try:
+            signal.signal(signum, prev)
+        except (ValueError, OSError):
+            pass
+    _prev_handlers.clear()

@@ -82,6 +82,31 @@ class CollectiveTimeout(DegradationError):
     timed out or failed.  Fallback: continue with local-only data."""
 
 
+class CheckpointWriteFailed(DegradationError):
+    """A checkpoint snapshot or manifest could not be written (disk full,
+    permissions, injected fault).  Fallback: the run continues with
+    in-memory-only checkpoints — losing durability, never the run."""
+
+
+class CheckpointCorrupt(DegradationError):
+    """A checkpoint snapshot failed its content checksum (truncated or
+    bit-rotted file) or the manifest would not parse.  Fallback: the
+    previous manifest generation.  A property of stored data, not of the
+    process: does not advance the circuit breaker."""
+
+    breaker_relevant = False
+
+
+class CheckpointMismatch(DegradationError):
+    """A checkpoint exists but belongs to a different run: the graph
+    fingerprint or the context fingerprint recorded in the manifest does
+    not match the current invocation.  Policy: clean restart (ignore the
+    checkpoint), never a crash and never a silent resume of foreign
+    state.  A refusal, not a fault: does not advance the breaker."""
+
+    breaker_relevant = False
+
+
 class DeviceOOM(DegradationError):
     """The accelerator (or host, for MemoryError) ran out of memory in an
     optional fast path.  Fallback: the path's smaller-footprint twin

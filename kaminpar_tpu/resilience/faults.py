@@ -33,6 +33,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Type
 
 from .errors import (
+    CheckpointCorrupt,
+    CheckpointWriteFailed,
     CollectiveTimeout,
     DegradationError,
     DeviceOOM,
@@ -103,6 +105,18 @@ _register(SiteSpec(
     "collective", CollectiveTimeout,
     "local-only data (skip cross-process aggregation)",
     "host-side cross-process gathers (telemetry/report.py, dist driver)",
+))
+_register(SiteSpec(
+    "checkpoint-write", CheckpointWriteFailed,
+    "in-memory-only checkpoints (run continues, durability lost)",
+    "atomic snapshot/manifest write at a pipeline barrier "
+    "(resilience/checkpoint.py)",
+))
+_register(SiteSpec(
+    "checkpoint-load", CheckpointCorrupt,
+    "previous manifest generation (one barrier of progress lost)",
+    "snapshot read + checksum validation on --resume "
+    "(resilience/checkpoint.py)",
 ))
 
 

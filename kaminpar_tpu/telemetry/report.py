@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -107,6 +107,12 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # the output gate's verdict (resilience/gate.py); absent when the
     # gate was disabled or no partition ran in this stream
     gate_verdict = info.pop("output_gate", {"checked": False})
+    # schema v3 resilience sections: the checkpoint manager's summary
+    # (resilience/checkpoint.py) and the anytime/wind-down annotation
+    # (resilience/deadline.py); well-formed defaults when the run used
+    # neither
+    ckpt_summary = info.pop("checkpoint", {"enabled": False})
+    anytime = info.pop("anytime", {"anytime": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -184,6 +190,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # part compile or execute"
         "progress": [p.to_dict() for p in _progress_series()],
         "compile": _compile_section(),
+        # schema v3: preemption-safety audit trail — what was
+        # checkpointed (and whether durability degraded to memory-only)
+        # and whether the run wound down early under a deadline/signal
+        "checkpoint": ckpt_summary,
+        "anytime": anytime,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

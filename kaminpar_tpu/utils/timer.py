@@ -42,6 +42,7 @@ class Timer:
     def __init__(self, name: str = "root", enabled: bool = True) -> None:
         self.root = TimerNode(name)
         self._stack = [self.root]
+        self._open_starts: list = []  # perf_counter stamps of open scopes
         self.enabled = enabled
 
     def reset(self) -> None:
@@ -54,6 +55,7 @@ class Timer:
             return
         self.root = TimerNode(self.root.name)
         self._stack = [self.root]
+        self._open_starts = []
 
     def idle(self) -> bool:
         """True when no scope is open — i.e. not nested inside another
@@ -72,29 +74,58 @@ class Timer:
         tel = telemetry.enabled()
         entry_state = _span_entry_state() if tel else None
         start = time.perf_counter()
+        self._open_starts.append(start)
         try:
             yield
         finally:
-            sync_s = None
-            if sync is not None:
-                t_sync = time.perf_counter()
-                try:
-                    import jax
+            # an emergency unwind() may have force-closed this scope
+            # while the generator was suspended — don't double-account
+            if self._stack and self._stack[-1] is node:
+                sync_s = None
+                if sync is not None:
+                    t_sync = time.perf_counter()
+                    try:
+                        import jax
 
-                    jax.block_until_ready(sync)
-                except Exception:
-                    pass
-                sync_s = time.perf_counter() - t_sync
-            end = time.perf_counter()
+                        jax.block_until_ready(sync)
+                    except Exception:
+                        pass
+                    sync_s = time.perf_counter() - t_sync
+                end = time.perf_counter()
+                node.elapsed += end - start
+                node.count += 1
+                if tel:
+                    path = ".".join(n.name for n in self._stack[1:])
+                    telemetry.record_span(
+                        name, path, start, end - start,
+                        **_span_exit_attrs(entry_state, sync_s),
+                    )
+                self._stack.pop()
+                if self._open_starts:
+                    self._open_starts.pop()
+
+    def unwind(self) -> int:
+        """Force-close every open scope, recording its elapsed time and
+        span — the emergency path for an interrupt that surfaces from
+        deep inside XLA (SIGINT during a jitted while_loop): without it
+        the stack stays open, ``idle()`` lies, and the emergency run
+        report renders a scope tree with un-accounted open nodes.
+        Returns the number of scopes closed."""
+        closed = 0
+        end = time.perf_counter()
+        while len(self._stack) > 1:
+            node = self._stack[-1]
+            start = self._open_starts.pop() if self._open_starts else end
             node.elapsed += end - start
             node.count += 1
-            if tel:
+            if telemetry.enabled():
                 path = ".".join(n.name for n in self._stack[1:])
                 telemetry.record_span(
-                    name, path, start, end - start,
-                    **_span_exit_attrs(entry_state, sync_s),
+                    node.name, path, start, end - start, interrupted=True
                 )
             self._stack.pop()
+            closed += 1
+        return closed
 
     def elapsed(self, *path: str) -> float:
         node = self.root

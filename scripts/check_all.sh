@@ -2,9 +2,11 @@
 # The one-command commit gate: tpulint, run-report schema check, a
 # chaos smoke run (every fault site injected once; the run must still
 # produce a gate-valid partition and a schema-valid report), the
-# telemetry.diff regression-gate self-test + BENCH-trend check, and the
-# ROADMAP.md tier-1 pytest command.  Exits nonzero on the first
-# failing stage.
+# telemetry.diff regression-gate self-test + BENCH-trend check, a
+# preempt-and-resume smoke (SIGTERM an rgg2d run mid-pipeline, resume
+# from the checkpoint, assert gate-valid + anytime/checkpoint report
+# sections), and the ROADMAP.md tier-1 pytest command.  Exits nonzero
+# on the first failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
 #         --fast skips the tier-1 pytest stage (lint + schema + chaos
@@ -15,13 +17,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/5] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/6] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/5] run-report schema (producer selftest, v1 + v2) =="
+echo "== [2/6] run-report schema (producer selftest, v1/v2 fixtures + v3 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/5] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/6] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -42,7 +44,7 @@ print(f"chaos smoke OK: {len(r['degraded'])} degraded event(s), "
       f"{len(r['progress'])} progress series")
 EOF
 
-echo "== [4/5] telemetry.diff self-test + BENCH trend =="
+echo "== [4/6] telemetry.diff self-test + BENCH trend =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -62,12 +64,53 @@ if python -m kaminpar_tpu.telemetry.diff \
 fi
 python scripts/bench_trend.py --check || exit 1
 
+
+echo "== [5/6] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+CKPT=/tmp/_kmp_ckpt_smoke
+rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
+python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
+    --checkpoint-dir "$CKPT" --report-json /tmp/_kmp_preempt1.json -q &
+preempt_pid=$!
+# signal as soon as the first barrier checkpoint lands => mid-pipeline
+for _ in $(seq 1 240); do
+    [ -f "$CKPT/manifest.json" ] && break
+    sleep 0.5
+done
+kill -TERM "$preempt_pid" 2>/dev/null
+wait "$preempt_pid" || { echo "ERROR: SIGTERM'd run exited nonzero" >&2; exit 1; }
+python scripts/check_report_schema.py /tmp/_kmp_preempt1.json || exit 1
+python - <<'EOF2' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_preempt1.json"))
+assert r["anytime"]["anytime"] is True, r["anytime"]
+assert r["anytime"]["reason"] == "sigterm", r["anytime"]
+ck = r["checkpoint"]
+assert ck["enabled"] and ck["writes"] > 0 and not ck["memory_only"], ck
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], f"preempted run failed the gate: {gate}"
+print(f"preempt OK: anytime at stage {r['anytime'].get('stage')}, "
+      f"{ck['writes']} checkpoint write(s)")
+EOF2
+python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
+    --checkpoint-dir "$CKPT" --resume --report-json /tmp/_kmp_preempt2.json -q \
+    || exit 1
+python scripts/check_report_schema.py /tmp/_kmp_preempt2.json || exit 1
+python - <<'EOF2' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_preempt2.json"))
+assert r["checkpoint"]["resumed_from"] is not None, r["checkpoint"]
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], f"resumed run failed the gate: {gate}"
+print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
+      f"cut={gate['cut_recomputed']}")
+EOF2
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [5/5] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [6/6] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [5/5] tier-1 pytest (ROADMAP.md) =="
+echo "== [6/6] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -83,15 +83,27 @@ def validate_instance(instance: Any, schema: dict, path: str = "$") -> List[str]
 
 def version_checks(report: Any) -> List[str]:
     """Schema_version-conditional requirements the dependency-free
-    validator subset cannot express (no if/then): v2 reports must carry
-    the `progress` and `compile` sections; v1 reports remain valid
+    validator subset cannot express (no if/then): v2+ reports must carry
+    the `progress` and `compile` sections, v3+ additionally the
+    `checkpoint` and `anytime` sections; older reports remain valid
     without them during the transition."""
     errors: List[str] = []
-    if isinstance(report, dict) and report.get("schema_version") == 2:
-        for key in ("progress", "compile"):
+    if not isinstance(report, dict):
+        return errors
+    version = report.get("schema_version")
+    if not isinstance(version, int):
+        return errors
+    required_by_version = [
+        (2, ("progress", "compile")),
+        (3, ("checkpoint", "anytime")),
+    ]
+    for min_version, keys in required_by_version:
+        if version < min_version:
+            continue
+        for key in keys:
             if key not in report:
                 errors.append(
-                    f"$: schema_version 2 requires section {key!r}"
+                    f"$: schema_version {version} requires section {key!r}"
                 )
     return errors
 
@@ -121,10 +133,22 @@ def _minimal_v1_report() -> dict:
     }
 
 
+def _minimal_v2_report() -> dict:
+    """A minimal schema_version-2 report (progress/compile present, no
+    checkpoint/anytime sections) — the second transition fixture."""
+    r = _minimal_v1_report()
+    r["schema_version"] = 2
+    r["progress"] = []
+    r["compile"] = {"caveat": "none", "totals": {}, "phases": {}}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
-    check_all.sh fast path)."""
+    check_all.sh fast path).  Annotates non-default `checkpoint` and
+    `anytime` sections so the v3 producer surface is exercised, not just
+    its empty defaults."""
     # run as a script, sys.path[0] is scripts/ — add the repo root
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in sys.path:
@@ -134,6 +158,18 @@ def _selftest_report(path: str) -> None:
 
     telemetry.enable()
     telemetry.annotate(result={"cut": 0, "imbalance": 0.0, "feasible": True})
+    telemetry.annotate(
+        checkpoint={
+            "enabled": True, "dir": "/tmp/ckpt", "memory_only": False,
+            "generation": 2, "writes": 2, "bytes": 1024, "wall_s": 0.01,
+            "resumed_from": "uncoarsen:1",
+            "snapshots": ["level-0", "state"],
+        },
+        anytime={
+            "anytime": True, "reason": "budget", "stage": "uncoarsen:1",
+            "budget_s": 1.0, "grace_s": 30.0, "elapsed_s": 1.2,
+        },
+    )
     write_run_report(path)
 
 
@@ -175,22 +211,37 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v2 (progress + compile sections)
-        if report.get("schema_version") != 2:
+        # live producer must emit v3 (progress/compile + checkpoint/anytime)
+        if report.get("schema_version") != 3:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 2",
+                f"expected 3",
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1 layout must STILL validate
-        v1 = _minimal_v1_report()
-        v1_errors = validate_instance(v1, schema) + version_checks(v1)
-        if v1_errors:
-            for e in v1_errors:
-                print(f"SCHEMA VIOLATION (v1 fixture) {e}", file=sys.stderr)
-            return 1
+        for key in ("checkpoint", "anytime"):
+            if key not in report:
+                print(
+                    f"SCHEMA VIOLATION $: selftest producer emitted no "
+                    f"{key!r} section",
+                    file=sys.stderr,
+                )
+                return 1
+        # transition coverage: the v1 and v2 layouts must STILL validate
+        for label, fixture in (
+            ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
+        ):
+            fx_errors = (
+                validate_instance(fixture, schema) + version_checks(fixture)
+            )
+            if fx_errors:
+                for e in fx_errors:
+                    print(
+                        f"SCHEMA VIOLATION ({label} fixture) {e}",
+                        file=sys.stderr,
+                    )
+                return 1
     elif args.report is None:
         ap.error("a report file is required unless --selftest is given")
     else:

@@ -1,0 +1,380 @@
+"""Checkpoint/resume + deadline-budget tests (docs/robustness.md).
+
+Kill-and-resume equivalence is the acceptance check of ISSUE 5: a run
+hard-interrupted at each barrier kind (coarsen / initial / uncoarsen)
+and resumed must produce a gate-valid partition with a cut within
+tolerance of the uninterrupted run, without re-running completed
+coarsening levels.  The deadline suite asserts `time_budget` yields a
+gate-valid partition annotated ``anytime: true``, and the fault-site
+tests cover the `checkpoint-write` / `checkpoint-load` degradations.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import resilience, telemetry
+from kaminpar_tpu.graphs.factories import make_rgg2d
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.resilience import checkpoint as ckpt_mod
+from kaminpar_tpu.resilience import deadline as deadline_mod
+from kaminpar_tpu.resilience.checkpoint import SimulatedPreemption
+
+N, K, CONTRACTION_LIMIT = 1500, 4, 50
+CUT_TOLERANCE = 0.15
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(ckpt_mod.STOP_AT_ENV, raising=False)
+    monkeypatch.delenv(resilience.FAULTS_ENV_VAR, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _graph():
+    return make_rgg2d(N, avg_degree=8, seed=3)
+
+
+def _run(ckpt_dir=None, resume=False, stop_at=None, seed=1, budget=None,
+         grace=None):
+    """One deep pipeline run; returns (solver, graph, partition, metrics)."""
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    if stop_at is not None:
+        os.environ[ckpt_mod.STOP_AT_ENV] = stop_at
+    else:
+        os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+    ctx = create_context_by_preset_name("default")
+    ctx.coarsening.contraction_limit = CONTRACTION_LIMIT
+    if ckpt_dir is not None:
+        ctx.resilience.checkpoint_dir = str(ckpt_dir)
+        ctx.resilience.resume = resume
+    if budget is not None:
+        ctx.resilience.time_budget = budget
+    if grace is not None:
+        ctx.resilience.budget_grace = grace
+    g = _graph()
+    solver = KaMinPar(ctx)
+    solver.set_output_level(0)
+    solver.set_graph(g)
+    part = solver.compute_partition(k=K, epsilon=0.03, seed=seed)
+    os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+    return solver, g, part, solver.result_metrics(g, part)
+
+
+def _gate_valid():
+    gates = telemetry.events("output-gate")
+    assert gates, "no output-gate event"
+    return gates[-1].attrs["valid"]
+
+
+@pytest.fixture(scope="module")
+def baseline_metrics():
+    """The uninterrupted run's metrics (one run shared by the module)."""
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        _, _, _, m = _run()
+        return m
+    finally:
+        resilience.reset()
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# io/snapshot: atomicity + checksums
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_roundtrip_and_checksum(tmp_path):
+    from kaminpar_tpu.io.snapshot import (
+        SnapshotError, read_snapshot, write_snapshot,
+    )
+
+    path = str(tmp_path / "snap.npz")
+    arrays = {"a": np.arange(10, dtype=np.int64), "b": np.ones(3)}
+    nbytes, sha = write_snapshot(path, arrays)
+    assert nbytes == os.path.getsize(path)
+    back = read_snapshot(path, sha)
+    np.testing.assert_array_equal(back["a"], arrays["a"])
+    # no stray temp files (atomic protocol)
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+    # truncation must surface as a structured checksum error
+    with open(path, "r+b") as f:
+        f.truncate(nbytes // 2)
+    with pytest.raises(SnapshotError):
+        read_snapshot(path, sha)
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume equivalence at every barrier kind
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "stop_at", ["coarsen:1!", "initial!", "uncoarsen:1!"],
+    ids=["coarsen", "initial", "uncoarsen"],
+)
+def test_kill_and_resume_equivalence(tmp_path, baseline_metrics, stop_at):
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt_dir=d, stop_at=stop_at)
+    manifest = json.load(open(d / "manifest.json"))
+    want_stage = stop_at.rstrip("!").split(":")[0]
+    assert manifest["stage"] == want_stage
+
+    completed_levels = sum(
+        1 for name in manifest["snapshots"] if name.startswith("level-")
+    )
+    _, _, part, m = _run(ckpt_dir=d, resume=True)
+    assert _gate_valid()
+    assert m["feasible"]
+    base = baseline_metrics["cut"]
+    assert abs(m["cut"] - base) <= max(2, CUT_TOLERANCE * base), (
+        f"resumed cut {m['cut']} vs baseline {base}"
+    )
+    # no completed coarsening level re-ran: the resumed run's
+    # coarsening-level events start past the restored hierarchy
+    rerun_levels = [
+        e.attrs["level"] for e in telemetry.events("coarsening-level")
+    ]
+    assert all(lvl > completed_levels for lvl in rerun_levels), (
+        f"levels {rerun_levels} re-ran below restored depth "
+        f"{completed_levels}"
+    )
+    # the report records where the run resumed from
+    summary = telemetry.run_info()["checkpoint"]
+    assert summary["resumed_from"] is not None
+
+
+def test_graceful_preemption_winds_down_to_valid_result(tmp_path):
+    """The SIGTERM path (driven via the STOP_AT soft hook): the run
+    finishes early, passes the gate, annotates anytime, and leaves a
+    final `result` checkpoint that a --resume returns instantly."""
+    d = tmp_path / "ckpt"
+    solver, g, part, m = _run(ckpt_dir=d, stop_at="coarsen:1")
+    assert _gate_valid()
+    assert m["feasible"]
+    assert solver.last_anytime and solver.last_anytime["anytime"]
+    assert solver.last_anytime["reason"].startswith("stop-at")
+    manifest = json.load(open(d / "manifest.json"))
+    assert manifest["stage"] == "result"
+    # resume: the result snapshot comes back without re-partitioning
+    _, _, part2, m2 = _run(ckpt_dir=d, resume=True)
+    np.testing.assert_array_equal(part, part2)
+    assert telemetry.run_info()["checkpoint"]["resumed_from"] == "result"
+
+
+def test_checkpoint_mismatch_degrades_to_clean_restart(tmp_path):
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt_dir=d, stop_at="uncoarsen:1!", seed=1)
+    # different seed => different ctx fingerprint => clean restart
+    _, _, _, m = _run(ckpt_dir=d, resume=True, seed=2)
+    assert _gate_valid() and m["feasible"]
+    actions = [
+        e.attrs.get("action") for e in telemetry.events("checkpoint")
+    ]
+    assert "clean-restart" in actions
+
+
+def test_resume_on_empty_dir_is_fresh_start(tmp_path, baseline_metrics):
+    _, _, _, m = _run(ckpt_dir=tmp_path / "empty", resume=True)
+    assert _gate_valid()
+    assert m["cut"] == baseline_metrics["cut"]  # plain deterministic run
+
+
+# ---------------------------------------------------------------------------
+# fault sites: checkpoint-write / checkpoint-load
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_write_fault_degrades_to_memory_only(tmp_path, monkeypatch):
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "checkpoint-write:nth=1")
+    d = tmp_path / "ckpt"
+    _, _, _, m = _run(ckpt_dir=d)
+    assert _gate_valid() and m["feasible"]
+    degraded = [e.attrs["site"] for e in telemetry.events("degraded")]
+    assert "checkpoint-write" in degraded
+    summary = telemetry.run_info()["checkpoint"]
+    assert summary["memory_only"] is True
+
+
+def test_corrupted_snapshot_falls_back_to_previous_generation(
+    tmp_path, baseline_metrics
+):
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt_dir=d, stop_at="uncoarsen:1!")
+    manifest = json.load(open(d / "manifest.json"))
+    state_file = manifest["snapshots"]["state"]["file"]
+    with open(d / state_file, "r+b") as f:
+        f.truncate(64)  # truncated snapshot: checksum must fail
+    _, _, _, m = _run(ckpt_dir=d, resume=True)
+    assert _gate_valid() and m["feasible"]
+    degraded = [e.attrs["site"] for e in telemetry.events("degraded")]
+    assert "checkpoint-load" in degraded
+    base = baseline_metrics["cut"]
+    assert abs(m["cut"] - base) <= max(2, CUT_TOLERANCE * base)
+
+
+def test_unusable_checkpoint_dir_degrades_with_warning(baseline_metrics):
+    _, _, _, m = _run(ckpt_dir="/proc/kaminpar/definitely/not/writable")
+    assert _gate_valid() and m["feasible"]
+    summary = telemetry.run_info()["checkpoint"]
+    assert summary["enabled"] is False
+    events = [
+        e.attrs.get("action") for e in telemetry.events("checkpoint")
+    ]
+    assert "dir-unusable" in events
+
+
+# ---------------------------------------------------------------------------
+# deadline budget / anytime contract
+# ---------------------------------------------------------------------------
+
+
+def test_time_budget_returns_gate_valid_anytime_partition():
+    solver, g, part, m = _run(budget=1e-3, grace=120.0)
+    assert _gate_valid()
+    assert m["feasible"]
+    assert part.shape == (N,)
+    assert (part >= 0).all() and (part < K).all()
+    anytime = solver.last_anytime
+    assert anytime and anytime["anytime"] and anytime["reason"] == "budget"
+    assert anytime["budget_s"] == pytest.approx(1e-3)
+    assert anytime["grace_s"] == pytest.approx(120.0)
+    assert anytime["elapsed_s"] >= 0
+
+
+def test_generous_budget_never_triggers_anytime():
+    solver, _, _, m = _run(budget=3600.0)
+    assert solver.last_anytime is None
+    assert m["feasible"]
+
+
+def test_deadline_unit_budget_and_stop_request():
+    deadline_mod.install_budget(1e-4, grace_s=5.0)
+    import time as time_mod
+
+    time_mod.sleep(0.01)
+    assert deadline_mod.should_stop()
+    assert deadline_mod.triggered()
+    st = deadline_mod.state()
+    assert st["anytime"] and st["reason"] == "budget"
+    deadline_mod.clear()
+    assert not deadline_mod.should_stop()
+    deadline_mod.request_stop("sigterm")
+    assert deadline_mod.should_stop()
+    assert deadline_mod.state()["reason"] == "sigterm"
+    deadline_mod.clear()
+
+
+def test_barrier_is_noop_without_manager():
+    assert ckpt_mod.active() is None
+    assert ckpt_mod.barrier("coarsen", level=1, scheme="deep") is True
+    assert telemetry.events("checkpoint") == []
+
+
+# ---------------------------------------------------------------------------
+# SIGINT bugfix: open timer scopes are closed, emergency report validates
+# ---------------------------------------------------------------------------
+
+
+def test_interrupt_unwind_closes_open_timer_scopes():
+    from kaminpar_tpu.utils import timer
+
+    t = timer.Timer()
+    s1 = t.scope("partitioning")
+    s2 = t.scope("coarsening")
+    s1.__enter__()
+    s2.__enter__()  # simulate SIGINT deep inside a jitted loop
+    assert not t.idle()
+    closed = t.unwind()
+    assert closed == 2
+    assert t.idle()
+    tree = t.root.children
+    assert "partitioning" in tree
+    assert "coarsening" in tree["partitioning"].children
+    assert tree["partitioning"].count == 1
+
+
+def test_cli_keyboard_interrupt_writes_schema_valid_report(
+    tmp_path, monkeypatch
+):
+    """A forced interrupt surfacing from inside the pipeline must yield
+    exit 130 and a schema-valid emergency run report with the
+    interrupted spans closed."""
+    from kaminpar_tpu import cli
+    from kaminpar_tpu.utils import timer
+
+    def fake_compute(self, **kwargs):
+        # leave scopes open, as a KeyboardInterrupt surfacing from a
+        # jitted while_loop does
+        timer.GLOBAL_TIMER.reset()
+        cm1 = timer.GLOBAL_TIMER.scope("partitioning")
+        cm2 = timer.GLOBAL_TIMER.scope("coarsening")
+        cm1.__enter__()
+        cm2.__enter__()
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(KaMinPar, "compute_partition", fake_compute)
+    report = tmp_path / "emergency.json"
+    rc = cli.main([
+        "gen:grid2d;rows=8;cols=8", "-k", "2", "-q",
+        "--report-json", str(report),
+    ])
+    deadline_mod.uninstall_signal_handlers()
+    assert rc == 130
+    assert report.exists()
+    r = json.load(open(report))
+    assert r["anytime"]["anytime"] is True
+    assert r["run"]["interrupted"] is True
+    # the open scopes were force-closed into the tree
+    assert "partitioning" in r["scope_tree"]
+    # and the artifact validates against the checked-in schema
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts",
+                                      "check_report_schema.py"),
+         str(report)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# fingerprints / manifest units
+# ---------------------------------------------------------------------------
+
+
+def test_graph_fingerprint_distinguishes_graphs():
+    g1 = make_rgg2d(400, avg_degree=8, seed=3)
+    g2 = make_rgg2d(400, avg_degree=8, seed=4)
+    assert ckpt_mod.graph_fingerprint(g1) == ckpt_mod.graph_fingerprint(g1)
+    assert ckpt_mod.graph_fingerprint(g1) != ckpt_mod.graph_fingerprint(g2)
+
+
+def test_ctx_fingerprint_ignores_resilience_knobs():
+    c1 = create_context_by_preset_name("default")
+    c2 = create_context_by_preset_name("default")
+    c2.resilience.checkpoint_dir = "/somewhere"
+    c2.resilience.resume = True
+    c2.resilience.time_budget = 5.0
+    assert ckpt_mod.ctx_fingerprint(c1) == ckpt_mod.ctx_fingerprint(c2)
+    c2.seed = 99
+    assert ckpt_mod.ctx_fingerprint(c1) != ckpt_mod.ctx_fingerprint(c2)

@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 2
+    assert loaded["schema_version"] == 3
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -194,6 +194,10 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     for key in ("trace_s", "lower_s", "compile_s", "compiles",
                 "persistent_cache_hits", "persistent_cache_misses"):
         assert key in comp["totals"], key
+    # schema v3 sections: well-formed defaults for a run that used
+    # neither checkpointing nor a deadline budget
+    assert loaded["checkpoint"] == {"enabled": False}
+    assert loaded["anytime"] == {"anytime": False}
 
     # validates against the checked-in schema (drift backstop)
     checker = _load_checker()
@@ -585,11 +589,11 @@ def test_diff_aligns_progress_by_kind_path_level(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# schema v1/v2 transition (scripts/check_report_schema.py)
+# schema v1/v2/v3 transition (scripts/check_report_schema.py)
 # ---------------------------------------------------------------------------
 
 
-def test_schema_accepts_v1_and_v2(tmp_path):
+def test_schema_accepts_v1_v2_and_v3(tmp_path):
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH
 
     checker = _load_checker()
@@ -597,16 +601,33 @@ def test_schema_accepts_v1_and_v2(tmp_path):
     v1 = checker._minimal_v1_report()
     assert checker.validate_instance(v1, schema) == []
     assert checker.version_checks(v1) == []
-    # a v2 report without the new sections must be rejected
+    # a v2 report without its sections must be rejected...
     v2_missing = dict(v1, schema_version=2)
     assert any(
         "progress" in e or "compile" in e
         for e in checker.version_checks(v2_missing)
     )
-    # v3 is not a known version
-    v3 = dict(v1, schema_version=3)
+    # ...and a complete v2 fixture accepted
+    v2 = checker._minimal_v2_report()
+    assert checker.validate_instance(v2, schema) == []
+    assert checker.version_checks(v2) == []
+    # v3 additionally requires the checkpoint/anytime sections
+    v3_missing = dict(v2, schema_version=3)
+    assert any(
+        "checkpoint" in e or "anytime" in e
+        for e in checker.version_checks(v3_missing)
+    )
+    v3 = dict(
+        v3_missing,
+        checkpoint={"enabled": False},
+        anytime={"anytime": False},
+    )
+    assert checker.validate_instance(v3, schema) == []
+    assert checker.version_checks(v3) == []
+    # v4 is not a known version
+    v4 = dict(v1, schema_version=4)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v3, schema))
+               for e in checker.validate_instance(v4, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
