@@ -1,0 +1,267 @@
+"""Shared shape-bucketing + bounded-cache policy (ROADMAP item 5's
+"refactor unlock").
+
+Two concerns that every scaling direction (hot-kernel fusion, the
+serving layer, multi-chip scale-out) shares used to be scattered:
+
+  * the **shape-bucket padding policy** — device arrays are padded to a
+    bounded family of shapes so XLA executables are reused across
+    levels, graphs, and requests.  ``pad_size`` (previously
+    ``utils/math.pad_size``; re-exported there for its existing callers)
+    is THE policy: next power of two with a granularity floor, giving
+    O(log n) distinct compiled shapes per graph.  ``bucket_key``
+    compacts a request's (n, m, k) into the executable-identity triple
+    the jit cache effectively keys on, so executable *reuse* becomes an
+    observable hit-rate instead of an invisible property of jax
+    internals.
+  * the **bounded cache policy** — :class:`BoundedCache`, an LRU with an
+    explicit entry cap AND a byte budget, so caches grown by sustained
+    traffic (the serving result cache, future plan/executable caches)
+    stay bounded instead of OOMing the host after a few hours of load.
+    Hit/miss/eviction counters are first-class (`stats()`), and the
+    serving layer surfaces them in the run report and the BENCH trend.
+
+Import-light by design (numpy only): the serving layer pulls this in
+before any backend exists, and ``utils/math`` re-exports ``pad_size``
+from here at interpreter start.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+
+def _ceil2(x: int) -> int:
+    """Smallest power of two >= x (utils/math.ceil2's twin; duplicated
+    two lines here so this module stays import-cycle-free — utils/math
+    re-exports pad_size from HERE)."""
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+def pad_size(x: int, granularity: int = 256) -> int:
+    """Shape-bucketed padding: next power of two, but at least x rounded up to
+    `granularity`.  Bounds the number of distinct compiled shapes per graph to
+    O(log n) as the multilevel hierarchy shrinks the graph ~2x per level."""
+    if x <= granularity:
+        return granularity
+    return _ceil2(x)
+
+
+def pad_k(k: int) -> int:
+    """The block-count bucket: k rounded up to a power of two (>= 2).
+    Mirrors ops/segments.pad_k_bucket, which additionally builds the
+    zero-capacity phantom block weights on device — this host-side twin
+    exists so bucket identity can be computed without importing jax."""
+    return max(2, 1 << (int(k) - 1).bit_length())
+
+
+def bucket_key(n: int, m: int, k: int) -> Tuple[int, int, int]:
+    """The executable-identity triple of a request: padded node slots,
+    padded edge slots, padded block count.  Two requests with the same
+    bucket key drive the device phases through the same compiled
+    programs (same shapes, same k tables) — the serving layer counts
+    reuse of these keys as its executable-cache hit rate."""
+    return (pad_size(int(n) + 1), pad_size(max(int(m), 1)), pad_k(k))
+
+
+class BoundedCache:
+    """A thread-safe LRU cache with an entry cap and a byte budget.
+
+    ``put(key, value, nbytes)`` evicts least-recently-used entries until
+    both bounds hold; a single value larger than the byte budget is
+    refused (``stats()['oversize']`` counts these) rather than evicting
+    the whole cache for one entry.  ``get`` returns None on miss —
+    callers that need to distinguish a cached None should wrap values.
+    """
+
+    def __init__(self, max_entries: int = 128,
+                 max_bytes: int = 256 << 20) -> None:
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, Tuple[Any, int]]" = (
+            OrderedDict()
+        )
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.oversize = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ent[0]
+
+    def put(self, key: Hashable, value: Any, nbytes: int = 0) -> bool:
+        """Insert (replacing any existing entry); returns False when the
+        value alone exceeds the byte budget and was refused."""
+        nbytes = int(nbytes)
+        with self._lock:
+            if nbytes > self.max_bytes:
+                self.oversize += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (value, nbytes)
+            self._bytes += nbytes
+            while (
+                len(self._entries) > self.max_entries
+                or self._bytes > self.max_bytes
+            ):
+                _, (_, dropped) = self._entries.popitem(last=False)
+                self._bytes -= dropped
+                self.evictions += 1
+            return True
+
+    def evict(self, key: Hashable) -> bool:
+        """Drop one entry (the serving-cache fault's forced-evict mode);
+        returns True when something was removed."""
+        with self._lock:
+            ent = self._entries.pop(key, None)
+            if ent is None:
+                return False
+            self._bytes -= ent[1]
+            self.evictions += 1
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> Dict[str, Any]:
+        """Counter snapshot (the run report's cache subsections)."""
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "entries": len(self._entries),
+                "bytes": int(self._bytes),
+                "max_entries": self.max_entries,
+                "max_bytes": int(self.max_bytes),
+                "hits": int(self.hits),
+                "misses": int(self.misses),
+                "evictions": int(self.evictions),
+                "oversize": int(self.oversize),
+                "hit_rate": (
+                    round(self.hits / lookups, 4) if lookups else 0.0
+                ),
+            }
+
+
+class BucketTracker:
+    """Executable-reuse accounting over :func:`bucket_key` triples.
+
+    jax's jit cache is the actual executable store; what it never tells
+    you is the *reuse rate* under a request stream.  The tracker counts
+    the first sighting of a bucket as a miss (a compile) and every later
+    sighting as a hit (executable reuse) — the compile-accounting layer
+    (telemetry/compile_account.py) confirms the attribution from the
+    other side."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._seen: Dict[Tuple[int, int, int], int] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def observe(self, n: int, m: int, k: int) -> Tuple[int, int, int]:
+        """Record one request's bucket; returns the key."""
+        key = bucket_key(n, m, k)
+        with self._lock:
+            if key in self._seen:
+                self._seen[key] += 1
+                self.hits += 1
+            else:
+                self._seen[key] = 1
+                self.misses += 1
+        return key
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {
+                "buckets": len(self._seen),
+                "hits": int(self.hits),
+                "misses": int(self.misses),
+                "hit_rate": (
+                    round(self.hits / lookups, 4) if lookups else 0.0
+                ),
+            }
+
+
+def full_graph_digest(graph) -> str:
+    """Exact structural identity of a graph: a hash of the FULL
+    adjacency and both weight arrays.  The checkpoint layer's sampling
+    ``graph_fingerprint`` is deliberately O(1) — resume only needs to
+    catch operator error — but a *result cache* replays stored answers
+    to matching keys, so its identity must cover every edge and weight:
+    two graphs that differ only in interior edges (beyond the sampled
+    head/tail) or in edge weights (which the sampling fingerprint never
+    reads) must never share a cached partition.  Compressed containers
+    are hashed as their raw encoded byte streams — same bytes, same
+    graph — so no decode pass is needed; either way the cost is one
+    sequential sweep over host memory, noise next to a partition."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+
+    def _arr(a) -> None:
+        if a is None:
+            h.update(b"\x00none")
+            return
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+
+    h.update(f"n={int(graph.n)};m={int(graph.m)};".encode())
+    if hasattr(graph, "data") and hasattr(graph, "offsets"):
+        # CompressedHostGraph: raw codec streams are the exact identity
+        h.update(str(getattr(graph, "codec", "?")).encode())
+        for name in ("xadj", "offsets", "data", "node_weights",
+                     "edge_weights", "wdata", "woffsets"):
+            _arr(getattr(graph, name, None))
+    else:
+        _arr(np.asarray(graph.xadj, dtype=np.int64))
+        _arr(graph.adjncy)
+        _arr(getattr(graph, "node_weights", None))
+        _arr(getattr(graph, "edge_weights", None))
+    return h.hexdigest()[:24]
+
+
+def result_cache_key(graph, ctx) -> Tuple[str, str]:
+    """The (graph identity, ctx fingerprint) a cached result is valid
+    for.  The graph identity is the PR-5 sampling ``graph_fingerprint``
+    (so the cache and the resume machinery agree on the cheap prefix)
+    strengthened with :func:`full_graph_digest` — the sampling
+    fingerprint alone ignores edge weights and interior structure, which
+    a replaying cache cannot afford.  The ctx fingerprint covers seed,
+    k, epsilon, preset and every algorithm knob, and excludes the
+    resilience/debug subtrees — a per-request deadline does not fork the
+    cache key."""
+    from .resilience.checkpoint import ctx_fingerprint, graph_fingerprint
+
+    return (
+        graph_fingerprint(graph) + ":" + full_graph_digest(graph),
+        ctx_fingerprint(ctx),
+    )

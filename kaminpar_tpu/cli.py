@@ -185,6 +185,26 @@ def build_parser() -> argparse.ArgumentParser:
         "forcibly interrupted",
     )
     p.add_argument(
+        "--serve-batch", default=None, metavar="BATCH.json",
+        help="serve/batch mode (partitioning-as-a-service): run every "
+        "request in the JSON batch spec through the admission-"
+        "controlled PartitionService — per-request fault isolation, "
+        "bounded result cache, per-request deadlines, SIGTERM drain; "
+        "verdicts land in the report's `serving` section "
+        "(docs/robustness.md).  The positional graph and -k are not "
+        "used in this mode",
+    )
+    p.add_argument(
+        "--serve-queue-depth", type=int, default=None, metavar="N",
+        help="serve mode: admission queue-depth cap (default 64; "
+        "overload is rejected, never queued unboundedly)",
+    )
+    p.add_argument(
+        "--serve-cost-cap", type=float, default=None, metavar="WORK",
+        help="serve mode: total estimated-cost (~ n + m) admission cap "
+        "across queued requests (default 5e7)",
+    )
+    p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
@@ -281,12 +301,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("\n".join(dump_toml(context_to_dict(ctx))))
         return 0
 
-    if args.graph is None:
-        print("error: no graph file given", file=sys.stderr)
-        return 1
-    if args.k is None and args.max_block_weights is None:
-        print("error: need -k or -B/--max-block-weights", file=sys.stderr)
-        return 1
+    if args.serve_batch is None:
+        if args.graph is None:
+            print("error: no graph file given", file=sys.stderr)
+            return 1
+        if args.k is None and args.max_block_weights is None:
+            print("error: need -k or -B/--max-block-weights",
+                  file=sys.stderr)
+            return 1
     if args.resume and not args.checkpoint_dir:
         print("error: --resume requires --checkpoint-dir", file=sys.stderr)
         return 2
@@ -333,6 +355,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"FAULTS plan={fault_plan} (fault injection ACTIVE; "
                 "see the report's 'faults' section)"
             )
+
+    if args.serve_batch is not None:
+        # serve/batch mode: the serving layer owns the request loop —
+        # admission, isolation, caching, drain — and the report export.
+        # The signal handlers installed above make SIGTERM/SIGINT drain
+        # the queue instead of killing the process.
+        from .serving.batch import run_batch_cli
+
+        return run_batch_cli(args, ctx)
 
     t_io = time.perf_counter()
     if args.graph.startswith("gen:"):

@@ -102,6 +102,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="declared (advisory, reported-not-enforced) wind-down "
         "allowance on top of --time-budget (default 30)",
     )
+    p.add_argument(
+        "--serve-batch", default=None, metavar="BATCH.json",
+        help="serve/batch mode is served by the shm CLI "
+        "(python -m kaminpar_tpu --serve-batch); the dist driver "
+        "partitions ONE large graph across the mesh per invocation — "
+        "this flag exists so the two CLIs stay argument-compatible and "
+        "fails with a pointer instead of 'unrecognized argument'",
+    )
     from . import telemetry
 
     telemetry.add_cli_args(p)
@@ -110,6 +118,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.serve_batch is not None:
+        print(
+            "error: serve/batch mode runs on the shm pipeline — use "
+            "`python -m kaminpar_tpu --serve-batch BATCH.json` "
+            "(docs/robustness.md, serving contract)",
+            file=sys.stderr,
+        )
+        return 2
     if args.graph is None:
         print("error: no graph file given", file=sys.stderr)
         return 1

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..utils.math import pad_size
+from ..caching import pad_size
 from .host import HostGraph
 
 from ..dtypes import ACC_DTYPE, WEIGHT_DTYPE  # int64 under

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..graphs.csr import DeviceGraph, WEIGHT_DTYPE
-from ..utils.math import pad_size
+from ..caching import pad_size
 from .segments import ACC_DTYPE, aggregate_by_key
 
 

@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 
 from ..graphs.csr import DeviceGraph, NODE_DTYPE
-from ..utils.math import pad_size
+from ..caching import pad_size
 from .segments import ACC_DTYPE
 
 

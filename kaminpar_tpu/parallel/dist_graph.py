@@ -35,7 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..dtypes import WEIGHT_DTYPE
 from ..graphs.host import HostGraph
-from ..utils.math import pad_size, round_up
+from ..caching import pad_size
+from ..utils.math import round_up
 from .mesh import NODE_AXIS
 
 

@@ -26,6 +26,8 @@ Three pieces (see docs/robustness.md for the operator view):
 """
 
 from .errors import (  # noqa: F401
+    AdmissionRejected,
+    CacheDegraded,
     CheckpointCorrupt,
     CheckpointMismatch,
     CheckpointWriteFailed,

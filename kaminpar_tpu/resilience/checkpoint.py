@@ -67,8 +67,11 @@ class SimulatedPreemption(RuntimeError):
     DegradationError: like a real SIGKILL it must never be swallowed by
     a fallback policy."""
 
-_active: Optional["CheckpointManager"] = None
-_suspended = 0
+# The active manager and the nested-run suspend counter live on the
+# per-run (thread-local) RunState — see resilience/runstate.py.  The
+# function API below is unchanged; back-to-back and interleaved runs
+# (the serving layer's request stream) each see only their own state.
+from . import runstate
 
 
 def activate(mgr: Optional["CheckpointManager"]) -> None:
@@ -76,18 +79,17 @@ def activate(mgr: Optional["CheckpointManager"]) -> None:
     the run that owns the telemetry stream activates one — nested runs
     (shm IP inside the dist driver) see no manager, so a checkpoint can
     never record an inner pipeline's stage as the outer run's."""
-    global _active
-    _active = mgr
+    runstate.current().manager = mgr
 
 
 def deactivate() -> None:
-    global _active, _suspended
-    _active = None
-    _suspended = 0
+    run = runstate.current()
+    run.manager = None
+    run.suspend = 0
 
 
 def active() -> Optional["CheckpointManager"]:
-    return _active
+    return runstate.current().manager
 
 
 def suspend() -> None:
@@ -97,17 +99,16 @@ def suspend() -> None:
     with their own scheme/stage nor consume its resume state.  The
     facade suspends around nested (non-stream-owning) runs and
     unsuspends in its finally; re-entrant (counted)."""
-    global _suspended
-    _suspended += 1
+    runstate.current().suspend += 1
 
 
 def unsuspend() -> None:
-    global _suspended
-    _suspended = max(0, _suspended - 1)
+    run = runstate.current()
+    run.suspend = max(0, run.suspend - 1)
 
 
 def suspended() -> bool:
-    return _suspended > 0
+    return runstate.current().suspend > 0
 
 
 def create_manager(res_ctx, graph, ctx) -> Optional["CheckpointManager"]:
@@ -164,12 +165,13 @@ def barrier(
     """
     from . import deadline
 
+    run = runstate.current()
     stage_id = stage if level is None else f"{stage}:{int(level)}"
-    if not _suspended:
+    if not run.suspend:
         # nested (suspended) runs neither track stages nor checkpoint —
         # but they DO honor the wind-down verdict below
         deadline.note_stage(stage_id)
-        mgr = _active
+        mgr = run.manager
         if mgr is not None and mgr.enabled:
             from .. import telemetry
 
@@ -208,10 +210,10 @@ def take_resume(scheme: str) -> Optional[dict]:
     (consumed on first take, so a clean-restart re-dispatch cannot
     accidentally resume twice).  Suspended (nested) runs never see it —
     an inner IP replica must not restore the outer run's state."""
-    mgr = _active
-    if mgr is None or _suspended:
+    run = runstate.current()
+    if run.manager is None or run.suspend:
         return None
-    return mgr.take_resume(scheme)
+    return run.manager.take_resume(scheme)
 
 
 # ---------------------------------------------------------------------------

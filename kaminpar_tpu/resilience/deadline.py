@@ -19,9 +19,14 @@ default behavior (a determined Ctrl-C still kills the process; the CLI
 then unwinds open timer scopes and writes an emergency report — see
 cli.py / utils/timer.Timer.unwind).
 
-Module-global by design, like the fault harness and the telemetry
-stream: one deadline governs one process-wide run; ``clear()`` between
-runs (the facade does this) keeps sequential runs independent.
+State model (PR 6): the budget/stop/stage flags live on an explicit
+per-run :class:`~kaminpar_tpu.resilience.runstate.RunState` object, one
+per run, resolved through a thread-local slot — the function API here is
+unchanged, but back-to-back and interleaved runs (the serving layer's
+request stream) can no longer consume each other's verdicts.  Signals
+are process-wide by nature and live in one shared slot that every run's
+``should_stop()`` folds in — a SIGTERM drains *every* run and the
+serving queue, which is exactly the drain contract.
 """
 
 from __future__ import annotations
@@ -30,65 +35,60 @@ import signal
 import time
 from typing import Optional
 
+from . import runstate
+
 #: Default DECLARED wind-down grace on top of the budget: the allowance
 #: the mandatory tail (extension, gate/repair, final checkpoint, report)
 #: is expected to fit.  Advisory — reported in the anytime section so
 #: operators can size preemption windows; the cooperative tail is not
 #: forcibly interrupted.  Overridable via ctx.resilience.budget_grace.
-DEFAULT_GRACE_S = 30.0
+DEFAULT_GRACE_S = runstate.DEFAULT_GRACE_S
 
-_budget_s: Optional[float] = None
-_grace_s: float = DEFAULT_GRACE_S
-_t0: Optional[float] = None
-_deadline: Optional[float] = None
-_stop = False
-_reason = ""
-_stage = ""
-_stage_at_stop = ""
-_announced = False
 _prev_handlers: dict = {}
 
 
 def install_budget(budget_s: float, grace_s: Optional[float] = None) -> None:
-    """Arm a fresh deadline ``budget_s`` seconds from now."""
-    global _budget_s, _grace_s, _t0, _deadline, _stop, _reason, _announced
-    _budget_s = float(budget_s)
-    _grace_s = float(grace_s) if grace_s is not None else DEFAULT_GRACE_S
-    _t0 = time.monotonic()
-    _deadline = _t0 + _budget_s
-    _stop = False
-    _reason = ""
-    _announced = False
+    """Arm a fresh deadline ``budget_s`` seconds from now (on the
+    calling thread's current run)."""
+    run = runstate.current()
+    run.budget_s = float(budget_s)
+    run.grace_s = float(grace_s) if grace_s is not None else DEFAULT_GRACE_S
+    run.t0 = time.monotonic()
+    run.deadline = run.t0 + run.budget_s
+    run.stop = False
+    run.reason = ""
+    run.announced = False
 
 
 def clear() -> None:
-    """Disarm the deadline and any pending stop request (between runs)."""
-    global _budget_s, _t0, _deadline, _stop, _reason, _stage, _announced
-    global _stage_at_stop
-    _budget_s = None
-    _t0 = None
-    _deadline = None
-    _stop = False
-    _reason = ""
-    _stage = ""
-    _stage_at_stop = ""
-    _announced = False
+    """Disarm the deadline and any pending stop request — including a
+    process-wide signal flag (test isolation between runs)."""
+    runstate.begin()
+    runstate.clear_signal()
 
 
 def begin_run(budget_s: Optional[float] = None,
               grace_s: Optional[float] = None) -> None:
-    """Per-run reset used by the facades (shm and dist): clears stale
-    budget/stage state from a previous run, arms a fresh budget when one
-    is configured — but PRESERVES a pending preemption signal.  A
-    SIGTERM that arrived while the graph was still loading must wind
-    down the run that follows, not be silently discarded."""
-    pending = _stop and _reason in ("sigterm", "sigint")
-    reason = _reason
-    clear()
+    """Per-run reset used by the facades (shm and dist): installs a
+    FRESH run state — stale budget/stage/stop state from a previous run
+    is structurally unreachable, not merely cleared — and arms the
+    configured budget.  A pending process-wide preemption signal is
+    deliberately NOT dropped: a SIGTERM that arrived while the graph was
+    still loading must wind down the run that follows."""
+    runstate.begin()
     if budget_s is not None and budget_s > 0:
         install_budget(budget_s, grace_s)
-    if pending:
-        request_stop(reason)
+    sig = runstate.signal_reason()
+    if sig:
+        request_stop(sig)
+
+
+def draining() -> str:
+    """The pending process-wide preemption reason ("" when none) — the
+    serving layer's drain gate: once set, queued requests are rejected
+    with verdict `rejected`/`draining` while the in-flight run finishes
+    its mandatory tail through the normal wind-down."""
+    return runstate.signal_reason()
 
 
 def agreed_stop() -> bool:
@@ -123,80 +123,95 @@ def agreed_stop() -> bool:
 
 def request_stop(reason: str) -> None:
     """Ask the pipeline to wind down at its next barrier (signal handlers,
-    tests).  Safe to call from a signal handler: sets flags only."""
-    global _stop, _reason
-    if not _stop:
-        _stop = True
-        _reason = reason
+    tests, the serving drain).  Safe to call from a signal handler: sets
+    flags only.  Signal-shaped reasons are recorded process-wide (every
+    run and the serving queue observe them); anything else stops only
+    the calling thread's current run."""
+    if reason in ("sigterm", "sigint", "draining"):
+        runstate.signal_stop(reason)
+    run = runstate.current()
+    if not run.stop:
+        run.stop = True
+        run.reason = reason
 
 
 def should_stop() -> bool:
-    """True once the budget has expired or a stop was requested.  The
-    first True transition emits a ``deadline`` telemetry event and a log
-    line (once), so the wind-down is visible in the run report."""
-    global _stop, _reason, _announced, _stage_at_stop
-    if not _stop and _deadline is not None and time.monotonic() >= _deadline:
-        _stop = True
-        _reason = _reason or "budget"
-    if _stop and not _announced:
-        _announced = True
-        _stage_at_stop = _stage  # where the wind-down actually began
-        _announce()
-    return _stop
+    """True once the budget has expired, a stop was requested, or a
+    process-wide preemption signal is pending.  The first True
+    transition emits a ``deadline`` telemetry event and a log line
+    (once), so the wind-down is visible in the run report."""
+    run = runstate.current()
+    if not run.stop:
+        sig = runstate.signal_reason()
+        if sig:
+            run.stop = True
+            run.reason = sig
+        elif run.deadline is not None and time.monotonic() >= run.deadline:
+            run.stop = True
+            run.reason = run.reason or "budget"
+    if run.stop and not run.announced:
+        run.announced = True
+        run.stage_at_stop = run.stage  # where the wind-down actually began
+        _announce(run)
+    return run.stop
 
 
-def _announce() -> None:
+def _announce(run) -> None:
     from .. import telemetry
     from ..utils.logger import log_warning
 
     telemetry.event(
         "deadline",
-        reason=_reason,
-        stage=_stage or None,
-        budget_s=_budget_s,
-        elapsed_s=None if _t0 is None else round(time.monotonic() - _t0, 3),
+        reason=run.reason,
+        stage=run.stage or None,
+        budget_s=run.budget_s,
+        elapsed_s=(
+            None if run.t0 is None
+            else round(time.monotonic() - run.t0, 3)
+        ),
     )
     log_warning(
-        f"deadline: winding down ({_reason}) at stage "
-        f"'{_stage or 'start'}' — finishing mandatory work only"
+        f"deadline: winding down ({run.reason}) at stage "
+        f"'{run.stage or 'start'}' — finishing mandatory work only"
     )
 
 
 def triggered() -> bool:
     """True when the run wound down early (deadline or stop request)."""
-    return _stop
+    return runstate.current().stop
 
 
 def note_stage(stage: str) -> None:
     """Record the deepest pipeline stage reached (barrier bookkeeping;
     the `anytime` annotation reports it)."""
-    global _stage
-    _stage = stage
+    runstate.current().stage = stage
 
 
 def stage_reached() -> str:
-    return _stage
+    return runstate.current().stage
 
 
 def state() -> dict:
     """The run report's `anytime` section for a wound-down run (None
     values are omitted so the section validates against the schema's
     typed optional properties)."""
+    run = runstate.current()
     d = {
-        "anytime": bool(_stop),
-        "reason": _reason or None,
-        "stage": _stage_at_stop or _stage or None,
-        "budget_s": _budget_s,
-        "grace_s": _grace_s if _budget_s is not None else None,
+        "anytime": bool(run.stop),
+        "reason": run.reason or None,
+        "stage": run.stage_at_stop or run.stage or None,
+        "budget_s": run.budget_s,
+        "grace_s": run.grace_s if run.budget_s is not None else None,
         "elapsed_s": (
-            None if _t0 is None else round(time.monotonic() - _t0, 3)
+            None if run.t0 is None
+            else round(time.monotonic() - run.t0, 3)
         ),
     }
     return {k: v for k, v in d.items() if v is not None or k == "anytime"}
 
 
 def grace_s() -> float:
-    return _grace_s
+    return runstate.current().grace_s
 
 
 def install_signal_handlers() -> None:

@@ -107,6 +107,28 @@ class CheckpointMismatch(DegradationError):
     breaker_relevant = False
 
 
+class AdmissionRejected(DegradationError):
+    """The serving layer's admission controller refused a request —
+    queue depth or estimated-cost cap exceeded, a draining service, an
+    open per-request-class breaker, or an injected `serving-admit`
+    fault.  Fallback: a structured `rejected` verdict for that request;
+    the service keeps serving.  A policy decision, not a fault: does
+    not advance the circuit breaker."""
+
+    breaker_relevant = False
+
+
+class CacheDegraded(DegradationError):
+    """A bounded-cache lookup was forced to miss (or an entry forcibly
+    evicted) — today only via the `serving-cache` injection site; a
+    future persistent cache backend would surface real read failures
+    the same way.  Fallback: recompute the request.  Correctness is
+    untouched (caches are an optimization), so the breaker ignores it.
+    """
+
+    breaker_relevant = False
+
+
 class DeviceOOM(DegradationError):
     """The accelerator (or host, for MemoryError) ran out of memory in an
     optional fast path.  Fallback: the path's smaller-footprint twin

@@ -33,6 +33,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Type
 
 from .errors import (
+    AdmissionRejected,
+    CacheDegraded,
     CheckpointCorrupt,
     CheckpointWriteFailed,
     CollectiveTimeout,
@@ -117,6 +119,17 @@ _register(SiteSpec(
     "previous manifest generation (one barrier of progress lost)",
     "snapshot read + checksum validation on --resume "
     "(resilience/checkpoint.py)",
+))
+_register(SiteSpec(
+    "serving-admit", AdmissionRejected,
+    "structured `rejected` verdict for that request (service keeps "
+    "serving)",
+    "serving-layer request admission (serving/service.py)",
+))
+_register(SiteSpec(
+    "serving-cache", CacheDegraded,
+    "forced miss/evict: the request recomputes (correctness untouched)",
+    "serving-layer result-cache lookup (serving/service.py)",
 ))
 
 

@@ -1,0 +1,117 @@
+"""The per-run resilience state object (the stream-owning-run gate).
+
+PR 5 kept the checkpoint manager, the suspend counter, and the deadline
+budget/stop flags as module globals in ``checkpoint.py`` / ``deadline.py``
+— correct for the one-shot CLI process model, but a concurrency hazard
+for a multi-request service: two back-to-back runs sharing one process
+could consume each other's resume state or stop verdicts, and two
+*interleaved* runs (service worker threads) would race on the same
+flags outright.
+
+This module moves all of that state onto an explicit :class:`RunState`
+object, one per run, held in a ``threading.local`` slot.  The public
+function APIs of ``resilience.deadline`` and ``resilience.checkpoint``
+are unchanged — every ``should_stop()`` / ``barrier()`` / ``activate()``
+call resolves the *current thread's* run state — so the drivers did not
+have to change.  What changed structurally:
+
+  * ``deadline.begin_run`` installs a **fresh** RunState instead of
+    mutating shared globals: a later run can never observe an earlier
+    run's stop verdict, stage bookkeeping, or checkpoint resume state,
+    because the earlier run's object is simply no longer reachable.
+  * Preemption **signals** (SIGTERM/SIGINT) are process-wide by nature
+    and outlive run boundaries, so they live in one lock-guarded
+    process-global slot here.  ``should_stop()`` folds it in: every
+    run in every thread observes a delivered signal (this is exactly
+    the serving layer's drain semantics), while run-local stop reasons
+    (budget expiry, ``stop-at`` test hooks, peer agreement) stay
+    run-local.  ``clear()`` drops both (test isolation); ``begin_run``
+    preserves the signal — a SIGTERM that arrives while the graph is
+    still loading must wind down the run that follows (PR-5 contract).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+#: Default DECLARED wind-down grace on top of the budget (see
+#: deadline.py, which re-exports it as its own DEFAULT_GRACE_S).
+DEFAULT_GRACE_S = 30.0
+
+
+class RunState:
+    """All resilience state owned by ONE run: the armed deadline budget,
+    the cooperative stop verdict, the deepest-stage bookkeeping, and the
+    active checkpoint manager (+ the nested-run suspend counter)."""
+
+    __slots__ = (
+        "budget_s", "grace_s", "t0", "deadline", "stop", "reason",
+        "stage", "stage_at_stop", "announced", "manager", "suspend",
+    )
+
+    def __init__(self) -> None:
+        # deadline half (resilience/deadline.py)
+        self.budget_s: Optional[float] = None
+        self.grace_s: float = DEFAULT_GRACE_S
+        self.t0: Optional[float] = None
+        self.deadline: Optional[float] = None
+        self.stop: bool = False
+        self.reason: str = ""
+        self.stage: str = ""
+        self.stage_at_stop: str = ""
+        self.announced: bool = False
+        # checkpoint half (resilience/checkpoint.py)
+        self.manager = None  # Optional[CheckpointManager]
+        self.suspend: int = 0
+
+
+_tls = threading.local()
+
+#: Process-wide preemption signal ("sigterm" / "sigint" / "" ).  Set by
+#: the signal handlers (and by the serving layer's drain request); read
+#: by every run's should_stop().  Deliberately UNLOCKED: signal_stop
+#: runs inside a signal handler, where acquiring a mutex the
+#: interrupted thread may hold would self-deadlock — single str
+#: assignments/reads are atomic under the GIL, and the only writer race
+#: (a signal arriving concurrently with a deliberate clear_signal) is
+#: an inherently ambiguous ordering either way.
+_signal_reason = ""
+
+
+def current() -> RunState:
+    """This thread's run state (created on first touch, so library use
+    without an explicit begin_run still has somewhere to keep flags)."""
+    run = getattr(_tls, "run", None)
+    if run is None:
+        run = _tls.run = RunState()
+    return run
+
+
+def begin() -> RunState:
+    """Install a FRESH RunState for this thread and return it.  The
+    previous run's object (if any) is abandoned unreferenced — its stop
+    verdict, stage bookkeeping, checkpoint manager, and resume state are
+    structurally unreachable from the new run."""
+    run = RunState()
+    _tls.run = run
+    return run
+
+
+def signal_stop(reason: str) -> None:
+    """Record a process-wide preemption signal (async-signal-safe: one
+    assignment).  Every run in every thread observes it."""
+    global _signal_reason
+    if not _signal_reason:
+        _signal_reason = reason
+
+
+def signal_reason() -> str:
+    """The pending process-wide preemption reason ("" when none)."""
+    return _signal_reason
+
+
+def clear_signal() -> None:
+    """Drop the process-wide signal flag (tests; deadline.clear)."""
+    global _signal_reason
+    _signal_reason = ""

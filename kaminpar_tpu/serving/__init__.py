@@ -1,0 +1,37 @@
+"""Partitioning-as-a-service: admission-controlled request queue with
+per-request fault isolation and bounded caches.
+
+ROADMAP item 2's workload — thousands of small-to-mid graphs per minute
+at varying (k, eps) — needs a process model the one-shot facade never
+had: many requests per process, one bad request failing *alone*, and
+caches that stay bounded under sustained traffic.  This package
+composes the PR 3–5 resilience primitives into that layer:
+
+  * :class:`~kaminpar_tpu.serving.service.PartitionService` — a bounded
+    request queue with admission control (queue-depth + estimated-cost
+    caps; overload yields a structured ``rejected`` verdict, never an
+    unbounded queue), per-request fault isolation (a malformed graph or
+    a ``DeviceOOM`` fails that request with a schema-valid error record
+    while the service keeps serving), a per-request-class circuit
+    breaker, per-request deadlines arming the PR-5 anytime budget, and
+    SIGTERM draining through the existing wind-down;
+  * a **result cache** (:class:`kaminpar_tpu.caching.BoundedCache`)
+    keyed by the PR-5 (graph fingerprint, ctx fingerprint) pair, with
+    entry caps and byte-budget eviction, plus executable-bucket reuse
+    accounting (:class:`kaminpar_tpu.caching.BucketTracker`) — cache
+    hit-rate is a first-class report/bench metric;
+  * the run report's ``serving`` section (schema v4): every request's
+    verdict — ``served`` / ``anytime`` / ``degraded`` / ``rejected`` /
+    ``failed`` — plus admission and cache statistics.
+
+CLI surface: ``python -m kaminpar_tpu --serve-batch BATCH.json``
+(serving/batch.py).  Operator contract: docs/robustness.md.
+"""
+
+from .service import (  # noqa: F401
+    PartitionRequest,
+    PartitionService,
+    RequestRecord,
+    ServiceConfig,
+    VERDICTS,
+)

@@ -1,0 +1,590 @@
+"""The PartitionService: bounded queue, admission control, isolation.
+
+Execution model: requests are *submitted* (admission-controlled, cheap)
+and then *executed* serially on the caller's thread — the accelerator is
+one device, so the concurrency control is the bounded queue and the
+admission policy, not a thread pool.  Every ``compute_partition`` call
+runs inside a fault-isolation boundary: classified failures
+(``resilience.errors``), malformed inputs (``io.GraphFormatError``), and
+parameter errors produce a structured ``failed``/``rejected`` record for
+*that request*; the service keeps serving.  Only genuinely
+process-fatal conditions (``KeyboardInterrupt``, ``SystemExit``, the
+checkpoint suite's ``SimulatedPreemption``) propagate.
+
+Isolation guarantees (regression-tested in tests/test_serving.py):
+
+  * resilience state is per-run by construction — each request's
+    deadline/checkpoint state lives on a fresh
+    :class:`~kaminpar_tpu.resilience.runstate.RunState`, so request N
+    can neither consume request N-1's resume state nor inherit its stop
+    verdict;
+  * per-request contexts are deep copies of the service's base context
+    with the checkpoint/resume knobs cleared — the serving result cache
+    is the durability story here, and two requests can never share a
+    manifest;
+  * repeated crash-shaped failures in one request *class* (the
+    executable bucket, i.e. padded (n, m, k)) open a per-class breaker:
+    later requests of that class are rejected at admission instead of
+    re-poisoning the device, while other classes keep serving.
+
+Draining: a process-wide preemption signal (SIGTERM/SIGINT via the CLI
+handlers, or :meth:`PartitionService.drain`) flips the service into
+drain mode — the in-flight request finishes its mandatory tail through
+the PR-5 wind-down (verdict ``anytime``), queued requests are rejected
+with reason ``draining``, and every verdict still lands in the report.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import caching, telemetry
+from ..resilience import errors as res_errors
+from ..resilience import deadline as deadline_mod
+from ..resilience import with_fallback
+from ..resilience.policy import BREAKER_THRESHOLD
+
+#: The verdict taxonomy, in severity order (docs/robustness.md).
+VERDICTS = ("served", "anytime", "degraded", "rejected", "failed")
+
+#: Estimated cost (work units ~ n + m) assumed for a request whose input
+#: cannot be sized without loading it (an opaque file path).
+DEFAULT_COST = 1_000_000.0
+
+
+@dataclass
+class PartitionRequest:
+    """One unit of service work: a graph source plus (k, eps) and QoS.
+
+    ``graph`` may be a loaded HostGraph/CompressedHostGraph, a
+    ``gen:...`` generator spec, or a file path (loaded inside the
+    request's isolation boundary — a malformed file fails the request,
+    not the submit call)."""
+
+    graph: Any
+    k: int
+    epsilon: float = 0.03
+    deadline_s: Optional[float] = None  # per-request anytime budget
+    priority: int = 0  # higher runs first
+    seed: Optional[int] = None
+    request_id: str = ""
+
+    _counter = itertools.count(1)
+
+    def __post_init__(self) -> None:
+        if not self.request_id:
+            self.request_id = f"req-{next(self._counter)}"
+
+
+@dataclass
+class RequestRecord:
+    """One request's verdict — the row that lands in the run report's
+    ``serving.requests`` array (and, for rejected requests, the whole
+    story: nothing else ever ran)."""
+
+    request_id: str
+    verdict: str  # one of VERDICTS
+    reason: str = ""  # rejection/failure/anytime reason
+    error: str = ""  # structured error type for failed requests
+    detail: str = ""  # truncated error message
+    k: int = 0
+    n: int = -1  # -1: input never resolved (rejected before load)
+    m: int = -1
+    cut: int = -1
+    imbalance: float = 0.0
+    feasible: bool = False
+    gate_valid: Optional[bool] = None
+    cached: bool = False
+    bucket: str = ""  # executable bucket key "n_pad/m_pad/k_pad"
+    degraded_sites: List[str] = field(default_factory=list)
+    wall_s: float = 0.0
+    partition: Optional[np.ndarray] = None  # library callers only
+
+    def to_dict(self) -> dict:
+        d = {
+            "request_id": self.request_id,
+            "verdict": self.verdict,
+            "k": int(self.k),
+            "n": int(self.n),
+            "m": int(self.m),
+            "cut": int(self.cut),
+            "imbalance": float(self.imbalance),
+            "feasible": bool(self.feasible),
+            "cached": bool(self.cached),
+            "wall_s": round(float(self.wall_s), 4),
+        }
+        for key in ("reason", "error", "detail", "bucket"):
+            v = getattr(self, key)
+            if v:
+                d[key] = v
+        if self.gate_valid is not None:
+            d["gate_valid"] = bool(self.gate_valid)
+        if self.degraded_sites:
+            d["degraded_sites"] = list(self.degraded_sites)
+        return d
+
+
+@dataclass
+class ServiceConfig:
+    """Admission + cache policy knobs (docs/robustness.md)."""
+
+    max_queue_depth: int = 64
+    #: total estimated work units (~ n + m) admitted but not yet run
+    max_queued_cost: float = 5e7
+    #: a single request larger than this is rejected outright
+    max_request_cost: float = 2.5e7
+    result_cache_entries: int = 128
+    result_cache_bytes: int = 256 << 20
+    #: default per-request budget when the request carries none (0: none)
+    default_deadline_s: float = 0.0
+    #: consecutive crash-shaped failures before a request class is
+    #: rejected at admission (mirrors the site breaker threshold)
+    breaker_threshold: int = BREAKER_THRESHOLD
+    #: keep partitions on the records (library callers; the CLI drops
+    #: them — a 16-request batch of 1M-node graphs is 64 MB of labels)
+    keep_partitions: bool = False
+
+
+class PartitionService:
+    """Admission-controlled, fault-isolated partitioning service."""
+
+    def __init__(self, ctx: Any = "default",
+                 config: Optional[ServiceConfig] = None,
+                 quiet: bool = True) -> None:
+        from ..context import Context
+        from ..presets import create_context_by_preset_name
+
+        if isinstance(ctx, str):
+            ctx = create_context_by_preset_name(ctx)
+        assert isinstance(ctx, Context)
+        self.base_ctx = ctx
+        self.config = config or ServiceConfig()
+        self.quiet = quiet
+        # guards the queue/bookkeeping maps so concurrent submit()
+        # producers are safe; execution itself stays serial and unlocked
+        self._lock = threading.Lock()
+        self._queue: List[PartitionRequest] = []
+        self._queued_cost: Dict[str, float] = {}
+        self._records: List[RequestRecord] = []
+        self._seq = itertools.count()
+        self._order: Dict[str, int] = {}  # request_id -> FIFO tiebreak
+        self._submit_class: Dict[str, str] = {}  # id -> admission class
+        self._admission_rejected = 0  # excludes drain-time rejections
+        self._result_cache = caching.BoundedCache(
+            max_entries=self.config.result_cache_entries,
+            max_bytes=self.config.result_cache_bytes,
+        )
+        self._buckets = caching.BucketTracker()
+        # per-request-class (executable bucket) crash counters
+        self._class_failures: Dict[str, int] = {}
+        self._drained = False
+
+    # -- admission -----------------------------------------------------
+
+    def _estimate(self, req: PartitionRequest):
+        """(cost, n, m) for admission; n/m are -1 when unknown without
+        loading the input (opaque file path)."""
+        g = req.graph
+        if hasattr(g, "n") and hasattr(g, "m"):
+            return float(g.n + g.m), int(g.n), int(g.m)
+        if isinstance(g, str) and g.startswith("gen:"):
+            try:
+                from ..graphs.factories import parse_gen_spec
+
+                _, kw = parse_gen_spec(g)
+                n = int(kw.get("n") or (
+                    int(kw.get("x", 1)) * int(kw.get("y", 1))
+                    * int(kw.get("z", 1))
+                ))
+                m = int(kw.get("m") or n * float(kw.get("avg_degree", 8)))
+                return float(n + m), n, m
+            except Exception:
+                return DEFAULT_COST, -1, -1
+        if isinstance(g, str):
+            try:
+                import os
+
+                return max(float(os.path.getsize(g)) / 8.0, 1.0), -1, -1
+            except OSError:
+                return DEFAULT_COST, -1, -1
+        return DEFAULT_COST, -1, -1
+
+    def _class_key(self, n: int, m: int, k: int) -> str:
+        if n < 0:
+            return "unsized"
+        return "/".join(str(x) for x in caching.bucket_key(n, m, k))
+
+    def _admission_reason(self, req: PartitionRequest,
+                          cost: float, cls: str) -> str:
+        """First violated admission rule, or "" to admit.  The injected
+        `serving-admit` fault routes through the policy wrapper so the
+        chaos suite sees the standard `degraded` event."""
+        admitted = with_fallback(
+            lambda: True, lambda exc: False,
+            site="serving-admit", where=req.request_id,
+        )
+        if not admitted:
+            return "fault-injected"
+        if deadline_mod.draining():
+            return "draining"
+        if req.k is None or int(req.k) < 1:
+            return "invalid-parameters"
+        if req.request_id in self._queued_cost:
+            # a pending duplicate would corrupt the cost/FIFO maps keyed
+            # by request_id; completed ids may be reused (re-submission)
+            return "duplicate-id"
+        if len(self._queue) >= self.config.max_queue_depth:
+            return "queue-full"
+        if cost > self.config.max_request_cost:
+            return "request-too-large"
+        if sum(self._queued_cost.values()) + cost > self.config.max_queued_cost:
+            return "cost-cap"
+        if self._class_failures.get(cls, 0) >= self.config.breaker_threshold:
+            return "breaker-open"
+        return ""
+
+    def submit(self, req: PartitionRequest) -> Optional[RequestRecord]:
+        """Admission-check one request.  Returns the ``rejected`` record
+        when the request is refused (already appended to the batch
+        records); None when it was queued."""
+        cost, n, m = self._estimate(req)
+        cls = self._class_key(n, m, int(req.k or 0))
+        with self._lock:
+            reason = self._admission_reason(req, cost, cls)
+            if reason:
+                rec = RequestRecord(
+                    request_id=req.request_id, verdict="rejected",
+                    reason=reason, k=int(req.k or 0), n=n, m=m,
+                )
+                self._records.append(rec)
+                self._admission_rejected += 1
+                depth = len(self._queue)
+            else:
+                self._queue.append(req)
+                self._queued_cost[req.request_id] = cost
+                self._order[req.request_id] = next(self._seq)
+                self._submit_class[req.request_id] = cls
+                rec = None
+        if rec is not None:
+            telemetry.event(
+                "serving", action="rejected", request=req.request_id,
+                reason=reason, queue_depth=depth,
+            )
+        return rec
+
+    # -- execution -----------------------------------------------------
+
+    def run_pending(self) -> List[RequestRecord]:
+        """Execute the queue serially (priority desc, then FIFO).  A
+        drain signal observed between requests rejects the remainder;
+        the batch always returns one record per request."""
+        done: List[RequestRecord] = []
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                self._queue.sort(
+                    key=lambda r: (-r.priority, self._order[r.request_id])
+                )
+                req = self._queue.pop(0)
+                self._queued_cost.pop(req.request_id, None)
+                self._order.pop(req.request_id, None)
+                cls_submit = self._submit_class.pop(req.request_id, "")
+            if deadline_mod.draining():
+                self._drained = True
+                rec = RequestRecord(
+                    request_id=req.request_id, verdict="rejected",
+                    reason="draining", k=int(req.k or 0),
+                )
+            else:
+                rec = self._execute(req, cls_submit)
+            with self._lock:
+                self._records.append(rec)
+            done.append(rec)
+        return done
+
+    def serve(self, requests) -> List[RequestRecord]:
+        """Drive a whole batch: submit() each request, draining the
+        queue whenever the next submission would trip the queue-depth or
+        aggregate-cost cap — a batch is ONE producer, so backpressure
+        means "run what is queued first", not "reject the tail" (the
+        caps still reject outright for concurrent submit() producers and
+        for single oversized requests).  Returns this batch's records
+        (admission rejections included, in order)."""
+        start = len(self._records)
+        for req in requests:
+            if self._queue and self._would_overflow(req):
+                self.run_pending()
+            self.submit(req)
+        self.run_pending()
+        return self._records[start:]
+
+    def _would_overflow(self, req: PartitionRequest) -> bool:
+        cost, _, _ = self._estimate(req)
+        with self._lock:
+            return (
+                len(self._queue) >= self.config.max_queue_depth
+                or sum(self._queued_cost.values()) + cost
+                > self.config.max_queued_cost
+            )
+
+    def _resolve_graph(self, source):
+        """Load/generate the input INSIDE the isolation boundary."""
+        if isinstance(source, str):
+            if source.startswith("gen:"):
+                from ..graphs.factories import generate
+
+                return generate(source)
+            from .. import io as io_mod
+
+            return io_mod.load_graph(source)
+        if not (hasattr(source, "n") and hasattr(source, "m")):
+            raise res_errors.AdmissionRejected(
+                f"request graph is neither a graph object nor a "
+                f"path/spec string: {type(source).__name__}"
+            )
+        return source
+
+    def _request_ctx(self, req: PartitionRequest):
+        """Per-request context: the base tree deep-copied, resilience
+        re-scoped to this request (no cross-request checkpoint state;
+        the per-request deadline arms the PR-5 anytime budget)."""
+        ctx = self.base_ctx.copy()
+        ctx.resilience.checkpoint_dir = ""
+        ctx.resilience.resume = False
+        budget = (
+            req.deadline_s if req.deadline_s is not None
+            else self.config.default_deadline_s
+        )
+        ctx.resilience.time_budget = float(budget or 0.0)
+        if req.seed is not None:
+            ctx.seed = int(req.seed)
+        # stamp the partition target so the ctx fingerprint (and with it
+        # the result-cache key) covers (k, eps) before setup runs
+        ctx.partition.k = int(req.k)
+        ctx.partition.epsilon = float(req.epsilon)
+        return ctx
+
+    def _cache_lookup(self, key, req: PartitionRequest,
+                      pre_degraded: List[str]):
+        """Result-cache get through the `serving-cache` site: an
+        injected fault forces a miss AND evicts the key (both documented
+        degradation modes at once — deterministic for the chaos suite).
+        The engaged site is recorded in ``pre_degraded`` because the
+        facade resets the telemetry stream at compute entry — the event
+        emitted here would otherwise vanish before the verdict is cut.
+        """
+        def forced_miss(exc):
+            self._result_cache.evict(key)
+            pre_degraded.append("serving-cache")
+            return None
+
+        return with_fallback(
+            lambda: self._result_cache.get(key), forced_miss,
+            site="serving-cache", where=req.request_id,
+        )
+
+    def _execute(self, req: PartitionRequest,
+                 cls_submit: str = "") -> RequestRecord:
+        from ..kaminpar import KaMinPar
+        from ..resilience.checkpoint import SimulatedPreemption
+        from ..utils.logger import OutputLevel
+
+        t0 = time.perf_counter()
+        rec = RequestRecord(
+            request_id=req.request_id, verdict="failed", k=int(req.k),
+        )
+        cls = cls_submit or "unsized"
+        pre_degraded: List[str] = []
+        try:
+            graph = self._resolve_graph(req.graph)
+            rec.n, rec.m = int(graph.n), int(graph.m)
+            ctx = self._request_ctx(req)
+            key = caching.result_cache_key(graph, ctx)
+            cached = self._cache_lookup(key, req, pre_degraded)
+            if cached is not None:
+                part, metrics = cached
+                rec.verdict = "served"
+                rec.cached = True
+                rec.cut = int(metrics["cut"])
+                rec.imbalance = float(metrics["imbalance"])
+                rec.feasible = bool(metrics["feasible"])
+                rec.gate_valid = metrics.get("gate_valid")
+                rec.partition = part if self.config.keep_partitions else None
+                rec.wall_s = time.perf_counter() - t0
+                telemetry.event(
+                    "serving", action="cache-hit", request=req.request_id,
+                )
+                return rec
+            bucket = self._buckets.observe(rec.n, rec.m, int(req.k))
+            rec.bucket = "/".join(str(x) for x in bucket)
+            cls = self._class_key(rec.n, rec.m, int(req.k))
+
+            solver = KaMinPar(ctx)
+            if self.quiet:
+                solver.set_output_level(OutputLevel.QUIET)
+            solver.set_graph(graph)
+            part = solver.compute_partition(
+                k=int(req.k), epsilon=float(req.epsilon), seed=req.seed,
+            )
+        except (KeyboardInterrupt, SystemExit, SimulatedPreemption):
+            raise  # process-fatal by contract; never a request verdict
+        except BaseException as exc:  # the isolation boundary
+            err = res_errors.classify(exc, site="")
+            rec.verdict = "failed"
+            rec.error = type(err if err is not None else exc).__name__
+            rec.detail = str(exc)[:300]
+            rec.reason = (
+                "malformed-input" if _input_shaped(exc) else "exception"
+            )
+            rec.wall_s = time.perf_counter() - t0
+            # crash-shaped failures advance the request-class breaker;
+            # refusal-shaped degradations (breaker_relevant=False) and
+            # malformed inputs do not — a bad file says nothing about
+            # the next request of the same shape.  Latched under BOTH
+            # the resolved executable bucket and the admission-time
+            # estimate class (for file-backed inputs those differ:
+            # admission can only see "unsized" without loading the
+            # file), so the admission check — which can only ever
+            # compute the estimate class — actually observes the count.
+            crash = (
+                err.breaker_relevant if err is not None
+                else not _input_shaped(exc)
+            )
+            if crash:
+                for c in {cls, cls_submit} - {""}:
+                    self._class_failures[c] = (
+                        self._class_failures.get(c, 0) + 1
+                    )
+            telemetry.event(
+                "serving", action="failed", request=req.request_id,
+                error=rec.error, reason=rec.reason,
+            )
+            from ..utils.logger import log_warning
+
+            log_warning(
+                f"serving[{req.request_id}]: request failed in isolation "
+                f"({rec.error}: {rec.detail[:120]}); service continues"
+            )
+            return rec
+
+        # success path: harvest the per-request telemetry (the facade
+        # reset the stream at compute entry, so everything in it belongs
+        # to this request)
+        for c in {cls, cls_submit} - {""}:
+            self._class_failures.pop(c, None)
+        metrics = solver.result_metrics(graph, part)
+        rec.cut = int(metrics["cut"])
+        rec.imbalance = float(metrics["imbalance"])
+        rec.feasible = bool(metrics["feasible"])
+        gate = telemetry.run_info().get("output_gate")
+        if isinstance(gate, dict) and gate.get("checked"):
+            rec.gate_valid = bool(gate.get("valid"))
+        rec.degraded_sites = sorted(({
+            e.attrs.get("site", "") for e in telemetry.events("degraded")
+        } | set(pre_degraded)) - {""})
+        anytime = solver.last_anytime
+        if anytime:
+            rec.verdict = "anytime"
+            rec.reason = str(anytime.get("reason") or "")
+            if rec.reason in ("sigterm", "sigint", "draining"):
+                self._drained = True
+        elif rec.degraded_sites:
+            rec.verdict = "degraded"
+        else:
+            rec.verdict = "served"
+        rec.partition = part if self.config.keep_partitions else None
+        rec.wall_s = time.perf_counter() - t0
+        if rec.verdict == "served" and rec.feasible:
+            # only clean full-effort results are worth replaying; an
+            # anytime/degraded answer must not be served to a request
+            # that had the time to do better
+            self._result_cache.put(
+                key,
+                (np.asarray(part), {**metrics,
+                                    "gate_valid": rec.gate_valid}),
+                nbytes=np.asarray(part).nbytes,
+            )
+        return rec
+
+    # -- drain / reporting ---------------------------------------------
+
+    def drain(self, reason: str = "draining") -> None:
+        """Programmatic drain: queued requests will be rejected with
+        ``draining``; an in-flight run winds down at its next barrier
+        (the SIGTERM handlers reach the same state process-wide)."""
+        deadline_mod.request_stop(reason)
+
+    @property
+    def records(self) -> List[RequestRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def reset_records(self) -> List[RequestRecord]:
+        """Detach and return the accumulated verdict records (with the
+        admission-rejection counter).  The records list is the report
+        surface — every verdict must land in a report — so it is never
+        pruned implicitly; a long-lived service exports a report per
+        batch window and then resets, which bounds host memory under
+        sustained traffic.  Cache/bucket/breaker state is kept."""
+        with self._lock:
+            out = self._records
+            self._records = []
+            self._admission_rejected = 0
+        return out
+
+    def result_cache_stats(self) -> dict:
+        return self._result_cache.stats()
+
+    def summary(self) -> dict:
+        """The run report's ``serving`` section (schema v4)."""
+        with self._lock:
+            records = list(self._records)
+            admission_rejected = self._admission_rejected
+        counts = {v: 0 for v in VERDICTS}
+        for rec in records:
+            counts[rec.verdict] = counts.get(rec.verdict, 0) + 1
+        result_stats = self._result_cache.stats()
+        return {
+            "enabled": True,
+            "requests": [r.to_dict() for r in records],
+            "counts": counts,
+            "admission": {
+                "max_queue_depth": self.config.max_queue_depth,
+                "max_queued_cost": float(self.config.max_queued_cost),
+                "max_request_cost": float(self.config.max_request_cost),
+                # drain-time rejections carry the same verdict but never
+                # passed admission; this counter is admission's alone
+                "rejected": admission_rejected,
+            },
+            "cache": {
+                "result": result_stats,
+                "executable": self._buckets.stats(),
+                "hit_rate": result_stats["hit_rate"],
+            },
+            "drained": bool(self._drained),
+        }
+
+    def annotate(self) -> dict:
+        """Stamp the serving section into the telemetry run info (call
+        AFTER the last request — compute_partition resets the stream at
+        entry) and return it."""
+        s = self.summary()
+        telemetry.annotate(serving=s)
+        return s
+
+
+def _input_shaped(exc: BaseException) -> bool:
+    """Failures that indict the request's INPUT, not the process or the
+    request class: format errors, missing files, bad parameters."""
+    from ..io import GraphFormatError
+
+    return isinstance(
+        exc, (GraphFormatError, ValueError, OSError, KeyError, TypeError)
+    ) and not isinstance(exc, res_errors.DegradationError)

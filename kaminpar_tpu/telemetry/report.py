@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -113,6 +113,10 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # neither
     ckpt_summary = info.pop("checkpoint", {"enabled": False})
     anytime = info.pop("anytime", {"anytime": False})
+    # schema v4: the serving layer's per-request verdicts + admission
+    # and cache statistics (serving/service.py); single-shot runs carry
+    # the well-formed disabled default
+    serving = info.pop("serving", {"enabled": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -195,6 +199,10 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # and whether the run wound down early under a deadline/signal
         "checkpoint": ckpt_summary,
         "anytime": anytime,
+        # schema v4: partitioning-as-a-service — every request's verdict
+        # (served/anytime/degraded/rejected/failed), admission caps, and
+        # the bounded result/executable cache hit rates
+        "serving": serving,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

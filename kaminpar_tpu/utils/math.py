@@ -24,13 +24,10 @@ def round_up(x: int, multiple: int) -> int:
     return ceil_div(x, multiple) * multiple
 
 
-def pad_size(x: int, granularity: int = 256) -> int:
-    """Shape-bucketed padding: next power of two, but at least x rounded up to
-    `granularity`.  Bounds the number of distinct compiled shapes per graph to
-    O(log n) as the multilevel hierarchy shrinks the graph ~2x per level."""
-    if x <= granularity:
-        return granularity
-    return ceil2(x)
+# The shape-bucket padding policy moved to kaminpar_tpu.caching (the
+# shared bucketing + bounded-cache policy module, ROADMAP item 5);
+# re-exported here for its historical callers.
+from ..caching import pad_size  # noqa: F401,E402
 
 
 def split_integral(total: int, ratio: float) -> tuple[int, int]:

@@ -70,6 +70,11 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
     parsed = entry.get("parsed") or {}
     report = parsed.get("report") or {}
     compile_totals = report.get("compile", {}).get("totals", {})
+    # v4 reports from a serving run carry the bounded-cache hit rate —
+    # the first-class serving metric alongside cut/seconds (rounds
+    # without a serving section show "-")
+    serving = report.get("serving") or {}
+    cache_hit = (serving.get("cache") or {}).get("hit_rate")
     return {
         "round": os.path.basename(path),
         "rc": entry.get("rc"),
@@ -79,6 +84,7 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "coarsening_s": parsed.get("lp_coarsening_seconds"),
         "platform": parsed.get("platform"),
         "compile_s": compile_totals.get("compile_s"),
+        "cache_hit": cache_hit,
         "schema": report.get("schema_version"),
     }
 
@@ -93,7 +99,8 @@ def _fmt(v: Optional[Any]) -> str:
 
 def render(rows: List[Dict[str, Any]]) -> str:
     cols = ("round", "rc", "cut", "vs_baseline", "total_s",
-            "coarsening_s", "compile_s", "platform", "schema")
+            "coarsening_s", "compile_s", "cache_hit", "platform",
+            "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [

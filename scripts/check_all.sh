@@ -5,8 +5,11 @@
 # telemetry.diff regression-gate self-test + BENCH-trend check, a
 # preempt-and-resume smoke (SIGTERM an rgg2d run mid-pipeline, resume
 # from the checkpoint, assert gate-valid + anytime/checkpoint report
-# sections), and the ROADMAP.md tier-1 pytest command.  Exits nonzero
-# on the first failing stage.
+# sections), a serving smoke (16-request batch with one poisoned graph,
+# fault injection, a tight per-request deadline, repeated shapes for
+# cache hits, and a SIGTERM mid-batch drain — all verdicts in one
+# schema-valid report), and the ROADMAP.md tier-1 pytest command.
+# Exits nonzero on the first failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
 #         --fast skips the tier-1 pytest stage (lint + schema + chaos
@@ -17,13 +20,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/6] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/7] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/6] run-report schema (producer selftest, v1/v2 fixtures + v3 producer) =="
+echo "== [2/7] run-report schema (producer selftest, v1-v3 fixtures + v4 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/6] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/7] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -44,7 +47,7 @@ print(f"chaos smoke OK: {len(r['degraded'])} degraded event(s), "
       f"{len(r['progress'])} progress series")
 EOF
 
-echo "== [4/6] telemetry.diff self-test + BENCH trend =="
+echo "== [4/7] telemetry.diff self-test + BENCH trend =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -65,7 +68,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/6] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/7] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -105,12 +108,100 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
+echo "== [6/7] serving smoke (mixed batch + faults + SIGTERM drain) =="
+SERVE_DIR=/tmp/_kmp_serve_smoke
+rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
+python - <<'EOF3' || exit 1
+# build the batch: 14 requests over 3 repeated shapes (result-cache
+# hits), 1 deliberately malformed graph, 1 tight per-request deadline
+import json
+
+poison = "/tmp/_kmp_serve_smoke/poison.metis"
+open(poison, "w").write("3 2\n1 2\n999999 1\n2\n")  # out-of-range id
+A = {"graph": "gen:rgg2d;n=4096;avg_degree=8;seed=1", "k": 4, "seed": 1}
+B = {"graph": "gen:rgg2d;n=4096;avg_degree=8;seed=2", "k": 4, "seed": 1}
+C = {"graph": "gen:rgg2d;n=2048;avg_degree=8;seed=3", "k": 4, "seed": 1}
+reqs = [dict(A, id=f"a{i}") for i in range(6)]
+reqs += [dict(B, id=f"b{i}") for i in range(4)]
+reqs += [dict(C, id=f"c{i}") for i in range(4)]
+reqs.append({"graph": poison, "k": 4, "id": "poison"})
+reqs.append({"graph": "gen:rgg2d;n=65536;avg_degree=8;seed=9", "k": 8,
+             "seed": 1, "deadline_s": 0.05, "id": "tight-deadline"})
+assert len(reqs) == 16
+json.dump({"requests": reqs}, open("/tmp/_kmp_serve_smoke/batch.json", "w"))
+EOF3
+KAMINPAR_TPU_FAULTS=refiner:nth=1 python -m kaminpar_tpu \
+    --serve-batch "$SERVE_DIR/batch.json" \
+    --report-json "$SERVE_DIR/report.json" \
+    || { echo "ERROR: serving batch exited nonzero (isolation broken)" >&2; exit 1; }
+python scripts/check_report_schema.py "$SERVE_DIR/report.json" || exit 1
+python - <<'EOF3' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_serve_smoke/report.json"))
+s = r["serving"]
+assert s["enabled"] and len(s["requests"]) == 16, len(s["requests"])
+c = s["counts"]
+assert sum(c.values()) == 16, c
+assert c["failed"] == 1, c  # the poisoned request, alone
+assert c["anytime"] >= 1, c  # the tight per-request deadline fired
+assert c["served"] >= 12, c
+by_id = {q["request_id"]: q for q in s["requests"]}
+assert by_id["poison"]["verdict"] == "failed", by_id["poison"]
+assert by_id["tight-deadline"]["verdict"] == "anytime", by_id["tight-deadline"]
+# every completed request is gate-valid and feasible
+for q in s["requests"]:
+    if q["verdict"] in ("served", "anytime", "degraded"):
+        assert q["feasible"], q
+        assert q.get("gate_valid", True), q
+# bounded result cache: hit-rate over the repeated-shape subset
+assert s["cache"]["hit_rate"] >= 0.5, s["cache"]
+assert s["cache"]["result"]["entries"] <= s["cache"]["result"]["max_entries"]
+# the injected refiner fault degraded ONE request, not the process
+assert r["faults"]["injected"], r["faults"]
+print(f"serving smoke OK: counts={c}, "
+      f"cache_hit_rate={s['cache']['hit_rate']}, "
+      f"exec_buckets={s['cache']['executable']['buckets']}")
+EOF3
+python - <<'EOF3' || exit 1
+# drain batch: 12 slow distinct requests, SIGTERM lands mid-batch
+import json
+
+reqs = [{"graph": f"gen:rgg2d;n=65536;avg_degree=8;seed={i}", "k": 8,
+         "seed": 1, "id": f"d{i}"} for i in range(12)]
+json.dump({"requests": reqs}, open("/tmp/_kmp_serve_smoke/drain.json", "w"))
+EOF3
+python -m kaminpar_tpu --serve-batch "$SERVE_DIR/drain.json" \
+    --report-json "$SERVE_DIR/drain_report.json" -q &
+serve_pid=$!
+# land the signal mid-batch: past interpreter/handler startup (~2 s),
+# well inside the first request's compile+run (~10 s) of 12 requests
+sleep 5
+kill -TERM "$serve_pid" 2>/dev/null
+wait "$serve_pid" \
+    || { echo "ERROR: SIGTERM'd serving batch exited nonzero" >&2; exit 1; }
+python scripts/check_report_schema.py "$SERVE_DIR/drain_report.json" || exit 1
+python - <<'EOF3' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_serve_smoke/drain_report.json"))
+s = r["serving"]
+# SIGTERM drained the queue: EVERY request still got a verdict in a
+# schema-valid report — in-flight wound down (anytime), queued rejected
+assert s["drained"] is True, s
+assert len(s["requests"]) == 12, len(s["requests"])
+c = s["counts"]
+assert sum(c.values()) == 12, c
+drained = [q for q in s["requests"]
+           if q["verdict"] == "rejected" and q.get("reason") == "draining"]
+assert drained, c
+print(f"drain OK: counts={c} ({len(drained)} drained)")
+EOF3
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [6/6] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [7/7] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [6/6] tier-1 pytest (ROADMAP.md) =="
+echo "== [7/7] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -85,8 +85,9 @@ def version_checks(report: Any) -> List[str]:
     """Schema_version-conditional requirements the dependency-free
     validator subset cannot express (no if/then): v2+ reports must carry
     the `progress` and `compile` sections, v3+ additionally the
-    `checkpoint` and `anytime` sections; older reports remain valid
-    without them during the transition."""
+    `checkpoint` and `anytime` sections, v4+ additionally the `serving`
+    section; older reports remain valid without them during the
+    transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -96,6 +97,7 @@ def version_checks(report: Any) -> List[str]:
     required_by_version = [
         (2, ("progress", "compile")),
         (3, ("checkpoint", "anytime")),
+        (4, ("serving",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -143,12 +145,22 @@ def _minimal_v2_report() -> dict:
     return r
 
 
+def _minimal_v3_report() -> dict:
+    """A minimal schema_version-3 report (checkpoint/anytime present, no
+    serving section) — the third transition fixture."""
+    r = _minimal_v2_report()
+    r["schema_version"] = 3
+    r["checkpoint"] = {"enabled": False}
+    r["anytime"] = {"anytime": False}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
-    check_all.sh fast path).  Annotates non-default `checkpoint` and
-    `anytime` sections so the v3 producer surface is exercised, not just
-    its empty defaults."""
+    check_all.sh fast path).  Annotates non-default `checkpoint`,
+    `anytime`, and `serving` sections so the v3/v4 producer surface is
+    exercised, not just its empty defaults."""
     # run as a script, sys.path[0] is scripts/ — add the repo root
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in sys.path:
@@ -169,6 +181,30 @@ def _selftest_report(path: str) -> None:
             "anytime": True, "reason": "budget", "stage": "uncoarsen:1",
             "budget_s": 1.0, "grace_s": 30.0, "elapsed_s": 1.2,
         },
+        serving={
+            "enabled": True,
+            "requests": [
+                {"request_id": "req-1", "verdict": "served", "k": 4,
+                 "n": 100, "m": 400, "cut": 12, "imbalance": 0.01,
+                 "feasible": True, "cached": False, "gate_valid": True,
+                 "bucket": "256/512/4", "wall_s": 0.5},
+                {"request_id": "req-2", "verdict": "rejected",
+                 "reason": "queue-full", "k": 4, "n": -1, "m": -1,
+                 "cut": -1, "imbalance": 0.0, "feasible": False,
+                 "cached": False, "wall_s": 0.0},
+            ],
+            "counts": {"served": 1, "anytime": 0, "degraded": 0,
+                       "rejected": 1, "failed": 0},
+            "admission": {"max_queue_depth": 64,
+                          "max_queued_cost": 5e7,
+                          "max_request_cost": 2.5e7, "rejected": 1},
+            "cache": {"result": {"hits": 0, "misses": 1,
+                                 "hit_rate": 0.0},
+                      "executable": {"buckets": 1, "hits": 0,
+                                     "misses": 1, "hit_rate": 0.0},
+                      "hit_rate": 0.0},
+            "drained": False,
+        },
     )
     write_run_report(path)
 
@@ -187,8 +223,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v2) and validate it plus the embedded v1 transition fixture "
-        "(no report file needed)",
+        "v4) and validate it plus the embedded v1-v3 transition "
+        "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
 
@@ -211,16 +247,17 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v3 (progress/compile + checkpoint/anytime)
-        if report.get("schema_version") != 3:
+        # live producer must emit v4 (progress/compile +
+        # checkpoint/anytime + serving)
+        if report.get("schema_version") != 4:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 3",
+                f"expected 4",
                 file=sys.stderr,
             )
             return 1
-        for key in ("checkpoint", "anytime"):
+        for key in ("checkpoint", "anytime", "serving"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -228,9 +265,10 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 1
-        # transition coverage: the v1 and v2 layouts must STILL validate
+        # transition coverage: the v1-v3 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
+            ("v3", _minimal_v3_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

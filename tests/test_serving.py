@@ -1,0 +1,671 @@
+"""Serving-layer tests: admission control, per-request fault isolation,
+bounded caches, drain semantics — plus the PR-6 per-run resilience-state
+regression suite (docs/robustness.md, serving contract).
+
+The load-with-chaos test is the acceptance check of ISSUE 6: a mixed
+batch (varying n, k, eps, one deliberately malformed graph, fault
+sampling on) must finish with every served result gate-valid, the
+poisoned request failed in isolation, and zero cross-request
+contamination of telemetry scopes or checkpoint state.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import caching, resilience, telemetry
+from kaminpar_tpu.graphs.factories import make_rgg2d
+from kaminpar_tpu.resilience import checkpoint as ckpt_mod
+from kaminpar_tpu.resilience import deadline as deadline_mod
+from kaminpar_tpu.resilience import faults, runstate
+from kaminpar_tpu.serving import (
+    PartitionRequest,
+    PartitionService,
+    ServiceConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(ckpt_mod.STOP_AT_ENV, raising=False)
+    monkeypatch.delenv(resilience.FAULTS_ENV_VAR, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _gen(n=600, seed=3):
+    return f"gen:rgg2d;n={n};avg_degree=8;seed={seed}"
+
+
+def _svc(**cfg):
+    return PartitionService("default", ServiceConfig(**cfg))
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_queue_depth_cap():
+    svc = _svc(max_queue_depth=2)
+    assert svc.submit(PartitionRequest(_gen(), k=4)) is None
+    assert svc.submit(PartitionRequest(_gen(), k=4)) is None
+    rec = svc.submit(PartitionRequest(_gen(), k=4))
+    assert rec is not None and rec.verdict == "rejected"
+    assert rec.reason == "queue-full"
+
+
+def test_admission_cost_caps():
+    svc = _svc(max_queued_cost=10_000, max_request_cost=8_000)
+    # a single oversized request is refused outright
+    rec = svc.submit(PartitionRequest(_gen(n=4096), k=4))
+    assert rec is not None and rec.reason == "request-too-large"
+    # and the aggregate cap holds across queued requests
+    assert svc.submit(PartitionRequest(_gen(n=600), k=4)) is None
+    rec2 = svc.submit(PartitionRequest(_gen(n=600), k=4))
+    assert rec2 is not None and rec2.reason == "cost-cap"
+    # every admission decision is a record in the batch, nothing queued
+    # was lost
+    assert [r.verdict for r in svc.records] == ["rejected", "rejected"]
+
+
+def test_admission_invalid_parameters():
+    rec = _svc().submit(PartitionRequest(_gen(), k=0))
+    assert rec is not None and rec.reason == "invalid-parameters"
+
+
+def test_serving_admit_fault_site(monkeypatch):
+    """The `serving-admit` injection forces a structured rejection (with
+    the standard degraded event) and spends itself: the next submit is
+    admitted."""
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "serving-admit:nth=1")
+    svc = _svc()
+    rec = svc.submit(PartitionRequest(_gen(), k=4))
+    assert rec is not None and rec.reason == "fault-injected"
+    assert {"site": "serving-admit", "call": 1} in faults.injected_log()
+    degraded = [e.attrs["site"] for e in telemetry.events("degraded")]
+    assert "serving-admit" in degraded
+    assert svc.submit(PartitionRequest(_gen(), k=4)) is None
+
+
+# ---------------------------------------------------------------------------
+# result cache + executable buckets
+# ---------------------------------------------------------------------------
+
+
+def test_result_cache_hit_on_identical_request():
+    svc = _svc()
+    recs = svc.serve([
+        PartitionRequest(_gen(), k=4, seed=1),
+        PartitionRequest(_gen(), k=4, seed=1),
+    ])
+    assert [r.verdict for r in recs] == ["served", "served"]
+    assert not recs[0].cached and recs[1].cached
+    assert recs[0].cut == recs[1].cut
+    s = svc.summary()
+    assert s["cache"]["result"]["hits"] == 1
+    assert s["cache"]["hit_rate"] == 0.5
+    # a different (k) forks the ctx fingerprint: no false sharing
+    (r3,) = svc.serve([PartitionRequest(_gen(), k=8, seed=1)])
+    assert not r3.cached
+
+
+def test_result_cache_entry_cap_evicts_lru():
+    svc = _svc(result_cache_entries=1)
+    svc.serve([
+        PartitionRequest(_gen(seed=3), k=4, seed=1),
+        PartitionRequest(_gen(seed=4), k=4, seed=1),  # evicts seed=3
+        PartitionRequest(_gen(seed=3), k=4, seed=1),  # recompute
+    ])
+    stats = svc.result_cache_stats()
+    assert stats["entries"] == 1
+    assert stats["evictions"] >= 1
+    assert stats["hits"] == 0
+
+
+def test_serving_cache_fault_forces_miss(monkeypatch):
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "serving-cache:nth=2")
+    svc = _svc()
+    recs = svc.serve([
+        PartitionRequest(_gen(), k=4, seed=1),
+        PartitionRequest(_gen(), k=4, seed=1),  # lookup 2: injected
+    ])
+    # the second request recomputed (forced miss + evict) but stayed
+    # correct and gate-valid — and the engaged site is on the verdict
+    # even though the facade reset the telemetry stream at compute entry
+    assert not recs[1].cached
+    assert recs[1].verdict == "degraded"
+    assert "serving-cache" in recs[1].degraded_sites
+    assert recs[0].cut == recs[1].cut
+    assert {"site": "serving-cache", "call": 2} in faults.injected_log()
+
+
+def test_executable_bucket_reuse_accounting():
+    tracker = caching.BucketTracker()
+    assert tracker.observe(600, 4400, 4) == tracker.observe(610, 4500, 4)
+    assert tracker.observe(600, 4400, 8) != tracker.observe(600, 4400, 4)
+    stats = tracker.stats()
+    assert stats == {
+        "buckets": 2, "hits": 2, "misses": 2, "hit_rate": 0.5,
+    }
+
+
+def test_bounded_cache_byte_budget():
+    c = caching.BoundedCache(max_entries=100, max_bytes=100)
+    assert c.put("a", "x", 60) and c.put("b", "y", 60)  # evicts a
+    assert c.get("a") is None and c.get("b") == "y"
+    assert not c.put("huge", "z", 1000)  # refused, cache intact
+    assert c.get("b") == "y"
+    assert c.stats()["oversize"] == 1
+
+
+# ---------------------------------------------------------------------------
+# per-request fault isolation
+# ---------------------------------------------------------------------------
+
+
+def _malformed_metis(tmp_path):
+    p = tmp_path / "poison.metis"
+    p.write_text("3 2\n1 2\n999999 1\n2\n")  # out-of-range neighbor id
+    return str(p)
+
+
+def test_malformed_graph_fails_in_isolation(tmp_path):
+    svc = _svc()
+    recs = svc.serve([
+        PartitionRequest(_gen(), k=4, seed=1, request_id="good-1"),
+        PartitionRequest(_malformed_metis(tmp_path), k=4,
+                         request_id="poison"),
+        PartitionRequest(_gen(), k=4, seed=1, request_id="good-2"),
+    ])
+    by_id = {r.request_id: r for r in recs}
+    assert by_id["poison"].verdict == "failed"
+    assert by_id["poison"].reason == "malformed-input"
+    assert by_id["poison"].error == "GraphFormatError"
+    for rid in ("good-1", "good-2"):
+        assert by_id[rid].verdict == "served"
+        assert by_id[rid].feasible and by_id[rid].gate_valid
+    # an input failure says nothing about the request class: no breaker
+    assert svc._class_failures == {}
+
+
+def test_crash_failures_open_per_class_breaker(monkeypatch):
+    """Three crash-shaped failures in one request class reject the
+    fourth at admission — without poisoning other classes."""
+    from kaminpar_tpu import kaminpar as kp
+
+    def boom(self, **kwargs):
+        raise resilience.DeviceOOM("synthetic device OOM")
+
+    monkeypatch.setattr(kp.KaMinPar, "compute_partition", boom)
+    svc = _svc()
+    recs = svc.serve(
+        [PartitionRequest(_gen(), k=4, seed=s) for s in (1, 2, 3)]
+    )
+    assert [r.verdict for r in recs] == ["failed"] * 3
+    assert all(r.error == "DeviceOOM" for r in recs)
+    # same class (same executable bucket): rejected at admission
+    rej = svc.submit(PartitionRequest(_gen(), k=4, seed=4))
+    assert rej is not None and rej.reason == "breaker-open"
+    # a different class (different k bucket) is still admitted
+    assert svc.submit(PartitionRequest(_gen(), k=16, seed=4)) is None
+
+
+def test_deadline_request_winds_down_anytime_and_next_is_clean():
+    """A per-request deadline yields an `anytime` verdict; the NEXT
+    request gets a fresh run state — the stop verdict cannot leak
+    (the satellite-1 hazard, service-level view)."""
+    svc = _svc()
+    recs = svc.serve([
+        PartitionRequest(_gen(n=900, seed=4), k=8, seed=1,
+                         deadline_s=1e-4),
+        PartitionRequest(_gen(), k=4, seed=1),
+    ])
+    assert recs[0].verdict == "anytime"
+    assert recs[0].reason == "budget"
+    assert recs[0].feasible
+    assert recs[1].verdict == "served"  # no inherited wind-down
+    # anytime results are NOT cached: a later identical request with
+    # time to do better must recompute
+    assert svc.result_cache_stats()["entries"] == 1  # only the served one
+
+
+def test_drain_rejects_queued_requests():
+    svc = _svc()
+    for s in (1, 2, 3):
+        assert svc.submit(PartitionRequest(_gen(), k=4, seed=s)) is None
+    svc.drain()
+    recs = svc.run_pending()
+    assert [r.verdict for r in recs] == ["rejected"] * 3
+    assert all(r.reason == "draining" for r in recs)
+    s = svc.summary()
+    assert s["drained"] is True
+    assert s["counts"]["rejected"] == 3
+    # late submissions are rejected at admission, still one record each
+    late = svc.submit(PartitionRequest(_gen(), k=4, seed=9))
+    assert late is not None and late.reason == "draining"
+
+
+# ---------------------------------------------------------------------------
+# the ISSUE-6 acceptance batch: load with chaos
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_chaos_batch_isolates_and_stays_gate_valid(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv(
+        resilience.FAULTS_ENV_VAR,
+        "refiner:0.3,device-balancer:0.3,native-ip:0.3",
+    )
+    svc = _svc()
+    requests = [
+        PartitionRequest(_gen(n=600, seed=3), k=4, seed=1),
+        PartitionRequest(_gen(n=600, seed=3), k=4, epsilon=0.1, seed=1),
+        PartitionRequest(_gen(n=900, seed=4), k=8, seed=2),
+        PartitionRequest(_malformed_metis(tmp_path), k=4,
+                         request_id="poison"),
+        PartitionRequest(_gen(n=600, seed=3), k=4, seed=1),  # cache path
+        PartitionRequest(_gen(n=400, seed=5), k=2, seed=3),
+    ]
+    recs = svc.serve(requests)
+    assert len(recs) == len(requests)
+    by_id = {r.request_id: r for r in recs}
+    # the poisoned request failed ALONE
+    assert by_id["poison"].verdict == "failed"
+    completed = [r for r in recs if r.verdict in
+                 ("served", "anytime", "degraded")]
+    assert len(completed) == len(requests) - 1
+    for rec in completed:
+        assert rec.feasible, rec.to_dict()
+        if rec.gate_valid is not None:  # cache hits reuse the verdict
+            assert rec.gate_valid, rec.to_dict()
+    # zero cross-request contamination:
+    #  * each record carries its own request's shape, not a neighbor's
+    assert by_id[requests[2].request_id].k == 8
+    assert by_id[requests[5].request_id].k == 2
+    #  * no checkpoint manager or resume state survived the batch
+    assert ckpt_mod.active() is None
+    assert not ckpt_mod.suspended()
+    #  * the telemetry stream belongs to the LAST computed request only
+    runs = [e for e in telemetry.events("coarsening-level")]
+    ks = {telemetry.run_info().get("k")}
+    assert ks <= {requests[5].k, None}, (ks, runs)
+    # the serving summary is schema-shaped (validated end-to-end by the
+    # check_all smoke; here: the invariants)
+    s = svc.annotate()
+    assert s["counts"]["failed"] == 1
+    assert sum(s["counts"].values()) == len(requests)
+    # every completed request consulted the result cache (hits are NOT
+    # guaranteed under chaos: a degraded run is deliberately not cached)
+    result_stats = s["cache"]["result"]
+    assert result_stats["hits"] + result_stats["misses"] == len(completed)
+    json.dumps(s)  # JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# per-run resilience state (the satellite-1 regression suite)
+# ---------------------------------------------------------------------------
+
+
+def test_two_sequential_runs_share_process_without_state_leak(tmp_path):
+    """Back-to-back facade runs in ONE process: run A is preempted mid-
+    pipeline with a checkpoint on disk; run B (same process, resume NOT
+    requested) must neither consume A's resume state nor inherit its
+    stop verdict."""
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.presets import create_context_by_preset_name
+    from kaminpar_tpu.resilience.checkpoint import SimulatedPreemption
+
+    g = make_rgg2d(800, avg_degree=8, seed=3)
+    ctx_a = create_context_by_preset_name("default")
+    ctx_a.coarsening.contraction_limit = 50
+    ctx_a.resilience.checkpoint_dir = str(tmp_path / "ckpt")
+    import os
+
+    os.environ[ckpt_mod.STOP_AT_ENV] = "coarsen:1!"
+    try:
+        with pytest.raises(SimulatedPreemption):
+            solver_a = KaMinPar(ctx_a)
+            solver_a.set_output_level(0)
+            solver_a.set_graph(g)
+            solver_a.compute_partition(k=4, epsilon=0.03, seed=1)
+    finally:
+        os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+    assert (tmp_path / "ckpt" / "manifest.json").exists()
+
+    # run B: fresh solver, SAME process, no --resume
+    ctx_b = create_context_by_preset_name("default")
+    ctx_b.coarsening.contraction_limit = 50
+    ctx_b.resilience.checkpoint_dir = str(tmp_path / "ckpt-b")
+    solver_b = KaMinPar(ctx_b)
+    solver_b.set_output_level(0)
+    solver_b.set_graph(g)
+    part = solver_b.compute_partition(k=4, epsilon=0.03, seed=1)
+    assert part.shape == (g.n,)
+    assert solver_b.last_anytime is None  # A's verdict did not leak
+    actions = [e.attrs.get("action") for e in telemetry.events("checkpoint")]
+    assert "resumed" not in actions  # A's resume state was not consumed
+    assert not deadline_mod.triggered()
+
+
+def test_stale_stop_verdict_does_not_survive_begin_run():
+    deadline_mod.request_stop("stop-at:test")
+    assert deadline_mod.should_stop()
+    deadline_mod.begin_run(None, None)
+    # non-signal stop reasons are run-local: gone with the old run
+    assert not deadline_mod.should_stop()
+    # signal-shaped stops persist across begin_run (the PR-5 contract:
+    # a SIGTERM during graph load winds down the run that follows)...
+    deadline_mod.request_stop("sigterm")
+    deadline_mod.begin_run(None, None)
+    assert deadline_mod.should_stop()
+    assert deadline_mod.state()["reason"] == "sigterm"
+    # ...and only clear() (test isolation) drops it
+    deadline_mod.clear()
+    assert not deadline_mod.should_stop()
+
+
+def test_runstate_thread_isolation():
+    """Interleaved runs in different threads own independent deadline
+    state; a process-wide signal stops every thread (drain semantics)."""
+    results = {}
+    first_done = threading.Barrier(3)  # both workers + the main thread
+    signal_raised = threading.Event()
+
+    def worker(name, budget):
+        deadline_mod.begin_run(budget, None)
+        if budget:
+            time.sleep(0.01)  # let the tiny budget expire
+        results[name] = {
+            "stopped": deadline_mod.should_stop(),
+            "reason": deadline_mod.state().get("reason"),
+        }
+        first_done.wait(timeout=10)
+        assert signal_raised.wait(timeout=10)
+        results[name + "/after-signal"] = deadline_mod.should_stop()
+
+    ta = threading.Thread(target=worker, args=("a", 1e-4))
+    tb = threading.Thread(target=worker, args=("b", None))
+    ta.start()
+    tb.start()
+    first_done.wait(timeout=10)  # both first verdicts are recorded
+    runstate.signal_stop("sigterm")
+    signal_raised.set()
+    ta.join(timeout=10)
+    tb.join(timeout=10)
+    assert results["a"]["stopped"] is True
+    assert results["a"]["reason"] == "budget"
+    assert results["b"]["stopped"] is False  # a's expiry stayed in a
+    assert results["a/after-signal"] is True
+    assert results["b/after-signal"] is True  # signals reach every run
+    runstate.clear_signal()
+
+
+def test_checkpoint_manager_is_per_run_object(tmp_path):
+    """activate/suspend bookkeeping lives on the current run object: a
+    begin_run (fresh run) structurally drops the previous manager."""
+    mgr = ckpt_mod.CheckpointManager(str(tmp_path), "g", "c")
+    ckpt_mod.activate(mgr)
+    ckpt_mod.suspend()
+    assert ckpt_mod.active() is mgr and ckpt_mod.suspended()
+    deadline_mod.begin_run(None, None)
+    assert ckpt_mod.active() is None
+    assert not ckpt_mod.suspended()
+
+
+# ---------------------------------------------------------------------------
+# batch spec loader (the CLI surface)
+# ---------------------------------------------------------------------------
+
+
+def test_batch_spec_roundtrip(tmp_path):
+    from kaminpar_tpu.serving.batch import BatchSpecError, load_batch
+
+    spec = {
+        "config": {"max_queue_depth": 7, "default_deadline_s": 2.5},
+        "requests": [
+            {"graph": _gen(), "k": 4, "epsilon": 0.05, "seed": 9,
+             "priority": 2, "id": "hi"},
+            {"graph": "some/path.metis", "k": 2},
+        ],
+    }
+    p = tmp_path / "batch.json"
+    p.write_text(json.dumps(spec))
+    requests, config = load_batch(str(p))
+    assert config.max_queue_depth == 7
+    assert config.default_deadline_s == 2.5
+    assert requests[0].request_id == "hi"
+    assert requests[0].priority == 2 and requests[0].seed == 9
+    assert requests[1].request_id == "req-2"
+    for bad in (
+        {"requests": []},
+        {"requests": [{"graph": "x"}]},  # no k
+        {"config": {"nope": 1}, "requests": [{"graph": "x", "k": 2}]},
+        "not a batch",
+    ):
+        p.write_text(json.dumps(bad))
+        with pytest.raises(BatchSpecError):
+            load_batch(str(p))
+
+
+def test_priority_orders_the_queue():
+    svc = _svc()
+    order = []
+    real = PartitionService._execute
+
+    def record_order(self, req, *args, **kwargs):
+        order.append(req.request_id)
+        return real(self, req, *args, **kwargs)
+
+    PartitionService._execute = record_order
+    try:
+        svc.serve([
+            PartitionRequest(_gen(), k=4, seed=1, priority=0,
+                             request_id="low"),
+            PartitionRequest(_gen(), k=4, seed=1, priority=5,
+                             request_id="high"),
+        ])
+    finally:
+        PartitionService._execute = real
+    assert order == ["high", "low"]
+
+
+# ---------------------------------------------------------------------------
+# review-hardening regressions
+# ---------------------------------------------------------------------------
+
+
+def test_full_graph_digest_sees_what_the_sampling_fingerprint_misses():
+    """The result-cache key must cover interior edges and edge weights —
+    exactly the blind spots of the O(1) resume fingerprint."""
+    from kaminpar_tpu.resilience.checkpoint import graph_fingerprint
+
+    g = make_rgg2d(4096, avg_degree=16, seed=1)
+    base = caching.full_graph_digest(g)
+
+    # an interior adjacency entry beyond the sampled head/tail window
+    g2 = make_rgg2d(4096, avg_degree=16, seed=1)
+    mid = g2.adjncy.shape[0] // 2
+    assert 4096 < mid < g2.adjncy.shape[0] - 4096
+    g2.adjncy[mid] = (g2.adjncy[mid] + 1) % g2.n
+    assert graph_fingerprint(g) == graph_fingerprint(g2)  # the blind spot
+    assert caching.full_graph_digest(g2) != base
+
+    # edge weights, which the sampling fingerprint never reads
+    g3 = make_rgg2d(4096, avg_degree=16, seed=1)
+    g3.edge_weights = np.full(g3.adjncy.shape[0], 2, dtype=np.int32)
+    assert graph_fingerprint(g) == graph_fingerprint(g3)
+    assert caching.full_graph_digest(g3) != base
+
+    # and the combined serving key forks where the digest forks
+    from kaminpar_tpu.presets import create_context_by_preset_name
+
+    ctx = create_context_by_preset_name("default")
+    assert (caching.result_cache_key(g, ctx)
+            != caching.result_cache_key(g3, ctx))
+
+
+def test_serve_drains_instead_of_rejecting_large_batches():
+    """A single-producer batch bigger than the queue caps runs in
+    windows; nothing is spuriously rejected queue-full/cost-cap."""
+    svc = _svc(max_queue_depth=2)
+    recs = svc.serve([
+        PartitionRequest(_gen(), k=4, seed=1, request_id=f"r{i}")
+        for i in range(5)
+    ])
+    assert len(recs) == 5
+    assert [r.verdict for r in recs].count("rejected") == 0
+    assert all(r.verdict == "served" for r in recs)
+
+
+def test_pending_duplicate_id_rejected_then_reusable():
+    svc = _svc()
+    assert svc.submit(PartitionRequest(_gen(), k=4, seed=1,
+                                       request_id="dup")) is None
+    rej = svc.submit(PartitionRequest(_gen(), k=4, seed=1,
+                                      request_id="dup"))
+    assert rej is not None and rej.reason == "duplicate-id"
+    svc.run_pending()
+    # a completed id may be reused (re-submission of the same request)
+    assert svc.submit(PartitionRequest(_gen(), k=4, seed=1,
+                                       request_id="dup")) is None
+
+
+def test_batch_spec_rejects_duplicate_ids_and_parses_string_bools(
+    tmp_path,
+):
+    from kaminpar_tpu.serving.batch import BatchSpecError, load_batch
+
+    p = tmp_path / "batch.json"
+    # an explicit id colliding with a generated default ("req-2")
+    p.write_text(json.dumps([
+        {"graph": _gen(), "k": 4, "id": "req-2"},
+        {"graph": _gen(), "k": 4},
+    ]))
+    with pytest.raises(BatchSpecError, match="duplicate"):
+        load_batch(str(p))
+
+    p.write_text(json.dumps({
+        "config": {"keep_partitions": "false"},
+        "requests": [{"graph": _gen(), "k": 4}],
+    }))
+    _, config = load_batch(str(p))
+    assert config.keep_partitions is False  # bool("false") would be True
+
+    p.write_text(json.dumps({
+        "config": {"keep_partitions": "maybe"},
+        "requests": [{"graph": _gen(), "k": 4}],
+    }))
+    with pytest.raises(BatchSpecError, match="boolean"):
+        load_batch(str(p))
+
+
+def test_file_backed_crashes_latch_the_admission_visible_class(
+    tmp_path, monkeypatch
+):
+    """Admission can only ever see "unsized" for a file path (it never
+    loads the input), so crash-shaped failures must latch that class
+    too — otherwise the documented breaker-open rejection can never
+    fire for file-backed requests."""
+    from kaminpar_tpu import kaminpar as kp
+
+    path = tmp_path / "tri.metis"
+    path.write_text("3 3\n2 3\n1 3\n1 2\n")
+
+    def boom(self, **kwargs):
+        raise resilience.DeviceOOM("synthetic device OOM")
+
+    monkeypatch.setattr(kp.KaMinPar, "compute_partition", boom)
+    svc = _svc()
+    recs = svc.serve([
+        PartitionRequest(str(path), k=2, request_id=f"f{i}")
+        for i in range(3)
+    ])
+    assert [r.verdict for r in recs] == ["failed"] * 3
+    rej = svc.submit(PartitionRequest(str(path), k=2, request_id="f4"))
+    assert rej is not None and rej.reason == "breaker-open"
+
+
+def test_batch_spec_wraps_field_coercion_errors(tmp_path):
+    """Every malformed spec field must surface as BatchSpecError (the
+    CLI's exit-2 contract), never a raw TypeError/ValueError."""
+    from kaminpar_tpu.serving.batch import BatchSpecError, load_batch
+
+    p = tmp_path / "batch.json"
+    for bad in (
+        {"config": {"max_queue_depth": None},
+         "requests": [{"graph": _gen(), "k": 2}]},
+        {"requests": [{"graph": _gen(), "k": "four"}]},
+        {"requests": [{"graph": _gen(), "k": 2, "seed": "abc"}]},
+    ):
+        p.write_text(json.dumps(bad))
+        with pytest.raises(BatchSpecError):
+            load_batch(str(p))
+
+
+def test_admission_rejected_counter_excludes_drain_rejections():
+    svc = _svc()
+    assert svc.submit(PartitionRequest(_gen(), k=0)) is not None  # bad k
+    for i in range(3):
+        svc.submit(PartitionRequest(_gen(), k=4, seed=1,
+                                    request_id=f"q{i}"))
+    svc.drain()
+    try:
+        svc.run_pending()
+    finally:
+        deadline_mod.clear()
+    s = svc.summary()
+    # 1 admission rejection + 3 drain rejections share the verdict...
+    assert s["counts"]["rejected"] == 4
+    # ...but the admission metric counts only its own
+    assert s["admission"]["rejected"] == 1
+    assert s["drained"] is True
+
+
+def test_reset_records_bounds_long_lived_services():
+    svc = _svc()
+    svc.serve([PartitionRequest(_gen(), k=4, seed=1)])
+    window = svc.reset_records()
+    assert len(window) == 1 and window[0].verdict == "served"
+    assert svc.records == []
+    assert svc.summary()["admission"]["rejected"] == 0
+    # cache state survives the reset: the same request replays
+    (rec,) = svc.serve([PartitionRequest(_gen(), k=4, seed=1)])
+    assert rec.cached
+
+
+def test_concurrent_submit_respects_caps():
+    """submit() is safe for concurrent producers: the depth cap holds
+    exactly and the bookkeeping maps stay consistent."""
+    svc = _svc(max_queue_depth=16)
+    results = []
+
+    def producer(t):
+        for i in range(40):
+            results.append(
+                svc.submit(PartitionRequest(
+                    _gen(), k=4, request_id=f"t{t}-{i}"))
+            )
+
+    threads = [threading.Thread(target=producer, args=(t,))
+               for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    queued = [r for r in results if r is None]
+    assert len(svc._queue) == len(queued) == 16
+    assert set(svc._queued_cost) == set(svc._order) == {
+        req.request_id for req in svc._queue
+    }
+    rejected = [r for r in results if r is not None]
+    assert all(r.reason == "queue-full" for r in rejected)

@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 3
+    assert loaded["schema_version"] == 4
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -194,10 +194,11 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     for key in ("trace_s", "lower_s", "compile_s", "compiles",
                 "persistent_cache_hits", "persistent_cache_misses"):
         assert key in comp["totals"], key
-    # schema v3 sections: well-formed defaults for a run that used
-    # neither checkpointing nor a deadline budget
+    # schema v3/v4 sections: well-formed defaults for a run that used
+    # neither checkpointing, a deadline budget, nor the serving layer
     assert loaded["checkpoint"] == {"enabled": False}
     assert loaded["anytime"] == {"anytime": False}
+    assert loaded["serving"] == {"enabled": False}
 
     # validates against the checked-in schema (drift backstop)
     checker = _load_checker()
@@ -589,11 +590,11 @@ def test_diff_aligns_progress_by_kind_path_level(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# schema v1/v2/v3 transition (scripts/check_report_schema.py)
+# schema v1/v2/v3/v4 transition (scripts/check_report_schema.py)
 # ---------------------------------------------------------------------------
 
 
-def test_schema_accepts_v1_v2_and_v3(tmp_path):
+def test_schema_accepts_v1_through_v4(tmp_path):
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH
 
     checker = _load_checker()
@@ -617,17 +618,19 @@ def test_schema_accepts_v1_v2_and_v3(tmp_path):
         "checkpoint" in e or "anytime" in e
         for e in checker.version_checks(v3_missing)
     )
-    v3 = dict(
-        v3_missing,
-        checkpoint={"enabled": False},
-        anytime={"anytime": False},
-    )
+    v3 = checker._minimal_v3_report()
     assert checker.validate_instance(v3, schema) == []
     assert checker.version_checks(v3) == []
-    # v4 is not a known version
-    v4 = dict(v1, schema_version=4)
+    # v4 additionally requires the serving section
+    v4_missing = dict(v3, schema_version=4)
+    assert any("serving" in e for e in checker.version_checks(v4_missing))
+    v4 = dict(v4_missing, serving={"enabled": False})
+    assert checker.validate_instance(v4, schema) == []
+    assert checker.version_checks(v4) == []
+    # v5 is not a known version
+    v5 = dict(v1, schema_version=5)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v4, schema))
+               for e in checker.validate_instance(v5, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
