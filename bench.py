@@ -357,6 +357,14 @@ def _bench_line() -> dict:
             line["vs_baseline_cut_10m"] = round(ref_10m / max(cut_10m, 1), 3)
     line.update(util)
     if best_report is not None:
+        # perf-observatory headline figures promoted next to cut/seconds
+        # (the full per-scope breakdown rides in the embedded report's
+        # `perf` section; scripts/bench_trend.py renders these columns)
+        perf_totals = best_report.get("perf", {}).get("totals", {})
+        for src, dst in (("hbm_util", "hbm_util"),
+                         ("pad_waste", "pad_waste")):
+            if perf_totals.get(src) is not None:
+                line[dst] = perf_totals[src]
         # drop only OPTIONAL sections; everything the schema requires
         # (including events) stays, so the embedded report validates
         # against run_report.schema.json exactly like a --report-json file

@@ -68,6 +68,27 @@ def bucket_key(n: int, m: int, k: int) -> Tuple[int, int, int]:
     return (pad_size(int(n) + 1), pad_size(max(int(m), 1)), pad_k(k))
 
 
+def record_padding(n=None, n_pad=None, m=None, m_pad=None,
+                   k=None, k_pad=None) -> None:
+    """Report one padded launch shape (real vs padded element counts,
+    per axis) to the performance observatory.
+
+    The shape-bucket policy lives here, so this is where every pad site
+    (device CSR upload, contraction, subgraph slicing, the k bucket,
+    dist shards) reports what fraction of the launch was padding — the
+    run report's `perf.pad_waste` rows.  Import-light contract intact:
+    telemetry is imported lazily and the call is a no-op (one bool
+    check) unless the perf layer is enabled."""
+    try:
+        from .telemetry import perf
+    except Exception:
+        return
+    if perf.enabled():
+        perf.record_padding(
+            n=n, n_pad=n_pad, m=m, m_pad=m_pad, k=k, k_pad=k_pad
+        )
+
+
 class BoundedCache:
     """A thread-safe LRU cache with an entry cap and a byte budget.
 
@@ -91,6 +112,13 @@ class BoundedCache:
         self.misses = 0
         self.evictions = 0
         self.oversize = 0
+        # per-window twins (begin_window): a long-lived serving process
+        # reports fresh per-window rates instead of lifetime averages
+        # that asymptotically freeze under sustained traffic
+        self.w_hits = 0
+        self.w_misses = 0
+        self.w_evictions = 0
+        self.w_oversize = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -105,9 +133,11 @@ class BoundedCache:
             ent = self._entries.get(key)
             if ent is None:
                 self.misses += 1
+                self.w_misses += 1
                 return None
             self._entries.move_to_end(key)
             self.hits += 1
+            self.w_hits += 1
             return ent[0]
 
     def put(self, key: Hashable, value: Any, nbytes: int = 0) -> bool:
@@ -117,6 +147,7 @@ class BoundedCache:
         with self._lock:
             if nbytes > self.max_bytes:
                 self.oversize += 1
+                self.w_oversize += 1
                 return False
             old = self._entries.pop(key, None)
             if old is not None:
@@ -130,6 +161,7 @@ class BoundedCache:
                 _, (_, dropped) = self._entries.popitem(last=False)
                 self._bytes -= dropped
                 self.evictions += 1
+                self.w_evictions += 1
             return True
 
     def evict(self, key: Hashable) -> bool:
@@ -148,10 +180,22 @@ class BoundedCache:
             self._entries.clear()
             self._bytes = 0
 
+    def begin_window(self) -> None:
+        """Zero the per-window counters (lifetime totals are kept) —
+        called by the serving layer's `reset_records()` so each exported
+        report window carries its own hit rate."""
+        with self._lock:
+            self.w_hits = 0
+            self.w_misses = 0
+            self.w_evictions = 0
+            self.w_oversize = 0
+
     def stats(self) -> Dict[str, Any]:
-        """Counter snapshot (the run report's cache subsections)."""
+        """Counter snapshot (the run report's cache subsections):
+        lifetime totals plus the current window's counters."""
         with self._lock:
             lookups = self.hits + self.misses
+            w_lookups = self.w_hits + self.w_misses
             return {
                 "entries": len(self._entries),
                 "bytes": int(self._bytes),
@@ -164,6 +208,16 @@ class BoundedCache:
                 "hit_rate": (
                     round(self.hits / lookups, 4) if lookups else 0.0
                 ),
+                "window": {
+                    "hits": int(self.w_hits),
+                    "misses": int(self.w_misses),
+                    "evictions": int(self.w_evictions),
+                    "oversize": int(self.w_oversize),
+                    "hit_rate": (
+                        round(self.w_hits / w_lookups, 4)
+                        if w_lookups else 0.0
+                    ),
+                },
             }
 
 
@@ -182,6 +236,9 @@ class BucketTracker:
         self._seen: Dict[Tuple[int, int, int], int] = {}
         self.hits = 0
         self.misses = 0
+        # per-window twins (begin_window) — see BoundedCache
+        self.w_hits = 0
+        self.w_misses = 0
 
     def observe(self, n: int, m: int, k: int) -> Tuple[int, int, int]:
         """Record one request's bucket; returns the key."""
@@ -190,14 +247,34 @@ class BucketTracker:
             if key in self._seen:
                 self._seen[key] += 1
                 self.hits += 1
+                self.w_hits += 1
             else:
                 self._seen[key] = 1
                 self.misses += 1
+                self.w_misses += 1
         return key
+
+    def begin_window(self) -> None:
+        """Zero the per-window counters (bucket sightings and lifetime
+        totals are kept)."""
+        with self._lock:
+            self.w_hits = 0
+            self.w_misses = 0
+
+    def per_bucket(self) -> Dict[str, int]:
+        """Lifetime sightings per bucket ("n/m/k" string keys) — the
+        serving latency rollup joins these with its per-class
+        histograms."""
+        with self._lock:
+            return {
+                "/".join(str(x) for x in key): int(count)
+                for key, count in self._seen.items()
+            }
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             lookups = self.hits + self.misses
+            w_lookups = self.w_hits + self.w_misses
             return {
                 "buckets": len(self._seen),
                 "hits": int(self.hits),
@@ -205,6 +282,14 @@ class BucketTracker:
                 "hit_rate": (
                     round(self.hits / lookups, 4) if lookups else 0.0
                 ),
+                "window": {
+                    "hits": int(self.w_hits),
+                    "misses": int(self.w_misses),
+                    "hit_rate": (
+                        round(self.w_hits / w_lookups, 4)
+                        if w_lookups else 0.0
+                    ),
+                },
             }
 
 

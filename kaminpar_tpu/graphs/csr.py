@@ -116,12 +116,15 @@ def device_graph_from_host(
     device=None,
 ) -> DeviceGraph:
     """Upload a HostGraph into the padded device layout."""
+    from ..caching import record_padding
+
     n, m = graph.n, graph.m
     n_floor, m_floor = shape_floors()
     n_pad = n_pad if n_pad is not None else pad_size(n + 1, n_floor)
     m_pad = m_pad if m_pad is not None else pad_size(max(m, 1), m_floor)
     if n_pad < n + 1 or m_pad < m:
         raise ValueError("pad sizes too small")
+    record_padding(n=n + 1, n_pad=n_pad, m=m, m_pad=m_pad)
 
     row_ptr = np.full(n_pad + 1, m, dtype=np.int32)
     row_ptr[: n + 1] = graph.xadj.astype(np.int32)
@@ -181,6 +184,9 @@ def device_graph_from_compressed(
     m_pad = m_pad if m_pad is not None else pad_size(max(m, 1), m_floor)
     if n_pad < n + 1 or m_pad < m:
         raise ValueError("pad sizes too small")
+    from ..caching import record_padding
+
+    record_padding(n=n + 1, n_pad=n_pad, m=m, m_pad=m_pad)
     pad_node = n_pad - 1
 
     # O(n) arrays come straight from the (uncompressed) offsets

@@ -30,6 +30,8 @@ RULES: Dict[str, str] = {
     "R3": "32-bit accumulation where the dtypes.py 64-bit policy applies",
     "R4": "jit wrapper constructed per iteration/evaluation (retrace)",
     "R5": "routed-gather plan built without a slot cap check",
+    "R6": "eager device-memory/cost introspection outside the gated "
+          "perf helpers (telemetry.perf / utils.heap_profiler)",
 }
 
 _SUPPRESS_RE = re.compile(
@@ -72,6 +74,13 @@ class LintConfig:
 
     # files allowed to call jax device/backend queries directly (the gate)
     gate_suffixes: Tuple[str, ...] = ("utils/platform.py",)
+    # files allowed to walk live arrays / cost-analyze executables /
+    # profile device memory directly (R6's gate: the perf observatory
+    # and the heap profiler own those probes behind enabled() checks)
+    perf_gate_suffixes: Tuple[str, ...] = (
+        "telemetry/perf.py",
+        "utils/heap_profiler.py",
+    )
     # R3 fires only under these directory names (plus lint fixtures)
     r3_dirs: Tuple[str, ...] = ("ops", "graphs", "parallel", "lint_fixtures")
     # rules to run (all by default)
@@ -119,6 +128,9 @@ class ModuleContext:
         self.jit_reachable = _jit_reachable_functions(tree, self)
         self.is_gate_module = any(
             path.endswith(sfx) for sfx in config.gate_suffixes
+        )
+        self.is_perf_gate_module = any(
+            path.endswith(sfx) for sfx in config.perf_gate_suffixes
         )
         parts = set(path.replace("\\", "/").split("/"))
         self.r3_applies = bool(parts & set(config.r3_dirs))

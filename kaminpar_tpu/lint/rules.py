@@ -37,6 +37,28 @@ ACC_CALLS = frozenset(
 )
 INT32_NAMES = frozenset({"jax.numpy.int32", "numpy.int32"})
 
+# R6: the memory/cost introspection surface that must stay behind the
+# gated perf helpers (telemetry/perf.py samples at barriers,
+# utils/heap_profiler.py behind profiling_enabled()).  Same hazard
+# class as R2's eager device queries: jax.live_arrays walks every live
+# buffer, device_memory_profile serializes a pprof proto, and
+# cost-analyzing an executable walks its HLO — all fine at a gated
+# barrier, pathological inside a hot loop or at import time.
+R6_QUERIES = frozenset(
+    {
+        "jax.live_arrays",
+        "jax.profiler.device_memory_profile",
+    }
+)
+R6_METHODS = frozenset(
+    {
+        "cost_analysis",
+        "memory_analysis",
+        "get_compiled_memory_stats",
+        "device_memory_profile",
+    }
+)
+
 
 def _terminal_name(func: ast.AST) -> Optional[str]:
     if isinstance(func, ast.Attribute):
@@ -313,6 +335,29 @@ class _RuleWalker(ast.NodeVisitor):
                     "jax.jit of a fresh lambda retraces on every call of "
                     "the enclosing function; define the jitted function "
                     "at module level",
+                )
+
+        # R6: eager device-memory/cost introspection outside the gated
+        # perf-barrier helpers
+        if not ctx.is_perf_gate_module:
+            if q in R6_QUERIES:
+                self._emit(
+                    "R6", node,
+                    f"direct {q}() walks device state eagerly (R2's "
+                    "hazard class); route through the gated perf "
+                    "helpers (telemetry.perf.sample_memory / "
+                    "utils.heap_profiler)",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in R6_METHODS
+            ):
+                self._emit(
+                    "R6", node,
+                    f".{node.func.attr}() introspects a compiled "
+                    "executable/device eagerly; the perf observatory "
+                    "(telemetry/perf.py) captures this at the compile "
+                    "boundary — use its snapshot instead",
                 )
 
         # R5: gather plans must be checked against the slot cap

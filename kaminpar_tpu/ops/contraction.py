@@ -213,6 +213,9 @@ def contract_clustering(
     n_floor, m_floor = shape_floors()
     n_pad_c = pad_size(c_n_i + 1, n_floor)
     m_pad_c = pad_size(max(c_m_i, 1), m_floor)
+    from ..caching import record_padding
+
+    record_padding(n=c_n_i + 1, n_pad=n_pad_c, m=c_m_i, m_pad=m_pad_c)
     coarse, cmap_final = _contract_part2(
         n_pad_c, m_pad_c, cmap, c_n, c_node_w, cu_g, cv_g, w_g, group_valid, c_m
     )

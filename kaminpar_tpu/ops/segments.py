@@ -54,6 +54,9 @@ def pad_k_bucket(k, max_block_weights, min_block_weights=None):
     Returns (k_pad, max_block_weights, min_block_weights).
     """
     k_pad = max(2, 1 << (int(k) - 1).bit_length())
+    from ..caching import record_padding
+
+    record_padding(k=int(k), k_pad=k_pad)
     if k_pad != k:
         pad = jnp.zeros(k_pad - int(k), dtype=ACC_DTYPE)
         max_block_weights = jnp.concatenate(

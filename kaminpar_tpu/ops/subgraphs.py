@@ -193,6 +193,9 @@ def slice_block(
     m_b = int(ext.edge_counts[block])
     n_pad_sub = pad_size(n_b + 1, n_floor)
     m_pad_sub = pad_size(max(m_b, 1), m_floor)
+    from ..caching import record_padding
+
+    record_padding(n=n_b + 1, n_pad=n_pad_sub, m=m_b, m_pad=m_pad_sub)
     row_ptr, src, dst, edge_w, node_w = _slice_block_kernel(
         ext.ls_s, ext.ld_s, ext.w_s, ext.node_w_s, ext.rowcount_s,
         ext.node_start[block], jnp.int32(n_b),

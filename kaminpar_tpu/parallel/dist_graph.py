@@ -190,6 +190,13 @@ def _assemble_dist_graph(
         v0, v1 = min(d * n_loc, n), min((d + 1) * n_loc, n)
         m_loc = max(m_loc, int(xadj[v1] - xadj[v0]))
     m_loc = pad_size(m_loc)
+    # pad-waste attribution for the sharded layout: every device pads
+    # its node range to n_loc and its edge slice to the max shard's
+    # bucket, so padded slots are D * per-shard slots against the m
+    # real edges — this row captures shard skew AND bucket rounding
+    from ..caching import record_padding
+
+    record_padding(n=n + 1, n_pad=n_pad, m=m, m_pad=m_loc * D)
 
     src_t = np.empty((D, m_loc), dtype=np.int32)
     dst_t = np.full((D, m_loc), pad_node, dtype=np.int32)

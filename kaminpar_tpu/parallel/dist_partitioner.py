@@ -272,6 +272,17 @@ class dKaMinPar:
                     }
                 )
             if owns_stream:
+                # per-rank memory rollup (perf.memory.ranks): collective
+                # — every process gathers its live-HBM figure, so the
+                # report shows residency skew between ranks the same way
+                # the aggregated timers show wall skew.  perf.enabled()
+                # is env+telemetry state, identical on all ranks.
+                from ..telemetry import perf as perf_mod
+
+                if perf_mod.enabled():
+                    telemetry.annotate(
+                        perf_ranks=perf_mod.rank_memory_rollup()
+                    )
                 if mgr is not None and mgr.enabled:
                     final_part = partition
                     ckpt_mod.barrier(

@@ -217,12 +217,23 @@ class Coarsener:
         self.current_n = c_n
         from .. import telemetry
 
+        # per-level resident-buffer accounting (perf.memory.levels):
+        # padded shapes and total device-array bytes of the coarse CSR —
+        # all host-side array metadata, never a device sync
+        g = coarse.graph
         telemetry.event(
             "coarsening-level",
             level=self.level,
             n=int(c_n),
             m=int(c_m),
             retries=retries,
+            n_pad=int(g.node_w.shape[0]),
+            m_pad=int(g.dst.shape[0]),
+            buffer_bytes=int(
+                g.row_ptr.nbytes + g.src.nbytes + g.dst.nbytes
+                + g.edge_w.nbytes + g.node_w.nbytes
+                + coarse.cmap.nbytes
+            ),
         )
         return True
 

@@ -171,6 +171,13 @@ def barrier(
         # nested (suspended) runs neither track stages nor checkpoint —
         # but they DO honor the wind-down verdict below
         deadline.note_stage(stage_id)
+        # device-memory watermark: the perf observatory samples the
+        # resident-bytes figure at exactly these multilevel barriers
+        # (host side, between launches; one bool check when disabled)
+        from ..telemetry import perf as perf_mod
+
+        if perf_mod.enabled():
+            perf_mod.sample_memory(stage_id, level=level)
         mgr = run.manager
         if mgr is not None and mgr.enabled:
             from .. import telemetry

@@ -178,13 +178,17 @@ def run_batch_cli(args, ctx) -> int:
                 f"wall={rec.wall_s:.3f}s{extra}"
             )
         counts = summary["counts"]
+        total_hist = (
+            summary.get("latency", {}).get("phases", {}).get("total", {})
+        )
         print(
             "SERVING total={} served={} anytime={} degraded={} "
-            "rejected={} failed={} cache_hit_rate={} drained={} "
-            "wall={:.3f}s".format(
+            "rejected={} failed={} cache_hit_rate={} p50_ms={} "
+            "p95_ms={} drained={} wall={:.3f}s".format(
                 len(records), counts["served"], counts["anytime"],
                 counts["degraded"], counts["rejected"], counts["failed"],
                 summary["cache"]["hit_rate"],
+                total_hist.get("p50_ms"), total_hist.get("p95_ms"),
                 int(summary["drained"]), wall,
             )
         )

@@ -104,6 +104,9 @@ class RequestRecord:
     bucket: str = ""  # executable bucket key "n_pad/m_pad/k_pad"
     degraded_sites: List[str] = field(default_factory=list)
     wall_s: float = 0.0
+    # per-phase latency breakdown in ms (admission_wait / resolve /
+    # compute / gate) — the per-request rows behind serving.latency
+    phases: Dict[str, float] = field(default_factory=dict)
     partition: Optional[np.ndarray] = None  # library callers only
 
     def to_dict(self) -> dict:
@@ -127,6 +130,8 @@ class RequestRecord:
             d["gate_valid"] = bool(self.gate_valid)
         if self.degraded_sites:
             d["degraded_sites"] = list(self.degraded_sites)
+        if self.phases:
+            d["phases"] = dict(self.phases)
         return d
 
 
@@ -184,6 +189,20 @@ class PartitionService:
         # per-request-class (executable bucket) crash counters
         self._class_failures: Dict[str, int] = {}
         self._drained = False
+        # serving latency metrics (telemetry/perf.py Histogram): one
+        # streaming histogram per request phase plus a per-class (bucket)
+        # rollup — the report's serving.latency section.  Windowed with
+        # the records (reset_records), so a long-lived service reports
+        # per-window percentiles instead of frozen lifetime averages.
+        from ..telemetry.perf import Histogram
+
+        self._latency: Dict[str, Histogram] = {
+            phase: Histogram()
+            for phase in ("admission_wait", "resolve", "compute",
+                          "gate", "total")
+        }
+        self._class_latency: Dict[str, Histogram] = {}
+        self._submit_t: Dict[str, float] = {}  # id -> submit stamp
 
     # -- admission -----------------------------------------------------
 
@@ -270,6 +289,7 @@ class PartitionService:
                 self._queued_cost[req.request_id] = cost
                 self._order[req.request_id] = next(self._seq)
                 self._submit_class[req.request_id] = cls
+                self._submit_t[req.request_id] = time.perf_counter()
                 rec = None
         if rec is not None:
             telemetry.event(
@@ -296,6 +316,7 @@ class PartitionService:
                 self._queued_cost.pop(req.request_id, None)
                 self._order.pop(req.request_id, None)
                 cls_submit = self._submit_class.pop(req.request_id, "")
+                submit_t = self._submit_t.pop(req.request_id, None)
             if deadline_mod.draining():
                 self._drained = True
                 rec = RequestRecord(
@@ -303,7 +324,11 @@ class PartitionService:
                     reason="draining", k=int(req.k or 0),
                 )
             else:
-                rec = self._execute(req, cls_submit)
+                wait_s = (
+                    time.perf_counter() - submit_t
+                    if submit_t is not None else 0.0
+                )
+                rec = self._execute(req, cls_submit, wait_s)
             with self._lock:
                 self._records.append(rec)
             done.append(rec)
@@ -391,9 +416,11 @@ class PartitionService:
         )
 
     def _execute(self, req: PartitionRequest,
-                 cls_submit: str = "") -> RequestRecord:
+                 cls_submit: str = "",
+                 wait_s: float = 0.0) -> RequestRecord:
         from ..kaminpar import KaMinPar
         from ..resilience.checkpoint import SimulatedPreemption
+        from ..utils import timer
         from ..utils.logger import OutputLevel
 
         t0 = time.perf_counter()
@@ -402,8 +429,10 @@ class PartitionService:
         )
         cls = cls_submit or "unsized"
         pre_degraded: List[str] = []
+        resolve_s = compute_s = gate_s = 0.0
         try:
             graph = self._resolve_graph(req.graph)
+            resolve_s = time.perf_counter() - t0
             rec.n, rec.m = int(graph.n), int(graph.m)
             ctx = self._request_ctx(req)
             key = caching.result_cache_key(graph, ctx)
@@ -418,6 +447,7 @@ class PartitionService:
                 rec.gate_valid = metrics.get("gate_valid")
                 rec.partition = part if self.config.keep_partitions else None
                 rec.wall_s = time.perf_counter() - t0
+                self._observe_latency(rec, wait_s, resolve_s, 0.0, 0.0)
                 telemetry.event(
                     "serving", action="cache-hit", request=req.request_id,
                 )
@@ -430,9 +460,15 @@ class PartitionService:
             if self.quiet:
                 solver.set_output_level(OutputLevel.QUIET)
             solver.set_graph(graph)
+            t_c0 = time.perf_counter()
             part = solver.compute_partition(
                 k=int(req.k), epsilon=float(req.epsilon), seed=req.seed,
             )
+            # the gate runs inside compute_partition under its own
+            # top-level scope; the per-run timer reset at compute entry
+            # makes this elapsed figure THIS request's gate time
+            gate_s = timer.GLOBAL_TIMER.elapsed("output-gate")
+            compute_s = max(time.perf_counter() - t_c0 - gate_s, 0.0)
         except (KeyboardInterrupt, SystemExit, SimulatedPreemption):
             raise  # process-fatal by contract; never a request verdict
         except BaseException as exc:  # the isolation boundary
@@ -444,6 +480,12 @@ class PartitionService:
                 "malformed-input" if _input_shaped(exc) else "exception"
             )
             rec.wall_s = time.perf_counter() - t0
+            # failures carry latency too (whatever phases completed) —
+            # a timeout-shaped failure mode must be visible in p99
+            self._observe_latency(
+                rec, wait_s, resolve_s,
+                max(rec.wall_s - resolve_s - gate_s, 0.0), gate_s,
+            )
             # crash-shaped failures advance the request-class breaker;
             # refusal-shaped degradations (breaker_relevant=False) and
             # malformed inputs do not — a bad file says nothing about
@@ -501,6 +543,7 @@ class PartitionService:
             rec.verdict = "served"
         rec.partition = part if self.config.keep_partitions else None
         rec.wall_s = time.perf_counter() - t0
+        self._observe_latency(rec, wait_s, resolve_s, compute_s, gate_s)
         if rec.verdict == "served" and rec.feasible:
             # only clean full-effort results are worth replaying; an
             # anytime/degraded answer must not be served to a request
@@ -512,6 +555,67 @@ class PartitionService:
                 nbytes=np.asarray(part).nbytes,
             )
         return rec
+
+    def _observe_latency(self, rec: RequestRecord, wait_s: float,
+                         resolve_s: float, compute_s: float,
+                         gate_s: float) -> None:
+        """Fold one request's phase walls into the streaming histograms
+        (overall per-phase + per-class total) and stamp the per-request
+        breakdown onto its record.  `total` includes the admission wait
+        — the latency a CALLER observes, not just the execution."""
+        from ..telemetry.perf import Histogram
+
+        total_s = rec.wall_s + wait_s
+        phases = {
+            "admission_wait": wait_s,
+            "resolve": resolve_s,
+            "compute": compute_s,
+            "gate": gate_s,
+            "total": total_s,
+        }
+        for name, v in phases.items():
+            self._latency[name].record(v)
+        rec.phases = {
+            f"{name}_ms": round(v * 1000.0, 3)
+            for name, v in phases.items()
+        }
+        # cache hits never touch an executable (rec.bucket stays empty)
+        # but still belong to their shape class for the rollup
+        cls = rec.bucket or self._class_key(rec.n, rec.m, int(rec.k or 0))
+        hist = self._class_latency.get(cls)
+        if hist is None:
+            hist = self._class_latency[cls] = Histogram()
+        hist.record(total_s)
+
+    def latency_summary(self) -> dict:
+        """The report's ``serving.latency`` section: per-phase
+        histograms (p50/p95/p99 over log-spaced buckets) and the
+        per-class rollup joined with executable-bucket reuse counts."""
+        sightings = self._buckets.per_bucket()
+        classes = {}
+        for cls, hist in self._class_latency.items():
+            snap = hist.snapshot()
+            seen = sightings.get(cls, 0)
+            classes[cls] = {
+                "requests": snap["count"],
+                "p50_ms": snap["p50_ms"],
+                "p95_ms": snap["p95_ms"],
+                "p99_ms": snap["p99_ms"],
+                "mean_ms": snap["mean_ms"],
+                # executable utilization of the class: how often its
+                # compiled programs were reused rather than rebuilt
+                "executable_sightings": int(seen),
+                "executable_reuse": (
+                    round((seen - 1) / seen, 4) if seen else 0.0
+                ),
+            }
+        return {
+            "phases": {
+                name: hist.snapshot()
+                for name, hist in self._latency.items()
+            },
+            "classes": classes,
+        }
 
     # -- drain / reporting ---------------------------------------------
 
@@ -532,11 +636,20 @@ class PartitionService:
         surface — every verdict must land in a report — so it is never
         pruned implicitly; a long-lived service exports a report per
         batch window and then resets, which bounds host memory under
-        sustained traffic.  Cache/bucket/breaker state is kept."""
+        sustained traffic.  Cache/bucket/breaker state is kept, but
+        their WINDOW counters and the latency histograms restart with
+        the records — each exported window carries its own hit rates
+        and percentiles instead of averages frozen by hours of history.
+        """
         with self._lock:
             out = self._records
             self._records = []
             self._admission_rejected = 0
+            for hist in self._latency.values():
+                hist.reset()
+            self._class_latency.clear()
+        self._result_cache.begin_window()
+        self._buckets.begin_window()
         return out
 
     def result_cache_stats(self) -> dict:
@@ -568,6 +681,7 @@ class PartitionService:
                 "executable": self._buckets.stats(),
                 "hit_rate": result_stats["hit_rate"],
             },
+            "latency": self.latency_summary(),
             "drained": bool(self._drained),
         }
 

@@ -121,6 +121,14 @@ def enable() -> None:
         compile_account.install()
     except Exception:
         pass
+    # the perf observatory hooks the backend-compile boundary the same
+    # way (idempotent, no-op while disabled / KAMINPAR_TPU_PERF=0)
+    try:
+        from . import perf
+
+        perf.install()
+    except Exception:
+        pass
 
 
 def disable() -> None:
@@ -147,6 +155,12 @@ def reset() -> None:
         from . import compile_account
 
         compile_account.reset()
+    except Exception:
+        pass
+    try:
+        from . import perf
+
+        perf.reset()
     except Exception:
         pass
 
@@ -343,6 +357,10 @@ def export_cli_outputs(args, extra_run=None, quiet: bool = False) -> int:
         write_run_report(args.report_json, extra_run=extra_run)
         if not quiet and primary:
             print(f"REPORT written to {args.report_json}")
+            print(
+                "  triage: python -m kaminpar_tpu.telemetry.top "
+                f"{args.report_json}"
+            )
     if getattr(args, "diff_base", None):
         if not getattr(args, "report_json", None):
             import sys

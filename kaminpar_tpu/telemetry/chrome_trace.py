@@ -157,6 +157,27 @@ def chrome_trace() -> dict:
                     "args": e.get("attrs", {}),
                 }
             )
+            if e["name"] == "perf-memory":
+                # barrier memory watermarks render as a counter track
+                # (telemetry/perf.py samples; one curve per byte figure)
+                attrs = e.get("attrs", {})
+                counters = {
+                    key: attrs[key]
+                    for key in ("live_bytes", "bytes_in_use")
+                    if key in attrs
+                }
+                if counters:
+                    trace_events.append(
+                        {
+                            "ph": "C",
+                            "cat": "perf",
+                            "name": "memory",
+                            "ts": round(e["t"] * 1e6, 3),
+                            "pid": pid,
+                            "tid": 0,
+                            "args": counters,
+                        }
+                    )
         for series in payload.get("progress", []):
             trace_events.extend(_counter_events(pid, series))
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}

@@ -1,11 +1,12 @@
 """Run-report diff and regression gate.
 
 `python -m kaminpar_tpu.telemetry.diff BASE.report.json CAND.report.json`
-aligns two run reports (schema v1 or v2) by dotted scope path and by
-progress series, prints the wall / cut / convergence deltas, and exits
-non-zero when the candidate regresses past the configurable thresholds
-— the mechanical answer to "are these two runs the same solver?" that
-the reference's parseable timer output only enables by hand.
+aligns two run reports (schema v1 through v5) by dotted scope path, by
+progress series, and — for serving runs — by request id, prints the
+wall / cut / convergence / serving deltas, and exits non-zero when the
+candidate regresses past the configurable thresholds — the mechanical
+answer to "are these two runs the same solver?" that the reference's
+parseable timer output only enables by hand.
 
 Gated (exit 1 on regression):
   * edge cut:        cand.result.cut  > base * (1 + --cut-threshold)
@@ -13,12 +14,20 @@ Gated (exit 1 on regression):
   * total wall:      cand wall > base * (1 + --wall-threshold), with an
                      absolute --min-wall-s floor so micro-run noise
                      cannot trip the gate
+  * serving (both reports carry an enabled v4+ `serving` section):
+      - served rate: cand served a smaller fraction of its batch than
+        base (rate, not absolute count — batch sizes may differ)
+      - cache hit rate: cand dropped more than --hit-rate-threshold
+        (absolute) below base
 
 Informational (printed, never gated):
   * per-scope wall deltas (scope_tree alignment, largest first)
   * compile vs execute split deltas (schema v2 `compile` section)
   * progress-series convergence deltas: iterations to converge and, for
     series carrying a `cut` stat, the final per-series cut
+  * per-request verdict transitions (serving requests aligned by id)
+  * roofline totals deltas (schema v5 `perf` section: bytes, hbm_util,
+    pad waste)
 
 Exit codes: 0 pass, 1 regression, 2 usage/IO error.  check_all.sh runs
 the self-diff (identical reports, expect 0) and a perturbed diff
@@ -35,6 +44,8 @@ from typing import Any, Dict, List, Optional, Tuple
 DEFAULT_WALL_THRESHOLD = 0.10
 DEFAULT_CUT_THRESHOLD = 0.10
 DEFAULT_MIN_WALL_S = 0.05
+#: absolute serving cache hit-rate drop tolerated before the gate fires
+DEFAULT_HIT_RATE_THRESHOLD = 0.10
 
 
 def load_report(path: str) -> dict:
@@ -111,12 +122,104 @@ def _pct(new: float, old: float) -> str:
     return f"{100.0 * (new - old) / abs(old):+.1f}%"
 
 
+def diff_serving(
+    base: dict,
+    cand: dict,
+    hit_rate_threshold: float = DEFAULT_HIT_RATE_THRESHOLD,
+) -> Tuple[List[str], List[str]]:
+    """Serving-section diff (schema v4+): align requests by id, report
+    verdict transitions, gate served-rate and cache-hit-rate
+    regressions.  Returns (lines, failures); both empty unless BOTH
+    reports carry an enabled serving section — a single-shot run diffed
+    against a serving run is a workload change, not a regression."""
+    sb = base.get("serving") or {}
+    sc = cand.get("serving") or {}
+    lines: List[str] = []
+    failures: List[str] = []
+    if not (sb.get("enabled") and sc.get("enabled")):
+        if sb.get("enabled") != sc.get("enabled"):
+            lines.append(
+                "serving: only "
+                + ("base" if sb.get("enabled") else "cand")
+                + " ran in serve mode (section not compared)"
+            )
+        return lines, failures
+
+    counts_b = sb.get("counts") or {}
+    counts_c = sc.get("counts") or {}
+    served_b = int(counts_b.get("served", 0))
+    served_c = int(counts_c.get("served", 0))
+    total_b = sum(int(v) for v in counts_b.values())
+    total_c = sum(int(v) for v in counts_c.values())
+    lines.append(
+        "serving: served {}/{} -> {}/{}, failed {} -> {}, "
+        "rejected {} -> {}".format(
+            served_b, total_b, served_c, total_c,
+            counts_b.get("failed", 0), counts_c.get("failed", 0),
+            counts_b.get("rejected", 0), counts_c.get("rejected", 0),
+        )
+    )
+    # gate on the served *rate*, not the absolute count — base and cand
+    # may come from different batch sizes, and a 12/12 candidate is no
+    # regression against a 16/16 base
+    if total_b > 0 and total_c > 0:
+        rate_b = served_b / total_b
+        rate_c = served_c / total_c
+        if rate_c < rate_b - 1e-9:
+            failures.append(
+                "served rate regressed: "
+                f"{served_b}/{total_b} -> {served_c}/{total_c}"
+            )
+
+    hr_b = (sb.get("cache") or {}).get("hit_rate")
+    hr_c = (sc.get("cache") or {}).get("hit_rate")
+    if hr_b is not None and hr_c is not None:
+        lines.append(f"serving cache hit_rate: {hr_b} -> {hr_c}")
+        if float(hr_c) < float(hr_b) - hit_rate_threshold:
+            failures.append(
+                f"serving cache hit rate regressed {hr_b} -> {hr_c} "
+                f"(threshold -{hit_rate_threshold})"
+            )
+
+    # per-request alignment by id: verdict transitions are the triage
+    # detail behind a served-count regression (informational — the
+    # count gate above decides pass/fail)
+    rb = {r.get("request_id"): r for r in sb.get("requests") or []}
+    rc = {r.get("request_id"): r for r in sc.get("requests") or []}
+    changed = [
+        (rid, rb[rid].get("verdict"), rc[rid].get("verdict"))
+        for rid in rb
+        if rid in rc and rb[rid].get("verdict") != rc[rid].get("verdict")
+    ]
+    for rid, vb, vc in changed[:8]:
+        lines.append(f"  request {rid}: {vb} -> {vc}")
+    only_b = sorted(set(rb) - set(rc))
+    only_c = sorted(set(rc) - set(rb))
+    if only_b:
+        lines.append(f"  requests only in base: {only_b[:5]}")
+    if only_c:
+        lines.append(f"  requests only in cand: {only_c[:5]}")
+
+    # latency movement (informational): p95 of the caller-observed total
+    def p95(s):
+        return (
+            ((s.get("latency") or {}).get("phases") or {})
+            .get("total", {}).get("p95_ms")
+        )
+
+    pb, pc = p95(sb), p95(sc)
+    if pb is not None and pc is not None:
+        lines.append(f"serving p95 total: {pb}ms -> {pc}ms")
+    return lines, failures
+
+
 def diff_reports(
     base: dict,
     cand: dict,
     wall_threshold: float = DEFAULT_WALL_THRESHOLD,
     cut_threshold: float = DEFAULT_CUT_THRESHOLD,
     min_wall_s: float = DEFAULT_MIN_WALL_S,
+    hit_rate_threshold: float = DEFAULT_HIT_RATE_THRESHOLD,
 ) -> Tuple[List[str], List[str]]:
     """Returns (report lines, gated failures); empty failures = pass."""
     lines: List[str] = []
@@ -218,6 +321,27 @@ def diff_reports(
         lines.append(
             f"progress series: {nb} base / {nc} cand, {len(pairs)} aligned"
         )
+
+    # -- serving (schema v4+; gated on served rate + cache hit rate) -----
+    s_lines, s_failures = diff_serving(
+        base, cand, hit_rate_threshold=hit_rate_threshold
+    )
+    lines.extend(s_lines)
+    failures.extend(s_failures)
+
+    # -- perf roofline totals (schema v5; informational) -----------------
+    pb = (base.get("perf") or {}).get("totals") or {}
+    pc = (cand.get("perf") or {}).get("totals") or {}
+    if pb and pc:
+        parts = [
+            f"perf: bytes {pb.get('bytes', 0):.3g} -> "
+            f"{pc.get('bytes', 0):.3g}"
+        ]
+        for key in ("hbm_util", "pad_waste"):
+            vb, vc = pb.get(key), pc.get(key)
+            if vb is not None and vc is not None:
+                parts.append(f"{key} {vb} -> {vc}")
+        lines.append(", ".join(parts))
     return lines, failures
 
 
@@ -242,6 +366,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "fires (default 0.05 s)",
     )
     ap.add_argument(
+        "--hit-rate-threshold", type=float,
+        default=DEFAULT_HIT_RATE_THRESHOLD,
+        help="absolute serving cache hit-rate drop tolerated before the "
+        "serving gate fires (default 0.10; only applies when both "
+        "reports ran in serve mode)",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="emit the verdict as one JSON line instead of text",
     )
@@ -260,6 +391,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         wall_threshold=args.wall_threshold,
         cut_threshold=args.cut_threshold,
         min_wall_s=args.min_wall_s,
+        hit_rate_threshold=args.hit_rate_threshold,
     )
     if args.json:
         print(json.dumps({

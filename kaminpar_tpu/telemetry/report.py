@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 4
+SCHEMA_VERSION = 5
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -70,6 +70,31 @@ def _compile_section() -> dict:
     except Exception:
         return {"caveat": "compile accounting unavailable",
                 "totals": {}, "phases": {}}
+
+
+def _perf_section(levels, perf_ranks=None) -> dict:
+    """Schema v5 `perf` section: roofline rows, memory watermarks (with
+    the per-level CSR buffer accounting folded in), pad-waste rows.
+    Well-formed disabled default when the observatory is unavailable."""
+    try:
+        from . import perf
+
+        section = perf.snapshot()
+    except Exception:
+        return {"enabled": False,
+                "caveat": "perf observatory unavailable"}
+    mem = section.setdefault("memory", {})
+    # per-level resident CSR/partition buffer bytes, from the
+    # coarsener's level events (host-side metadata, never a device pull)
+    mem["levels"] = [
+        {k: lv[k] for k in ("level", "n", "m", "n_pad", "m_pad",
+                            "buffer_bytes") if k in lv}
+        for lv in levels
+        if "buffer_bytes" in lv
+    ]
+    if perf_ranks:
+        mem["ranks"] = perf_ranks
+    return section
 
 
 def _fault_section() -> dict:
@@ -117,13 +142,19 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # and cache statistics (serving/service.py); single-shot runs carry
     # the well-formed disabled default
     serving = info.pop("serving", {"enabled": False})
+    # schema v5: the dist driver's per-rank memory rollup (collective,
+    # gathered before the report) folds into the perf section below
+    perf_ranks = info.pop("perf_ranks", None)
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
 
     levels = [
         {"level": e.attrs.get("level"), **{
-            k: e.attrs[k] for k in ("n", "m", "retries") if k in e.attrs
+            k: e.attrs[k]
+            for k in ("n", "m", "retries", "n_pad", "m_pad",
+                      "buffer_bytes")
+            if k in e.attrs
         }}
         for e in _events("coarsening-level")
     ]
@@ -203,6 +234,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # (served/anytime/degraded/rejected/failed), admission caps, and
         # the bounded result/executable cache hit rates
         "serving": serving,
+        # schema v5: the performance observatory — per-scope roofline
+        # rows (FLOPs/bytes vs measured wall vs device peak), barrier
+        # memory watermarks + per-level buffer bytes, and pad-waste
+        # attribution per (scope, bucket)
+        "perf": _perf_section(levels, perf_ranks),
     }
     if agg is not None:
         report["timers_aggregated"] = agg

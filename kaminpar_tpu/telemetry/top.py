@@ -1,0 +1,325 @@
+"""Run-report triage CLI: `python -m kaminpar_tpu.telemetry.top REPORT`.
+
+The read-first tool of the performance observatory (docs/performance.md
+"roofline triage workflow"): given one `--report-json` artifact it
+renders the top-N scopes by wall, by bytes moved, by utilization
+deficit (wall spent below the roofline — the fusion-target ranking),
+and the pad-waste rows (what fraction of each launch was padding —
+cross-reference BEFORE blaming a kernel), plus the memory watermarks
+and, for serve-mode reports, the latency percentiles.
+
+`--diff BASE` aligns a second report by scope path (the same alignment
+`telemetry.diff` gates on) and prints wall / bytes / utilization
+deltas side by side.
+
+Exit codes: 0 rendered, 1 only with `--require-roofline` when the
+report carries no roofline rows (the check_all.sh smoke assertion that
+the observatory did not silently die), 2 usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from .diff import flatten_scopes, load_report
+
+DEFAULT_TOP_N = 8
+
+
+def _fmt(v: Any, digits: int = 3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{digits}g}"
+    return str(v)
+
+
+def _table(headers: List[str], rows: List[List[Any]]) -> List[str]:
+    table = [headers] + [[_fmt(c) for c in row] for row in rows]
+    widths = [
+        max(len(str(row[i])) for row in table)
+        for i in range(len(headers))
+    ]
+    return [
+        "  ".join(str(cell).ljust(widths[i])
+                  for i, cell in enumerate(row))
+        for row in table
+    ]
+
+
+def roofline_rows(report: dict) -> Dict[str, dict]:
+    return (report.get("perf") or {}).get("roofline") or {}
+
+
+def render_report(report: dict, top_n: int = DEFAULT_TOP_N) -> List[str]:
+    lines: List[str] = []
+    perf = report.get("perf") or {}
+    peaks = perf.get("peaks") or {}
+    totals = perf.get("totals") or {}
+    lines.append(
+        f"peaks: {_fmt(peaks.get('gbps'))} GB/s, "
+        f"{_fmt(peaks.get('gflops'))} GFLOP/s "
+        f"({peaks.get('source', '?')}); totals: "
+        f"{_fmt(totals.get('bytes'))} bytes, "
+        f"{_fmt(totals.get('flops'))} flops, "
+        f"hbm_util={_fmt(totals.get('hbm_util'))}, "
+        f"pad_waste={_fmt(totals.get('pad_waste'))}"
+    )
+    axes = totals.get("pad_waste_axes") or {}
+    if axes:
+        # the headline sums element counts across axes, so m dominates;
+        # the per-axis twins are where a k-bucket regression shows up
+        lines.append(
+            "pad_waste by axis: "
+            + ", ".join(
+                f"{a}={_fmt(axes[a])}" for a in ("n", "m", "k")
+                if a in axes
+            )
+        )
+
+    # -- top scopes by wall (every report has a scope tree) --------------
+    scopes = flatten_scopes(report.get("scope_tree", {}))
+    by_wall = sorted(scopes.items(), key=lambda kv: -kv[1])[:top_n]
+    if by_wall:
+        lines.append("")
+        lines.append(f"top {len(by_wall)} scopes by wall:")
+        lines.extend(_table(
+            ["scope", "wall_s"],
+            [[path, round(w, 4)] for path, w in by_wall],
+        ))
+
+    # -- roofline: by bytes and by utilization deficit -------------------
+    roof = roofline_rows(report)
+    if roof:
+        by_bytes = sorted(
+            roof.items(), key=lambda kv: -kv[1].get("bytes", 0)
+        )[:top_n]
+        lines.append("")
+        lines.append(f"top {len(by_bytes)} scopes by bytes accessed:")
+        lines.extend(_table(
+            ["scope", "bytes", "flops", "wall_s", "GB/s", "hbm_util"],
+            [
+                [p, e.get("bytes"), e.get("flops"), e.get("wall_s"),
+                 e.get("achieved_gbps"), e.get("hbm_util")]
+                for p, e in by_bytes
+            ],
+        ))
+        with_deficit = [
+            (p, e) for p, e in roof.items() if e.get("deficit_s")
+        ]
+        by_deficit = sorted(
+            with_deficit, key=lambda kv: -kv[1]["deficit_s"]
+        )[:top_n]
+        if by_deficit:
+            lines.append("")
+            lines.append(
+                f"top {len(by_deficit)} scopes by utilization deficit "
+                "(wall below the roofline — fusion-target ranking):"
+            )
+            lines.extend(_table(
+                ["scope", "deficit_s", "hbm_util", "flops_util",
+                 "compiles"],
+                [
+                    [p, e.get("deficit_s"), e.get("hbm_util"),
+                     e.get("flops_util"), e.get("compiles")]
+                    for p, e in by_deficit
+                ],
+            ))
+    else:
+        lines.append("")
+        lines.append(
+            "no roofline rows (schema < 5, KAMINPAR_TPU_PERF=0, or a "
+            "fully warm executable cache — cost is captured per backend "
+            "compile)"
+        )
+
+    # -- pad waste -------------------------------------------------------
+    pad = perf.get("pad_waste") or []
+
+    def worst_waste(row: dict) -> float:
+        return max(
+            (row.get(axis + "_waste", 0.0) for axis in ("n", "m", "k")),
+            default=0.0,
+        )
+
+    by_waste = sorted(pad, key=lambda r: -worst_waste(r))[:top_n]
+    if by_waste:
+        lines.append("")
+        lines.append(f"top {len(by_waste)} pad-waste rows:")
+        lines.extend(_table(
+            ["scope", "bucket", "launches", "n_waste", "m_waste",
+             "k_waste"],
+            [
+                [r.get("scope"), r.get("bucket"), r.get("launches"),
+                 r.get("n_waste"), r.get("m_waste"), r.get("k_waste")]
+                for r in by_waste
+            ],
+        ))
+
+    # -- memory watermarks ----------------------------------------------
+    mem = perf.get("memory") or {}
+    samples = mem.get("samples") or []
+    if samples or mem.get("peak_live_bytes"):
+        lines.append("")
+        head = f"memory: peak live {_fmt(mem.get('peak_live_bytes'))} B"
+        if mem.get("hbm_limit_bytes"):
+            head += (
+                f", HBM limit {_fmt(mem.get('hbm_limit_bytes'))} B, "
+                f"headroom {_fmt(mem.get('headroom_bytes'))} B"
+            )
+        lines.append(head)
+        top_samples = sorted(
+            samples, key=lambda s: -s.get("live_bytes", 0)
+        )[:top_n]
+        if top_samples:
+            lines.extend(_table(
+                ["stage", "live_bytes"],
+                [[s.get("stage"), s.get("live_bytes")]
+                 for s in top_samples],
+            ))
+        levels = mem.get("levels") or []
+        if levels:
+            lines.append("per-level buffers:")
+            lines.extend(_table(
+                ["level", "n", "m", "n_pad", "m_pad", "buffer_bytes"],
+                [
+                    [lv.get("level"), lv.get("n"), lv.get("m"),
+                     lv.get("n_pad"), lv.get("m_pad"),
+                     lv.get("buffer_bytes")]
+                    for lv in levels
+                ],
+            ))
+        ranks = mem.get("ranks") or []
+        if len(ranks) > 1:
+            lines.append("per-rank live bytes:")
+            lines.extend(_table(
+                ["rank", "live_bytes"],
+                [[r.get("rank"), r.get("live_bytes")] for r in ranks],
+            ))
+
+    # -- serving latency -------------------------------------------------
+    serving = report.get("serving") or {}
+    latency = serving.get("latency") or {}
+    phases = latency.get("phases") or {}
+    if serving.get("enabled") and phases:
+        lines.append("")
+        lines.append("serving latency (per phase):")
+        lines.extend(_table(
+            ["phase", "count", "p50_ms", "p95_ms", "p99_ms", "max_ms"],
+            [
+                [name, h.get("count"), h.get("p50_ms"), h.get("p95_ms"),
+                 h.get("p99_ms"), h.get("max_ms")]
+                for name, h in phases.items()
+            ],
+        ))
+        classes = latency.get("classes") or {}
+        if classes:
+            lines.append("per request class (executable bucket):")
+            lines.extend(_table(
+                ["class", "requests", "p50_ms", "p95_ms", "reuse"],
+                [
+                    [cls, c.get("requests"), c.get("p50_ms"),
+                     c.get("p95_ms"), c.get("executable_reuse")]
+                    for cls, c in sorted(classes.items())
+                ],
+            ))
+    return lines
+
+
+def render_diff(base: dict, cand: dict,
+                top_n: int = DEFAULT_TOP_N) -> List[str]:
+    """Side-by-side scope deltas: wall from the scope trees (every
+    schema), bytes/utilization from the roofline rows (v5)."""
+    lines: List[str] = []
+    sb = flatten_scopes(base.get("scope_tree", {}))
+    sc = flatten_scopes(cand.get("scope_tree", {}))
+    shared = sorted(
+        set(sb) & set(sc),
+        key=lambda p: -abs(sc[p] - sb[p]),
+    )[:top_n]
+    rb, rc = roofline_rows(base), roofline_rows(cand)
+    if shared:
+        lines.append("scope deltas (base -> cand):")
+        rows = []
+        for path in shared:
+            eb, ec = rb.get(path, {}), rc.get(path, {})
+            rows.append([
+                path,
+                f"{sb[path]:.3f}->{sc[path]:.3f}",
+                f"{_fmt(eb.get('bytes'))}->{_fmt(ec.get('bytes'))}",
+                f"{_fmt(eb.get('hbm_util'))}->"
+                f"{_fmt(ec.get('hbm_util'))}",
+            ])
+        lines.extend(_table(
+            ["scope", "wall_s", "bytes", "hbm_util"], rows
+        ))
+    tb = (base.get("perf") or {}).get("totals") or {}
+    tc = (cand.get("perf") or {}).get("totals") or {}
+    if tb or tc:
+        lines.append(
+            f"totals: hbm_util {_fmt(tb.get('hbm_util'))} -> "
+            f"{_fmt(tc.get('hbm_util'))}, pad_waste "
+            f"{_fmt(tb.get('pad_waste'))} -> "
+            f"{_fmt(tc.get('pad_waste'))}"
+        )
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kaminpar_tpu.telemetry.top",
+        description="triage a run report: top scopes by wall / bytes / "
+        "utilization deficit / pad waste, memory watermarks, serving "
+        "latency",
+    )
+    ap.add_argument("report", help="run-report JSON (--report-json)")
+    ap.add_argument(
+        "--top", type=int, default=DEFAULT_TOP_N, metavar="N",
+        help=f"rows per ranking (default {DEFAULT_TOP_N})",
+    )
+    ap.add_argument(
+        "--diff", default=None, metavar="BASE.report.json",
+        help="also print scope-aligned wall/bytes/utilization deltas "
+        "against a baseline report",
+    )
+    ap.add_argument(
+        "--require-roofline", action="store_true",
+        help="exit 1 when the report carries no roofline rows (CI "
+        "assertion that cost capture ran)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the perf section as JSON instead of tables",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        report = load_report(args.report)
+        base = load_report(args.diff) if args.diff else None
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.get("perf") or {}))
+    else:
+        for line in render_report(report, top_n=args.top):
+            print(line)
+        if base is not None:
+            print()
+            for line in render_diff(base, report, top_n=args.top):
+                print(line)
+    if args.require_roofline and not roofline_rows(report):
+        print(
+            "error: report carries no roofline rows "
+            "(--require-roofline)", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

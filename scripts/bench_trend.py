@@ -14,9 +14,12 @@ Usage:
 
 `--check` exits non-zero when a recorded round is malformed (unreadable
 JSON, rc==0 without a parsed BENCH line, parsed line missing the metric
-fields) — cut/wall movements between rounds are PRINTED, not gated:
-rounds run on different code by design, and the per-PR regression gate
-is `telemetry.diff` on like-for-like reports (scripts/check_all.sh).
+fields, a schema-v5 report without its `perf` section) — cut/wall and
+the perf-observatory columns' movements between rounds (hbm_util,
+pad_waste, p95_ms) are PRINTED, not gated: rounds run on different code
+by design, and the per-PR regression gate is `telemetry.diff` on
+like-for-like reports (scripts/check_all.sh), which DOES gate serving
+hit-rate and served-count regressions.
 """
 
 from __future__ import annotations
@@ -63,6 +66,15 @@ def check_round(path: str, entry: Any) -> List[str]:
                 errors.append(
                     f"{name}: embedded report lacks schema_version"
                 )
+            elif (
+                isinstance(report, dict)
+                and isinstance(report.get("schema_version"), int)
+                and report["schema_version"] >= 5
+                and "perf" not in report
+            ):
+                errors.append(
+                    f"{name}: schema-v5 report carries no perf section"
+                )
     return errors
 
 
@@ -75,6 +87,14 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
     # without a serving section show "-")
     serving = report.get("serving") or {}
     cache_hit = (serving.get("cache") or {}).get("hit_rate")
+    # v5 reports carry the perf observatory's headline columns: overall
+    # achieved-vs-peak HBM utilization, overall padding waste, and (for
+    # serve-mode rounds) the caller-observed p95 latency
+    perf_totals = (report.get("perf") or {}).get("totals") or {}
+    p95_ms = (
+        ((serving.get("latency") or {}).get("phases") or {})
+        .get("total", {}).get("p95_ms")
+    )
     return {
         "round": os.path.basename(path),
         "rc": entry.get("rc"),
@@ -85,6 +105,11 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "platform": parsed.get("platform"),
         "compile_s": compile_totals.get("compile_s"),
         "cache_hit": cache_hit,
+        "hbm_util": parsed.get("hbm_util", perf_totals.get("hbm_util")),
+        "pad_waste": parsed.get(
+            "pad_waste", perf_totals.get("pad_waste")
+        ),
+        "p95_ms": p95_ms,
         "schema": report.get("schema_version"),
     }
 
@@ -99,8 +124,8 @@ def _fmt(v: Optional[Any]) -> str:
 
 def render(rows: List[Dict[str, Any]]) -> str:
     cols = ("round", "rc", "cut", "vs_baseline", "total_s",
-            "coarsening_s", "compile_s", "cache_hit", "platform",
-            "schema")
+            "coarsening_s", "compile_s", "cache_hit", "hbm_util",
+            "pad_waste", "p95_ms", "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -117,6 +142,25 @@ def render(rows: List[Dict[str, Any]]) -> str:
                     f"note: {prev['round']} -> {r['round']} cut moved "
                     f"{delta:+.1f}%"
                 )
+        if prev:
+            # perf-observatory movement notes (printed, never gated —
+            # see the module docstring's gating rationale)
+            for col, floor in (("hbm_util", 0.01), ("pad_waste", 0.05),
+                               ("p95_ms", None)):
+                a, b = prev.get(col), r.get(col)
+                if a is None or b is None:
+                    continue
+                if col == "p95_ms":
+                    if a > 0 and abs(b - a) / a >= 0.5:
+                        lines.append(
+                            f"note: {prev['round']} -> {r['round']} "
+                            f"p95_ms moved {a} -> {b}"
+                        )
+                elif abs(b - a) >= floor:
+                    lines.append(
+                        f"note: {prev['round']} -> {r['round']} "
+                        f"{col} moved {a} -> {b}"
+                    )
         if r["cut"] is not None:
             prev = r
     return "\n".join(lines)

@@ -23,7 +23,7 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== [1/7] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/7] run-report schema (producer selftest, v1-v3 fixtures + v4 producer) =="
+echo "== [2/7] run-report schema (producer selftest, v1-v4 fixtures + v5 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
 echo "== [3/7] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
@@ -42,10 +42,27 @@ assert r["progress"], "v2 report carries no progress series"
 # a fresh process always backend-compiles, so a zero count here means
 # the accounting silently stopped recording, not a warm cache
 assert r["compile"]["totals"]["compiles"] > 0, r["compile"]["totals"]
+# v5 perf observatory: a fresh process backend-compiles, so at least
+# one scope must carry cost (flops or bytes); barriers were crossed,
+# so memory samples exist; shape buckets were padded, so pad rows exist
+perf = r["perf"]
+assert perf["enabled"], perf
+assert any(
+    e.get("bytes", 0) > 0 or e.get("flops", 0) > 0
+    for e in perf["roofline"].values()
+), "no roofline scope carries cost"
+assert perf["memory"]["samples"], "no barrier memory samples"
+assert perf["pad_waste"], "no pad-waste rows"
 print(f"chaos smoke OK: {len(r['degraded'])} degraded event(s), "
       f"gate valid, cut={gate['cut_recomputed']}, "
-      f"{len(r['progress'])} progress series")
+      f"{len(r['progress'])} progress series, "
+      f"{len(perf['roofline'])} roofline scope(s), "
+      f"{len(perf['pad_waste'])} pad-waste row(s)")
 EOF
+# the triage CLI must render the same report and exit 0 (non-empty
+# roofline rows asserted by the flag)
+python -m kaminpar_tpu.telemetry.top /tmp/_kmp_chaos_report.json \
+    --require-roofline > /dev/null || exit 1
 
 echo "== [4/7] telemetry.diff self-test + BENCH trend =="
 # identical reports must pass (rc 0)...
@@ -158,9 +175,17 @@ assert s["cache"]["hit_rate"] >= 0.5, s["cache"]
 assert s["cache"]["result"]["entries"] <= s["cache"]["result"]["max_entries"]
 # the injected refiner fault degraded ONE request, not the process
 assert r["faults"]["injected"], r["faults"]
+# serving latency histograms: every executed request recorded a total,
+# and the percentile surface is populated (p50 <= p95 <= p99)
+lat = s["latency"]["phases"]["total"]
+assert lat["count"] >= 15, lat  # 16 requests minus the rejected-only ones
+assert lat["p50_ms"] is not None and lat["p95_ms"] is not None, lat
+assert lat["p50_ms"] <= lat["p95_ms"] <= lat["p99_ms"], lat
+assert s["latency"]["classes"], s["latency"]
 print(f"serving smoke OK: counts={c}, "
       f"cache_hit_rate={s['cache']['hit_rate']}, "
-      f"exec_buckets={s['cache']['executable']['buckets']}")
+      f"exec_buckets={s['cache']['executable']['buckets']}, "
+      f"p95_ms={lat['p95_ms']}")
 EOF3
 python - <<'EOF3' || exit 1
 # drain batch: 12 slow distinct requests, SIGTERM lands mid-batch

@@ -40,7 +40,9 @@ _TYPES = {
 }
 
 
-def _type_ok(value: Any, expected: str) -> bool:
+def _type_ok(value: Any, expected) -> bool:
+    if isinstance(expected, list):  # union, e.g. ["number", "null"]
+        return any(_type_ok(value, e) for e in expected)
     py = _TYPES.get(expected)
     if py is None:
         return True  # unknown type keyword: don't fail on it
@@ -86,8 +88,8 @@ def version_checks(report: Any) -> List[str]:
     validator subset cannot express (no if/then): v2+ reports must carry
     the `progress` and `compile` sections, v3+ additionally the
     `checkpoint` and `anytime` sections, v4+ additionally the `serving`
-    section; older reports remain valid without them during the
-    transition."""
+    section, v5+ additionally the `perf` section; older reports remain
+    valid without them during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -98,6 +100,7 @@ def version_checks(report: Any) -> List[str]:
         (2, ("progress", "compile")),
         (3, ("checkpoint", "anytime")),
         (4, ("serving",)),
+        (5, ("perf",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -155,12 +158,23 @@ def _minimal_v3_report() -> dict:
     return r
 
 
+def _minimal_v4_report() -> dict:
+    """A minimal schema_version-4 report (serving present, no perf
+    section) — the fourth transition fixture."""
+    r = _minimal_v3_report()
+    r["schema_version"] = 4
+    r["serving"] = {"enabled": False}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
     check_all.sh fast path).  Annotates non-default `checkpoint`,
     `anytime`, and `serving` sections so the v3/v4 producer surface is
-    exercised, not just its empty defaults."""
+    exercised, not just its empty defaults; the v5 `perf` section comes
+    from the live observatory (a pad-waste record and a memory sample
+    are injected so the producer emits non-empty subsections)."""
     # run as a script, sys.path[0] is scripts/ — add the repo root
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in sys.path:
@@ -206,6 +220,13 @@ def _selftest_report(path: str) -> None:
             "drained": False,
         },
     )
+    # exercise the v5 perf producer surface: one pad-waste record and
+    # one barrier-style memory sample (both host-side no-ops when the
+    # layer is off; here telemetry is on so they land in the report)
+    from kaminpar_tpu.telemetry import perf
+
+    perf.record_padding(n=100, n_pad=256, m=400, m_pad=512, k=4, k_pad=4)
+    perf.sample_memory("selftest")
     write_run_report(path)
 
 
@@ -223,7 +244,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v4) and validate it plus the embedded v1-v3 transition "
+        "v5) and validate it plus the embedded v1-v4 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -247,17 +268,17 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v4 (progress/compile +
-        # checkpoint/anytime + serving)
-        if report.get("schema_version") != 4:
+        # live producer must emit v5 (progress/compile +
+        # checkpoint/anytime + serving + perf)
+        if report.get("schema_version") != 5:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 4",
+                f"expected 5",
                 file=sys.stderr,
             )
             return 1
-        for key in ("checkpoint", "anytime", "serving"):
+        for key in ("checkpoint", "anytime", "serving", "perf"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -265,10 +286,22 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 1
-        # transition coverage: the v1-v3 layouts must STILL validate
+        # the injected pad-waste record must surface as a non-empty
+        # producer subsection (catches a silently dead observatory);
+        # KAMINPAR_TPU_PERF=0 legitimately disables the layer
+        if report["perf"].get("enabled") and not report["perf"].get(
+            "pad_waste"
+        ):
+            print(
+                "SCHEMA VIOLATION $: selftest perf section carries no "
+                "pad_waste rows despite an injected record",
+                file=sys.stderr,
+            )
+            return 1
+        # transition coverage: the v1-v4 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
-            ("v3", _minimal_v3_report()),
+            ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

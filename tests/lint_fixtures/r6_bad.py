@@ -1,0 +1,19 @@
+"""R6 bad fixture: eager device-memory/cost introspection outside the
+gated perf helpers."""
+import jax
+
+
+def watermark():
+    return sum(x.nbytes for x in jax.live_arrays())
+
+
+def profile(device):
+    return jax.profiler.device_memory_profile(device)
+
+
+def roofline(compiled):
+    return compiled.cost_analysis()
+
+
+def footprint(compiled):
+    return compiled.get_compiled_memory_stats()

@@ -1,0 +1,16 @@
+"""R6 good fixture: memory/cost introspection routed through the gated
+perf observatory and heap-profiler helpers."""
+from kaminpar_tpu.telemetry import perf
+from kaminpar_tpu.utils import heap_profiler
+
+
+def watermark():
+    return heap_profiler.live_device_bytes()
+
+
+def barrier_sample(stage):
+    return perf.sample_memory(stage)
+
+
+def roofline():
+    return perf.snapshot()["roofline"]

@@ -36,6 +36,7 @@ BAD_EXPECT = {
     "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
     "r5_bad.py": [("R5", 6), ("R5", 10)],
+    "r6_bad.py": [("R6", 7), ("R6", 11), ("R6", 15), ("R6", 19)],
 }
 
 
@@ -47,7 +48,7 @@ def test_rule_fires_on_bad_fixture(name):
 
 @pytest.mark.parametrize(
     "name", ["r1_good.py", "r2_good.py", "r3_good.py", "r4_good.py",
-             "r5_good.py"]
+             "r5_good.py", "r6_good.py"]
 )
 def test_rule_silent_on_good_fixture(name):
     assert _findings(name) == []

@@ -155,6 +155,7 @@ def test_executable_bucket_reuse_accounting():
     stats = tracker.stats()
     assert stats == {
         "buckets": 2, "hits": 2, "misses": 2, "hit_rate": 0.5,
+        "window": {"hits": 2, "misses": 2, "hit_rate": 0.5},
     }
 
 
@@ -641,6 +642,74 @@ def test_reset_records_bounds_long_lived_services():
     # cache state survives the reset: the same request replays
     (rec,) = svc.serve([PartitionRequest(_gen(), k=4, seed=1)])
     assert rec.cached
+
+
+def test_reset_records_windows_latency_and_cache_stats():
+    """The windowing satellite: after reset_records() a long-lived
+    service reports per-window hit rates and fresh latency histograms,
+    while lifetime counters keep accruing."""
+    svc = _svc()
+    svc.serve([PartitionRequest(_gen(), k=4, seed=1)])
+    s1 = svc.summary()
+    assert s1["latency"]["phases"]["total"]["count"] == 1
+    assert s1["cache"]["result"]["window"]["misses"] == 1
+    svc.reset_records()
+    s2 = svc.summary()
+    # latency histograms restarted with the window
+    assert s2["latency"]["phases"]["total"]["count"] == 0
+    assert s2["latency"]["classes"] == {}
+    # window counters restarted, lifetime kept
+    assert s2["cache"]["result"]["window"]["misses"] == 0
+    assert s2["cache"]["result"]["misses"] == 1
+    # the next window's cache hit lands in the fresh window stats
+    svc.serve([PartitionRequest(_gen(), k=4, seed=1)])
+    s3 = svc.summary()
+    assert s3["cache"]["result"]["window"]["hits"] == 1
+    assert s3["cache"]["result"]["window"]["hit_rate"] == 1.0
+    assert s3["latency"]["phases"]["total"]["count"] == 1
+
+
+def test_latency_phase_breakdown_and_class_rollup():
+    """Serving latency metrics: every executed request carries a
+    per-phase breakdown, the summary exposes p50/p95/p99 per phase, and
+    the per-class rollup joins latency with executable reuse."""
+    svc = _svc()
+    recs = svc.serve([
+        PartitionRequest(_gen(), k=4, seed=1, request_id="l1"),
+        PartitionRequest(_gen(), k=4, seed=1, request_id="l2"),  # cached
+    ])
+    for rec in recs:
+        assert rec.phases, rec
+        for key in ("admission_wait_ms", "resolve_ms", "compute_ms",
+                    "gate_ms", "total_ms"):
+            assert key in rec.phases, rec.phases
+        assert rec.phases["total_ms"] >= 0
+    # the cache hit spent no compute/gate time
+    assert recs[1].cached and recs[1].phases["compute_ms"] == 0.0
+
+    lat = svc.summary()["latency"]
+    total = lat["phases"]["total"]
+    assert total["count"] == 2
+    assert total["p50_ms"] <= total["p95_ms"] <= total["p99_ms"]
+    for phase in ("admission_wait", "resolve", "compute", "gate"):
+        assert lat["phases"][phase]["count"] == 2
+    # both requests share one shape class; the compiled-once bucket was
+    # sighted once (the cache hit never touched an executable)
+    assert len(lat["classes"]) == 1
+    (cls_stats,) = lat["classes"].values()
+    assert cls_stats["requests"] == 2
+    assert cls_stats["executable_sightings"] == 1
+    assert cls_stats["p95_ms"] is not None
+
+
+def test_failed_request_records_latency():
+    svc = _svc()
+    (rec,) = svc.serve(
+        [PartitionRequest("/nonexistent/path.metis", k=4)]
+    )
+    assert rec.verdict == "failed"
+    assert rec.phases["total_ms"] >= 0
+    assert svc.summary()["latency"]["phases"]["total"]["count"] == 1
 
 
 def test_concurrent_submit_respects_caps():

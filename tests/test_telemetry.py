@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 4
+    assert loaded["schema_version"] == 5
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -199,6 +199,17 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded["checkpoint"] == {"enabled": False}
     assert loaded["anytime"] == {"anytime": False}
     assert loaded["serving"] == {"enabled": False}
+    # schema v5 perf section: the observatory ran with telemetry (pad
+    # rows always accrue; roofline rows depend on cold compiles, so
+    # only the structure is pinned here — check_all's fresh-process
+    # stage asserts non-empty cost rows)
+    perf_sec = loaded["perf"]
+    assert perf_sec["enabled"] is True
+    for key in ("peaks", "totals", "roofline", "memory", "pad_waste"):
+        assert key in perf_sec, key
+    assert perf_sec["pad_waste"], "pad sites recorded nothing"
+    assert perf_sec["memory"]["samples"], "barriers sampled nothing"
+    assert perf_sec["peaks"]["gbps"] > 0
 
     # validates against the checked-in schema (drift backstop)
     checker = _load_checker()
@@ -590,11 +601,11 @@ def test_diff_aligns_progress_by_kind_path_level(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# schema v1/v2/v3/v4 transition (scripts/check_report_schema.py)
+# schema v1/v2/v3/v4/v5 transition (scripts/check_report_schema.py)
 # ---------------------------------------------------------------------------
 
 
-def test_schema_accepts_v1_through_v4(tmp_path):
+def test_schema_accepts_v1_through_v5(tmp_path):
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH
 
     checker = _load_checker()
@@ -624,13 +635,19 @@ def test_schema_accepts_v1_through_v4(tmp_path):
     # v4 additionally requires the serving section
     v4_missing = dict(v3, schema_version=4)
     assert any("serving" in e for e in checker.version_checks(v4_missing))
-    v4 = dict(v4_missing, serving={"enabled": False})
+    v4 = checker._minimal_v4_report()
     assert checker.validate_instance(v4, schema) == []
     assert checker.version_checks(v4) == []
-    # v5 is not a known version
-    v5 = dict(v1, schema_version=5)
+    # v5 additionally requires the perf section
+    v5_missing = dict(v4, schema_version=5)
+    assert any("perf" in e for e in checker.version_checks(v5_missing))
+    v5 = dict(v5_missing, perf={"enabled": False})
+    assert checker.validate_instance(v5, schema) == []
+    assert checker.version_checks(v5) == []
+    # v6 is not a known version
+    v6 = dict(v1, schema_version=6)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v5, schema))
+               for e in checker.validate_instance(v6, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
