@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import Any, Dict, Hashable, Optional, Tuple
 
 
@@ -42,12 +43,54 @@ def _ceil2(x: int) -> int:
     return 1 << (x - 1).bit_length()
 
 
+# --- pad policy modes (resilience/memory.py's rung-① lever) ---------------
+#
+# "bucketed" (the default, and the ONLY mode unless a memory-pressure
+# governor engages) is the executable-reuse policy below: next power of
+# two over a granularity floor.  "tight" trades that reuse for memory:
+# shapes round up only to the granularity multiple, so a padded buffer
+# carries at most granularity-1 wasted slots instead of up to ~2x.  The
+# mode is thread-local (each run's recovery ladder owns its own policy)
+# and scope-managed, so the default path is byte-identical to the
+# pre-governor behavior — the jaxpr-equality pins rely on that.
+
+_pad_mode = threading.local()
+
+PAD_POLICIES = ("bucketed", "tight")
+
+
+def pad_policy() -> str:
+    """The calling thread's active pad policy ("bucketed" by default)."""
+    return getattr(_pad_mode, "mode", "bucketed")
+
+
+@contextmanager
+def pad_policy_scope(mode: str):
+    """Run a block under a pad policy (restores the previous mode on
+    exit; used by the OOM recovery ladder around each rung attempt)."""
+    if mode not in PAD_POLICIES:
+        raise ValueError(f"unknown pad policy {mode!r}")
+    prev = pad_policy()
+    _pad_mode.mode = mode
+    try:
+        yield
+    finally:
+        _pad_mode.mode = prev
+
+
 def pad_size(x: int, granularity: int = 256) -> int:
     """Shape-bucketed padding: next power of two, but at least x rounded up to
     `granularity`.  Bounds the number of distinct compiled shapes per graph to
-    O(log n) as the multilevel hierarchy shrinks the graph ~2x per level."""
+    O(log n) as the multilevel hierarchy shrinks the graph ~2x per level.
+
+    Under the "tight" pad policy (pad_policy_scope; engaged only by the
+    memory-pressure recovery ladder) the power-of-two step is dropped:
+    shapes round up to the next `granularity` multiple only — no-headroom
+    buckets that trade executable reuse for device bytes."""
     if x <= granularity:
         return granularity
+    if pad_policy() == "tight":
+        return ((x + granularity - 1) // granularity) * granularity
     return _ceil2(x)
 
 
@@ -112,6 +155,13 @@ class BoundedCache:
         self.misses = 0
         self.evictions = 0
         self.oversize = 0
+        # eviction-cause split: `capacity` evictions keep the configured
+        # bounds (put overflow), `pressure` evictions were demanded by
+        # the memory governor (evict_to) — the serving report separates
+        # the two so a shrinking cache under HBM pressure is tellable
+        # from ordinary LRU turnover
+        self.evictions_capacity = 0
+        self.evictions_pressure = 0
         # per-window twins (begin_window): a long-lived serving process
         # reports fresh per-window rates instead of lifetime averages
         # that asymptotically freeze under sustained traffic
@@ -119,6 +169,8 @@ class BoundedCache:
         self.w_misses = 0
         self.w_evictions = 0
         self.w_oversize = 0
+        self.w_evictions_capacity = 0
+        self.w_evictions_pressure = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -162,6 +214,8 @@ class BoundedCache:
                 self._bytes -= dropped
                 self.evictions += 1
                 self.w_evictions += 1
+                self.evictions_capacity += 1
+                self.w_evictions_capacity += 1
             return True
 
     def evict(self, key: Hashable) -> bool:
@@ -173,7 +227,37 @@ class BoundedCache:
                 return False
             self._bytes -= ent[1]
             self.evictions += 1
+            self.evictions_capacity += 1
             return True
+
+    def evict_to(self, target_bytes: int, cause: str = "pressure") -> int:
+        """Shed least-recently-used entries until the cache holds at most
+        ``target_bytes`` (0 sheds every byte-carrying entry; zero-byte
+        entries hold no device memory and are left alone).  Returns the
+        bytes freed.  The
+        memory governor's pressure hook and the OOM recovery ladder call
+        this with cause="pressure" — those evictions are counted apart
+        from ordinary capacity turnover (`stats()['evictions_pressure']`)
+        so an operator can see what HBM pressure cost the cache."""
+        target_bytes = max(0, int(target_bytes))
+        freed = 0
+        with self._lock:
+            carrying = [k for k, (_, nb) in self._entries.items() if nb > 0]
+            for key in carrying:
+                if self._bytes <= target_bytes:
+                    break
+                _, dropped = self._entries.pop(key)
+                self._bytes -= dropped
+                freed += dropped
+                self.evictions += 1
+                self.w_evictions += 1
+                if cause == "pressure":
+                    self.evictions_pressure += 1
+                    self.w_evictions_pressure += 1
+                else:
+                    self.evictions_capacity += 1
+                    self.w_evictions_capacity += 1
+        return freed
 
     def clear(self) -> None:
         with self._lock:
@@ -189,6 +273,8 @@ class BoundedCache:
             self.w_misses = 0
             self.w_evictions = 0
             self.w_oversize = 0
+            self.w_evictions_capacity = 0
+            self.w_evictions_pressure = 0
 
     def stats(self) -> Dict[str, Any]:
         """Counter snapshot (the run report's cache subsections):
@@ -204,6 +290,8 @@ class BoundedCache:
                 "hits": int(self.hits),
                 "misses": int(self.misses),
                 "evictions": int(self.evictions),
+                "evictions_capacity": int(self.evictions_capacity),
+                "evictions_pressure": int(self.evictions_pressure),
                 "oversize": int(self.oversize),
                 "hit_rate": (
                     round(self.hits / lookups, 4) if lookups else 0.0
@@ -212,6 +300,8 @@ class BoundedCache:
                     "hits": int(self.w_hits),
                     "misses": int(self.w_misses),
                     "evictions": int(self.w_evictions),
+                    "evictions_capacity": int(self.w_evictions_capacity),
+                    "evictions_pressure": int(self.w_evictions_pressure),
                     "oversize": int(self.w_oversize),
                     "hit_rate": (
                         round(self.w_hits / w_lookups, 4)

@@ -185,6 +185,14 @@ def build_parser() -> argparse.ArgumentParser:
         "forcibly interrupted",
     )
     p.add_argument(
+        "--memory-budget", type=float, default=None, metavar="BYTES",
+        help="declared device-memory budget (bytes; also readable from "
+        "KAMINPAR_TPU_HBM_BYTES): the run either fits it or degrades "
+        "through the memory governor's recovery ladder (tight pads -> "
+        "host-spilled hierarchy -> semi-external streaming -> "
+        "host-only) — never RESOURCE_EXHAUSTED (docs/robustness.md)",
+    )
+    p.add_argument(
         "--serve-batch", default=None, metavar="BATCH.json",
         help="serve/batch mode (partitioning-as-a-service): run every "
         "request in the JSON batch spec through the admission-"
@@ -200,9 +208,11 @@ def build_parser() -> argparse.ArgumentParser:
         "overload is rejected, never queued unboundedly)",
     )
     p.add_argument(
-        "--serve-cost-cap", type=float, default=None, metavar="WORK",
-        help="serve mode: total estimated-cost (~ n + m) admission cap "
-        "across queued requests (default 5e7)",
+        "--serve-cost-cap", type=float, default=None, metavar="BYTES",
+        help="serve mode: total estimated-cost admission cap across "
+        "queued requests, in bytes of estimated device footprint (the "
+        "memory governor's sizing model, resilience/memory.py; "
+        "default 8 GiB)",
     )
     p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
@@ -288,6 +298,8 @@ def make_context(args: argparse.Namespace) -> Context:
         ctx.resilience.time_budget = args.time_budget
     if args.budget_grace is not None:
         ctx.resilience.budget_grace = args.budget_grace
+    if args.memory_budget is not None:
+        ctx.resilience.memory_budget = args.memory_budget
     if args.seed is not None:  # -C config may set the seed; flag wins
         ctx.seed = args.seed
     return ctx

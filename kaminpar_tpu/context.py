@@ -427,6 +427,16 @@ class ResilienceContext:
     resume: bool = False
     time_budget: float = 0.0
     budget_grace: float = 30.0
+    #: Declared device-memory budget in bytes (``--memory-budget``;
+    #: 0 = take KAMINPAR_TPU_HBM_BYTES, unset = no budget).  With a
+    #: budget in force the memory governor (resilience/memory.py)
+    #: enforces it: admission/preflight refuse what cannot fit, the
+    #: barrier pressure hook spills proactively, and a DeviceOOM
+    #: degrades through the recovery ladder instead of surfacing
+    #: RESOURCE_EXHAUSTED.  Excluded from the ctx fingerprint like the
+    #: rest of this subtree — a budget never forks checkpoints or
+    #: result-cache keys.
+    memory_budget: float = 0.0
 
 
 @dataclass

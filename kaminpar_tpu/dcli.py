@@ -103,6 +103,14 @@ def build_parser() -> argparse.ArgumentParser:
         "allowance on top of --time-budget (default 30)",
     )
     p.add_argument(
+        "--memory-budget", type=float, default=None, metavar="BYTES",
+        help="declared device-memory budget (bytes; or "
+        "KAMINPAR_TPU_HBM_BYTES): the dist driver pre-checks the "
+        "upload against it and refuses with a structured DeviceOOM "
+        "instead of an allocator death (the full recovery ladder is "
+        "shm-only — docs/robustness.md documents the limit)",
+    )
+    p.add_argument(
         "--serve-batch", default=None, metavar="BATCH.json",
         help="serve/batch mode is served by the shm CLI "
         "(python -m kaminpar_tpu --serve-batch); the dist driver "
@@ -205,6 +213,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         res_ctx.time_budget = args.time_budget
     if args.budget_grace is not None:
         res_ctx.budget_grace = args.budget_grace
+    if args.memory_budget is not None:
+        res_ctx.memory_budget = args.memory_budget
 
     t0 = time.perf_counter()
     try:

@@ -116,6 +116,12 @@ def device_graph_from_host(
     device=None,
 ) -> DeviceGraph:
     """Upload a HostGraph into the padded device layout."""
+    # `device-oom` chaos injection point: an allocator-shaped failure at
+    # upload propagates to the facade's recovery ladder
+    # (resilience/memory.py), which retries at the next rung
+    from ..resilience import maybe_inject
+
+    maybe_inject("device-oom")
     from ..caching import record_padding
 
     n, m = graph.n, graph.m

@@ -176,6 +176,7 @@ class KaMinPar:
         # stage as the outer run's.
         from .resilience import checkpoint as ckpt_mod
         from .resilience import deadline as deadline_mod
+        from .resilience import memory as mem_mod
 
         mgr = None
         res_ctx = ctx.resilience
@@ -195,6 +196,12 @@ class KaMinPar:
             mgr = ckpt_mod.create_manager(res_ctx, self._graph, ctx)
             if mgr is not None:
                 ckpt_mod.activate(mgr)
+            # memory governor: price this run against the declared
+            # budget and pick the starting ladder rung (after
+            # begin_run's fresh RunState — the governor state rides on
+            # it); dormant without a budget, but the ladder below still
+            # catches any DeviceOOM
+            mem_mod.begin_run(graph, ctx)
         if not owns_stream:
             # nested run (shm IP inside the dist driver): blind the
             # barrier hook for the duration — inner drivers must neither
@@ -243,7 +250,7 @@ class KaMinPar:
                     core_cg, core_ids, iso_ids = extract_core_compressed(
                         graph
                     )
-                    part_core = self._partition_core_resilient(core_cg, ctx)
+                    part_core = self._partition_core_governed(core_cg, ctx)
                     new_to_old = np.concatenate([core_ids, iso_ids])
                     old_to_new = np.empty(graph.n, dtype=np.int64)
                     old_to_new[new_to_old] = np.arange(graph.n)
@@ -259,14 +266,14 @@ class KaMinPar:
                 ):
                     core, perm, _ = remove_isolated_nodes(graph)
                     core_ctx = ctx  # weights already set up from the full graph
-                    part_core = self._partition_core(core, core_ctx)
+                    part_core = self._partition_core_governed(core, core_ctx)
                     partition = self._reintegrate_isolated(
                         graph, core, perm, num_isolated, part_core
                     )
                 elif num_isolated == graph.n and graph.n > 0:
                     partition = self._partition_only_isolated(graph)
                 else:
-                    partition = self._partition_core_resilient(graph, ctx)
+                    partition = self._partition_core_governed(graph, ctx)
         finally:
             set_output_level(prior_level)
             if not owns_stream:
@@ -317,6 +324,12 @@ class KaMinPar:
                 self.last_anytime = None
             if mgr is not None:
                 telemetry.annotate(checkpoint=mgr.summary())
+            # memory-budget audit trail: annotate only when a budget was
+            # declared or the ladder/pressure hook engaged — the report
+            # builder fills the well-formed disabled default otherwise
+            mem_summary = mem_mod.summary()
+            if mem_summary.get("enabled"):
+                telemetry.annotate(memory_budget=mem_summary)
             ckpt_mod.deactivate()
 
         debug.dump_toplevel_partition(ctx, partition)
@@ -347,6 +360,20 @@ class KaMinPar:
         if cached is None or cached[0] is not cgraph:
             self._decoded = (cgraph, cgraph.decode())
         return self._decoded[1]
+
+    def _partition_core_governed(self, graph, ctx: Context) -> np.ndarray:
+        """The core partition under the memory governor's OOM recovery
+        ladder (resilience/memory.py): a classified DeviceOOM anywhere
+        below retries at progressively more frugal rungs (tight pads,
+        host-spilled hierarchy, semi-external streaming, host-only)
+        instead of surfacing RESOURCE_EXHAUSTED.  A plain try-through
+        when the governor is dormant and nothing OOMs."""
+        from .resilience import memory as mem_mod
+
+        return mem_mod.run_ladder(
+            lambda: self._partition_core_resilient(graph, ctx),
+            graph, ctx, facade=self,
+        )
 
     def _partition_core_resilient(self, graph, ctx: Context) -> np.ndarray:
         """_partition_core under the compressed-stream degradation

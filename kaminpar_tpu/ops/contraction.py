@@ -202,6 +202,11 @@ def contract_clustering(
     module docstring).  The coarse graph lands in pad_size shape buckets so
     repeated contractions reuse compiled executables.
     """
+    # `device-oom` chaos injection point (contraction mints the largest
+    # fresh buffers of a level) — handled by the recovery ladder
+    from ..resilience import maybe_inject
+
+    maybe_inject("device-oom")
     from .lane_gather import maybe_edge_plans
 
     cmap, c_n, c_node_w, cu_g, cv_g, w_g, group_valid, c_m = _contract_part1(

@@ -201,6 +201,27 @@ class dKaMinPar:
             mgr = ckpt_mod.create_manager(res_ctx, graph, self.ctx)
             if mgr is not None:
                 ckpt_mod.activate(mgr)
+            # memory governor (resilience/memory.py): the dist driver
+            # has no recovery ladder — distributed rung semantics would
+            # need a cross-rank agreed rung — but the pre-upload budget
+            # check still refuses an upload the declared budget cannot
+            # hold with a structured DeviceOOM instead of letting the
+            # allocator die mid-shard (documented limit,
+            # docs/robustness.md)
+            from ..resilience import memory as memory_mod
+
+            memory_mod.begin_run(graph, self.ctx)
+            # the budget (KAMINPAR_TPU_HBM_BYTES / --memory-budget) is
+            # PER-DEVICE and dist_graph shards the node/edge arrays
+            # across the mesh, so price the per-rank shard, not the
+            # whole graph — otherwise any multi-chip run whose total
+            # footprint exceeds one device's budget is refused even
+            # though it fits after sharding
+            devices = max(1, int(self.mesh.devices.size))
+            memory_mod.preflight(
+                -(-graph.n // devices), -(-graph.m // devices), k,
+                where="dist",
+            )
 
         prior_level = output_level()
         try:
@@ -297,6 +318,9 @@ class dKaMinPar:
                     telemetry.annotate(anytime=deadline_mod.state())
                 if mgr is not None:
                     telemetry.annotate(checkpoint=mgr.summary())
+                mem_summary = memory_mod.summary()
+                if mem_summary.get("enabled"):
+                    telemetry.annotate(memory_budget=mem_summary)
                 ckpt_mod.deactivate()
             log(
                 f"RESULT cut={cut} imbalance={imbalance:.6f} "

@@ -24,11 +24,17 @@ from ..utils import timer
 
 @dataclass
 class CoarseningLevel:
-    fine_graph: DeviceGraph
-    coarse: CoarseGraph
+    """One hierarchy step.  ``fine_graph``/``coarse`` may be None while
+    the level is host-spilled (``spilled`` then holds the coarse host
+    CSR + cmap + pad bucket; resilience/memory.py rung 2) — the
+    coarsener restores them on demand during uncoarsening."""
+
+    fine_graph: Optional[DeviceGraph]
+    coarse: Optional[CoarseGraph]
     fine_n: int
     coarse_n: int
     coarse_m: int
+    spilled: Optional[dict] = None
 
 
 class Coarsener:
@@ -40,6 +46,15 @@ class Coarsener:
         self.levels: List[CoarseningLevel] = []
         self.current = graph
         self.current_n = n
+        # the input level (level 0's fine graph) — uncoarsening falls
+        # back to it when the hierarchy below has been host-spilled
+        self._input_graph = graph
+        # memory governor (resilience/memory.py): the active hierarchy
+        # registers as the run's spill target so the barrier pressure
+        # hook can shed cold levels; no-op while the governor is dormant
+        from ..resilience import memory as memory_mod
+
+        memory_mod.register_spiller(self)
         self.total_node_weight = int(ctx.partition.total_node_weight)
         lp_ctx = ctx.coarsening.clustering.lp
         from ..context import IsolatedNodesStrategy, TwoHopStrategy
@@ -240,12 +255,132 @@ class Coarsener:
     def uncoarsen(self, partition: jnp.ndarray) -> Tuple[DeviceGraph, jnp.ndarray]:
         """Pop one level; project the coarse partition up
         (abstract_cluster_coarsener.cc:149-171).  Returns (fine graph,
-        fine partition)."""
+        fine partition).
+
+        Host-spilled levels are transparently restored: the level below
+        (whose coarse graph IS this level's fine graph) is re-uploaded
+        into its original pad bucket, and a spilled projection map is
+        used straight from the host copy — the projection gather and the
+        restored arrays are bitwise-identical to the unspilled run
+        (deterministic buckets), so spill/reload is cut-neutral."""
+        if len(self.levels) >= 2:
+            # the popped level's fine graph lives in the level below
+            self._restore_level(len(self.levels) - 2)
         level = self.levels.pop()
-        fine_part = level.coarse.project_up(partition)
-        self.current = level.fine_graph
+        if level.coarse is not None:
+            cmap = level.coarse.cmap
+        else:
+            cmap = jnp.asarray(
+                np.asarray(level.spilled["cmap"], dtype=np.int32)
+            )
+            from ..resilience import memory as memory_mod
+
+            memory_mod.note_reload(int(cmap.nbytes))
+        fine = level.fine_graph
+        if fine is None:
+            fine = (
+                self.levels[-1].coarse.graph
+                if self.levels else self._input_graph
+            )
+        fine_part = partition[cmap]
+        self.current = fine
         self.current_n = level.fine_n
-        return level.fine_graph, fine_part
+        return fine, fine_part
+
+    # -- host spill / reload (resilience/memory.py rung 2) --------------
+
+    def _level_device_bytes(self, lvl: CoarseningLevel) -> int:
+        g = lvl.coarse.graph
+        return int(
+            g.row_ptr.nbytes + g.src.nbytes + g.dst.nbytes
+            + g.edge_w.nbytes + g.node_w.nbytes + lvl.coarse.cmap.nbytes
+        )
+
+    def spill_cold_levels(self, keep_last: int = 1) -> int:
+        """Serialize every hierarchy level except the newest
+        ``keep_last`` as host CSR + cmap and DROP their device arrays
+        (the working graph and the checkpoint payload's newest level
+        stay resident).  Returns the device bytes freed.  Called by the
+        barrier pressure hook (proactively, under budget pressure) and
+        unconditionally at rung >= 2."""
+        freed = 0
+        for i in range(len(self.levels) - max(0, keep_last)):
+            lvl = self.levels[i]
+            if lvl.coarse is None or lvl.spilled is not None:
+                continue
+            freed += self._spill_level(i)
+        return freed
+
+    def _spill_level(self, i: int) -> int:
+        from ..graphs.csr import host_graph_from_device
+        from ..resilience import memory as memory_mod
+
+        lvl = self.levels[i]
+        g = lvl.coarse.graph
+        nbytes = self._level_device_bytes(lvl)
+        hg = host_graph_from_device(g)
+        lvl.spilled = {
+            "xadj": hg.xadj,
+            "adjncy": hg.adjncy,
+            "node_w": hg.node_weight_array(),
+            "edge_w": hg.edge_weight_array(),
+            "cmap": np.asarray(lvl.coarse.cmap),
+            "n_pad": int(g.n_pad),
+            "m_pad": int(g.m_pad),
+        }
+        # drop the device arrays: this level's coarse graph is also the
+        # next level's fine graph (same object) — both refs must go or
+        # nothing is freed
+        lvl.coarse = None
+        if i + 1 < len(self.levels):
+            self.levels[i + 1].fine_graph = None
+        memory_mod.note_spill(nbytes)
+        from .. import telemetry
+
+        telemetry.event(
+            "memory-spill", level=i, bytes=nbytes,
+            n=lvl.coarse_n, m=lvl.coarse_m,
+        )
+        return nbytes
+
+    def _restore_level(self, i: int) -> None:
+        """Re-upload a spilled level into its ORIGINAL pad bucket (the
+        explicit n_pad/m_pad recorded at spill time, so cmaps and
+        partitions line up slot-for-slot whatever pad policy is active
+        now)."""
+        lvl = self.levels[i]
+        if lvl.coarse is not None:
+            return
+        from ..graphs.csr import device_graph_from_host
+        from ..graphs.host import HostGraph
+        from ..resilience import memory as memory_mod
+
+        sp = lvl.spilled
+        edge_w = sp["edge_w"]
+        hg = HostGraph(
+            xadj=sp["xadj"],
+            adjncy=sp["adjncy"],
+            node_weights=sp["node_w"],
+            edge_weights=edge_w if edge_w.size else None,
+        )
+        dg = device_graph_from_host(
+            hg, n_pad=sp["n_pad"], m_pad=sp["m_pad"]
+        )
+        lvl.coarse = CoarseGraph(
+            graph=dg,
+            cmap=jnp.asarray(np.asarray(sp["cmap"], dtype=np.int32)),
+        )
+        lvl.spilled = None
+        if i + 1 < len(self.levels):
+            self.levels[i + 1].fine_graph = dg
+        nbytes = self._level_device_bytes(lvl)
+        memory_mod.note_reload(nbytes)
+        from .. import telemetry
+
+        telemetry.event(
+            "memory-reload", level=i, bytes=nbytes,
+            n=lvl.coarse_n, m=lvl.coarse_m,
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -273,16 +408,25 @@ def newest_level_snapshot(coarsener: Coarsener) -> dict:
         "dims": np.asarray(
             [lvl.fine_n, lvl.coarse_n, lvl.coarse_m], dtype=np.int64
         ),
+        # the pad bucket the saved cmap was sized for: a resume must
+        # re-upload into exactly this bucket even when the recovery
+        # ladder has switched the ambient pad policy (rung >= 1)
+        "pads": np.asarray(
+            [lvl.coarse.graph.n_pad, lvl.coarse.graph.m_pad],
+            dtype=np.int64,
+        ),
     }
 
 
 def restore_levels(coarsener: Coarsener, dgraph: DeviceGraph, arrays: dict) -> int:
     """Rebuild the coarsener hierarchy from `level-<i>` snapshots:
     re-upload each saved coarse CSR and reattach the projection maps.
-    The pad policy is deterministic (graphs/csr.pad_size), so rebuilt
-    device graphs land in the same shape buckets as the originals and
-    saved cmaps/partitions line up slot-for-slot.  Returns the number of
-    levels restored."""
+    Snapshots record their pad bucket (`pads`), so the rebuilt device
+    graphs land in exactly the buckets the saved cmaps/partitions were
+    sized for even when the memory governor's ladder has switched the
+    ambient pad policy; pre-`pads` snapshots fall back to the
+    deterministic default policy (graphs/csr.pad_size) that wrote them.
+    Returns the number of levels restored."""
     from ..graphs.csr import device_graph_from_host
     from ..graphs.host import HostGraph
     from ..ops.contraction import CoarseGraph
@@ -301,7 +445,11 @@ def restore_levels(coarsener: Coarsener, dgraph: DeviceGraph, arrays: dict) -> i
             node_weights=a["node_w"],
             edge_weights=a["edge_w"] if a["edge_w"].size else None,
         )
-        dg = device_graph_from_host(hg)
+        if "pads" in a:
+            n_pad, m_pad = (int(x) for x in a["pads"])
+            dg = device_graph_from_host(hg, n_pad=n_pad, m_pad=m_pad)
+        else:
+            dg = device_graph_from_host(hg)
         coarse = CoarseGraph(
             graph=dg,
             cmap=jnp.asarray(np.asarray(a["cmap"], dtype=np.int32)),

@@ -76,7 +76,12 @@ class DeepMultilevelPartitioner:
 
         from . import debug
         from ..resilience import checkpoint as ckpt
+        from ..resilience import memory as memory_mod
 
+        # pre-upload budget check: refuse the allocation BEFORE bytes
+        # land on the device; the facade's recovery ladder catches the
+        # structured DeviceOOM and retries at the next rung
+        memory_mod.preflight(graph.n, graph.m, input_k, where="deep")
         with timer.scoped_timer("device-upload"):
             from ..graphs.compressed import CompressedHostGraph
 

@@ -47,7 +47,11 @@ class KWayMultilevelPartitioner:
         k = ctx.partition.k
         rng = rng_mod.host_rng(ctx.seed)
         from ..resilience import checkpoint as ckpt
+        from ..resilience import memory as memory_mod
 
+        # pre-upload budget check (see deep.py): a budget the bucket
+        # cannot fit is refused before the upload, not after the OOM
+        memory_mod.preflight(graph.n, graph.m, k, where="kway")
         with timer.scoped_timer("device-upload"):
             dgraph = device_graph_from_host(graph)
 

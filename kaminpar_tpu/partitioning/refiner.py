@@ -56,7 +56,13 @@ class RefinerPipeline:
     ) -> jax.Array:
         from ..telemetry import progress as progress_mod
         from ..ops.segments import pad_k_bucket
+        from ..resilience import maybe_inject
 
+        # `device-oom` chaos injection at refinement entry: OUTSIDE the
+        # per-step `refiner` rollback wrappers below, so the failure
+        # reaches the facade's memory-governor recovery ladder instead
+        # of a step rollback
+        maybe_inject("device-oom")
         k, max_block_weights, min_block_weights = pad_k_bucket(
             self.k, max_block_weights, min_block_weights
         )

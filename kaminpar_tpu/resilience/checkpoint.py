@@ -176,8 +176,9 @@ def barrier(
         # (host side, between launches; one bool check when disabled)
         from ..telemetry import perf as perf_mod
 
+        mem_sample = None
         if perf_mod.enabled():
-            perf_mod.sample_memory(stage_id, level=level)
+            mem_sample = perf_mod.sample_memory(stage_id, level=level)
         mgr = run.manager
         if mgr is not None and mgr.enabled:
             from .. import telemetry
@@ -197,6 +198,20 @@ def barrier(
                     stage, level=level, scheme=scheme,
                     new=new, keep=keep or [], meta=meta or {},
                 )
+        # memory-governor pressure hook (resilience/memory.py): AFTER
+        # the checkpoint offer (the newest level must be serialized
+        # before its siblings may be spilled), compare the live-bytes
+        # watermark against the declared budget and spill/shed
+        # proactively.  Two attribute reads while the governor is
+        # dormant.
+        from . import memory as memory_mod
+
+        memory_mod.on_barrier(
+            stage_id,
+            live_bytes=(
+                mem_sample.get("live_bytes") if mem_sample else None
+            ),
+        )
         stop_at = os.environ.get(STOP_AT_ENV, "")
         if stop_at:
             hard = stop_at.endswith("!")

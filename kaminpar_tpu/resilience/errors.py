@@ -132,7 +132,22 @@ class CacheDegraded(DegradationError):
 class DeviceOOM(DegradationError):
     """The accelerator (or host, for MemoryError) ran out of memory in an
     optional fast path.  Fallback: the path's smaller-footprint twin
-    (host balancer, uncompressed CSR, XLA gather)."""
+    (host balancer, uncompressed CSR, XLA gather) — and, anywhere under
+    ``compute_partition``, the memory governor's recovery ladder
+    (resilience/memory.py): the run retries at the next rung instead of
+    surfacing RESOURCE_EXHAUSTED.
+
+    ``rungs_exhausted`` is stamped True by the ladder only when every
+    rung (including the host-only path) failed — THAT is the
+    crash-shaped verdict the serving per-class breaker may latch on; a
+    ladder-retryable OOM never escapes the facade, so it can never latch
+    anything (the serving boundary additionally refuses to count a
+    ``rungs_exhausted=False`` OOM as a crash — the belt-and-braces for a
+    governor-disabled process)."""
+
+    #: True only when the recovery ladder ran out of rungs (set by
+    #: resilience/memory.py); a plain DeviceOOM is ladder-retryable.
+    rungs_exhausted = False
 
 
 #: Raw-exception markers that classify as DeviceOOM.  XLA surfaces

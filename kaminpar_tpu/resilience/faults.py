@@ -131,6 +131,14 @@ _register(SiteSpec(
     "forced miss/evict: the request recomputes (correctness untouched)",
     "serving-layer result-cache lookup (serving/service.py)",
 ))
+_register(SiteSpec(
+    "device-oom", DeviceOOM,
+    "memory-governor recovery ladder: retry at the next rung "
+    "(tight pads -> spilled hierarchy -> semi-external -> host-only)",
+    "allocator-shaped OOM at device upload / contraction / refinement "
+    "(resilience/memory.py ladder; ladder-retryable OOMs never latch "
+    "the serving per-class breaker — only rung exhaustion does)",
+))
 
 
 @dataclass

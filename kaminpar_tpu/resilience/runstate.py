@@ -48,6 +48,7 @@ class RunState:
     __slots__ = (
         "budget_s", "grace_s", "t0", "deadline", "stop", "reason",
         "stage", "stage_at_stop", "announced", "manager", "suspend",
+        "memory",
     )
 
     def __init__(self) -> None:
@@ -64,6 +65,10 @@ class RunState:
         # checkpoint half (resilience/checkpoint.py)
         self.manager = None  # Optional[CheckpointManager]
         self.suspend: int = 0
+        # memory-governor half (resilience/memory.py): armed by the
+        # facade's begin_run, None while dormant — the barrier pressure
+        # hook reads this slot and returns in two attribute lookups
+        self.memory = None  # Optional[GovernorState]
 
 
 _tls = threading.local()

@@ -53,9 +53,15 @@ from ..resilience.policy import BREAKER_THRESHOLD
 #: The verdict taxonomy, in severity order (docs/robustness.md).
 VERDICTS = ("served", "anytime", "degraded", "rejected", "failed")
 
-#: Estimated cost (work units ~ n + m) assumed for a request whose input
-#: cannot be sized without loading it (an opaque file path).
-DEFAULT_COST = 1_000_000.0
+#: Estimated cost (bytes of device footprint, resilience/memory.py's
+#: estimator) assumed for a request whose input cannot be sized without
+#: loading it (an opaque file path of unknown content).
+DEFAULT_COST = float(256 << 20)
+
+#: Device-footprint bytes assumed per byte of an on-disk graph file
+#: (admission never loads the file): text formats run ~8 bytes per edge
+#: token against ~12-24 padded device bytes per edge plus working set.
+FILE_COST_FACTOR = 4.0
 
 
 @dataclass
@@ -140,10 +146,16 @@ class ServiceConfig:
     """Admission + cache policy knobs (docs/robustness.md)."""
 
     max_queue_depth: int = 64
-    #: total estimated work units (~ n + m) admitted but not yet run
-    max_queued_cost: float = 5e7
-    #: a single request larger than this is rejected outright
-    max_request_cost: float = 2.5e7
+    #: total estimated cost admitted but not yet run.  Since the
+    #: memory-governor PR the unit is BYTES of estimated device
+    #: footprint (resilience/memory.estimate_run_bytes) — one sizing
+    #: model shared by `request-too-large`/`cost-cap` and the
+    #: `insufficient-memory` rule — where it used to be work units
+    #: (~ n + m); the flag semantics are unchanged, only the unit
+    max_queued_cost: float = float(8 << 30)
+    #: a single request estimated larger than this (bytes) is rejected
+    #: outright
+    max_request_cost: float = float(4 << 30)
     result_cache_entries: int = 128
     result_cache_bytes: int = 256 << 20
     #: default per-request budget when the request carries none (0: none)
@@ -185,6 +197,11 @@ class PartitionService:
             max_entries=self.config.result_cache_entries,
             max_bytes=self.config.result_cache_bytes,
         )
+        # the memory governor sheds this cache first under HBM pressure
+        # (resilience/memory.shed_caches; weakly held — dies with us)
+        from ..resilience import memory as _memory_mod
+
+        _memory_mod.register_shed_target(self._result_cache)
         self._buckets = caching.BucketTracker()
         # per-request-class (executable bucket) crash counters
         self._class_failures: Dict[str, int] = {}
@@ -207,11 +224,18 @@ class PartitionService:
     # -- admission -----------------------------------------------------
 
     def _estimate(self, req: PartitionRequest):
-        """(cost, n, m) for admission; n/m are -1 when unknown without
-        loading the input (opaque file path)."""
+        """(cost, n, m) for admission — cost is the ESTIMATED DEVICE
+        BYTES of the request (resilience/memory.estimate_run_bytes for
+        the padded bucket), the same sizing model the memory budget is
+        enforced in; n/m are -1 when unknown without loading the input
+        (opaque file path — sized from the file length, never a load)."""
+        from ..resilience.memory import estimate_run_bytes
+
+        k = int(req.k or 2)
         g = req.graph
         if hasattr(g, "n") and hasattr(g, "m"):
-            return float(g.n + g.m), int(g.n), int(g.m)
+            n, m = int(g.n), int(g.m)
+            return float(estimate_run_bytes(n, m, k)), n, m
         if isinstance(g, str) and g.startswith("gen:"):
             try:
                 from ..graphs.factories import parse_gen_spec
@@ -222,14 +246,17 @@ class PartitionService:
                     * int(kw.get("z", 1))
                 ))
                 m = int(kw.get("m") or n * float(kw.get("avg_degree", 8)))
-                return float(n + m), n, m
+                return float(estimate_run_bytes(n, m, k)), n, m
             except Exception:
                 return DEFAULT_COST, -1, -1
         if isinstance(g, str):
             try:
                 import os
 
-                return max(float(os.path.getsize(g)) / 8.0, 1.0), -1, -1
+                return (
+                    max(float(os.path.getsize(g)) * FILE_COST_FACTOR, 1.0),
+                    -1, -1,
+                )
             except OSError:
                 return DEFAULT_COST, -1, -1
         return DEFAULT_COST, -1, -1
@@ -239,8 +266,8 @@ class PartitionService:
             return "unsized"
         return "/".join(str(x) for x in caching.bucket_key(n, m, k))
 
-    def _admission_reason(self, req: PartitionRequest,
-                          cost: float, cls: str) -> str:
+    def _admission_reason(self, req: PartitionRequest, cost: float,
+                          cls: str, n: int = -1, m: int = -1) -> str:
         """First violated admission rule, or "" to admit.  The injected
         `serving-admit` fault routes through the policy wrapper so the
         chaos suite sees the standard `degraded` event."""
@@ -264,6 +291,26 @@ class PartitionService:
             return "request-too-large"
         if sum(self._queued_cost.values()) + cost > self.config.max_queued_cost:
             return "cost-cap"
+        # memory-budget admission (resilience/memory.py): a request
+        # whose MINIMUM device-resident footprint (the rung-2
+        # spilled-hierarchy estimate) exceeds the declared budget could
+        # only ever be served at the streamed/host rungs — orders slower
+        # than the service's latency contract — so it is rejected with a
+        # structured verdict instead.  Sized without loading the graph;
+        # unsized (file-backed) inputs skip the rule, consistent with
+        # the 'unsized' breaker-class convention.  Single-shot CLI runs
+        # still degrade through every rung.
+        if n >= 0:
+            from ..resilience import memory as memory_mod
+
+            budget = memory_mod.budget_bytes(self.base_ctx)
+            if (
+                budget
+                and memory_mod.governor_enabled()
+                and memory_mod.min_serveable_bytes(n, m, int(req.k or 2))
+                > budget
+            ):
+                return "insufficient-memory"
         if self._class_failures.get(cls, 0) >= self.config.breaker_threshold:
             return "breaker-open"
         return ""
@@ -275,7 +322,7 @@ class PartitionService:
         cost, n, m = self._estimate(req)
         cls = self._class_key(n, m, int(req.k or 0))
         with self._lock:
-            reason = self._admission_reason(req, cost, cls)
+            reason = self._admission_reason(req, cost, cls, n, m)
             if reason:
                 rec = RequestRecord(
                     request_id=req.request_id, verdict="rejected",
@@ -499,6 +546,17 @@ class PartitionService:
                 err.breaker_relevant if err is not None
                 else not _input_shaped(exc)
             )
+            if (
+                isinstance(err, res_errors.DeviceOOM)
+                and not err.rungs_exhausted
+            ):
+                # a ladder-retryable OOM can only reach this boundary in
+                # a governor-disabled process (KAMINPAR_TPU_MEM_GOVERNOR
+                # =0) — it indicts the budget, not the request class, so
+                # it must never latch the per-class breaker; only rung
+                # EXHAUSTION (every rung incl. host-only failed) is
+                # crash-shaped
+                crash = False
             if crash:
                 for c in {cls, cls_submit} - {""}:
                     self._class_failures[c] = (

@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 5
+SCHEMA_VERSION = 6
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -145,6 +145,10 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # schema v5: the dist driver's per-rank memory rollup (collective,
     # gathered before the report) folds into the perf section below
     perf_ranks = info.pop("perf_ranks", None)
+    # schema v6: the memory governor's audit trail (resilience/memory.py
+    # — budget, estimate, ladder rung, spill/reload accounting); runs
+    # with no declared budget and no OOM carry the disabled default
+    memory_budget = info.pop("memory_budget", {"enabled": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -239,6 +243,10 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # memory watermarks + per-level buffer bytes, and pad-waste
         # attribution per (scope, bucket)
         "perf": _perf_section(levels, perf_ranks),
+        # schema v6: the memory-pressure governor — declared budget vs
+        # estimate vs watermark, the recovery-ladder rung the run ended
+        # at, and spill/reload byte accounting (docs/robustness.md)
+        "memory_budget": memory_budget,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

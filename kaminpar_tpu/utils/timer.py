@@ -111,9 +111,17 @@ class Timer:
         the stack stays open, ``idle()`` lies, and the emergency run
         report renders a scope tree with un-accounted open nodes.
         Returns the number of scopes closed."""
+        return self.unwind_to(1)
+
+    def unwind_to(self, depth: int) -> int:
+        """Force-close open scopes until the stack is back at ``depth``
+        entries (the memory governor's per-rung unwind: a failed attempt
+        must not leave ITS scopes open under the facade's, but the
+        facade's own outer scopes stay).  ``unwind()`` is
+        ``unwind_to(1)``."""
         closed = 0
         end = time.perf_counter()
-        while len(self._stack) > 1:
+        while len(self._stack) > max(1, depth):
             node = self._stack[-1]
             start = self._open_starts.pop() if self._open_starts else end
             node.elapsed += end - start

@@ -8,8 +8,10 @@
 # sections), a serving smoke (16-request batch with one poisoned graph,
 # fault injection, a tight per-request deadline, repeated shapes for
 # cache hits, and a SIGTERM mid-batch drain — all verdicts in one
-# schema-valid report), and the ROADMAP.md tier-1 pytest command.
-# Exits nonzero on the first failing stage.
+# schema-valid report), a memory-governor smoke (artificially small
+# budget -> ladder engages, forced rung-2 spill/reload, a serving
+# insufficient-memory rejection), and the ROADMAP.md tier-1 pytest
+# command.  Exits nonzero on the first failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
 #         --fast skips the tier-1 pytest stage (lint + schema + chaos
@@ -20,13 +22,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/7] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/8] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/7] run-report schema (producer selftest, v1-v4 fixtures + v5 producer) =="
+echo "== [2/8] run-report schema (producer selftest, v1-v5 fixtures + v6 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/7] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/8] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -64,7 +66,7 @@ EOF
 python -m kaminpar_tpu.telemetry.top /tmp/_kmp_chaos_report.json \
     --require-roofline > /dev/null || exit 1
 
-echo "== [4/7] telemetry.diff self-test + BENCH trend =="
+echo "== [4/8] telemetry.diff self-test + BENCH trend =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -85,7 +87,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/7] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/8] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -125,7 +127,7 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
-echo "== [6/7] serving smoke (mixed batch + faults + SIGTERM drain) =="
+echo "== [6/8] serving smoke (mixed batch + faults + SIGTERM drain) =="
 SERVE_DIR=/tmp/_kmp_serve_smoke
 rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
 python - <<'EOF3' || exit 1
@@ -221,12 +223,84 @@ assert drained, c
 print(f"drain OK: counts={c} ({len(drained)} drained)")
 EOF3
 
+
+echo "== [7/8] memory-governor smoke (tiny budget + forced spill + serving) =="
+MEM_DIR=/tmp/_kmp_mem_smoke
+rm -rf "$MEM_DIR"; mkdir -p "$MEM_DIR"
+# an artificially small budget: 25% of the rung-0 estimate for the shape
+BUDGET=$(python - <<'PYEOF'
+from kaminpar_tpu.resilience.memory import estimate_run_bytes
+print(int(estimate_run_bytes(65536, 65536 * 8, 8) * 0.25))
+PYEOF
+) || exit 1
+KAMINPAR_TPU_HBM_BYTES=$BUDGET python -m kaminpar_tpu \
+    "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
+    --report-json "$MEM_DIR/budget.json" -q || exit 1
+python scripts/check_report_schema.py "$MEM_DIR/budget.json" || exit 1
+python - <<'PYEOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_mem_smoke/budget.json"))
+mb = r["memory_budget"]
+# the never-RESOURCE_EXHAUSTED contract: exit 0 (above), gate-valid,
+# ladder engaged (rung >= 1), nothing exhausted
+assert mb["enabled"] and mb["rung"] >= 1 and not mb["exhausted"], mb
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], gate
+print(f"tiny-budget OK: rung={mb['rung']} ({mb.get('rung_name')}), "
+      f"budget={mb.get('budget_bytes')} estimate={mb.get('estimate_bytes')}")
+PYEOF
+# forced rung 2: host-spilled hierarchy — spill AND reload events must
+# be present and the run still gate-valid
+KAMINPAR_TPU_MEM_RUNG=2 KAMINPAR_TPU_HBM_BYTES=$((BUDGET * 100)) \
+    python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
+    --contraction-limit 500 --report-json "$MEM_DIR/spill.json" -q || exit 1
+python - <<'PYEOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_mem_smoke/spill.json"))
+mb = r["memory_budget"]
+spills = [e for e in r["events"] if e["name"] == "memory-spill"]
+reloads = [e for e in r["events"] if e["name"] == "memory-reload"]
+assert mb["rung"] == 2 and spills and reloads, (mb, len(spills))
+assert mb["spills"]["count"] >= 1 and mb["spills"]["reloads"] >= 1, mb
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], gate
+print(f"spill smoke OK: {len(spills)} spill(s), {len(reloads)} reload(s), "
+      f"{mb['spills']['bytes']} bytes spilled")
+PYEOF
+# serving batch: one oversized request must be rejected with the
+# structured insufficient-memory verdict (sized from the gen spec,
+# never loaded); the fitting request is served normally
+python - <<'PYEOF' || exit 1
+import json
+reqs = [
+    {"graph": "gen:rgg2d;n=4096;avg_degree=8;seed=1", "k": 4,
+     "seed": 1, "id": "fits"},
+    {"graph": "gen:rgg2d;n=4194304;avg_degree=16;seed=2", "k": 64,
+     "id": "oversized"},
+]
+json.dump({"requests": reqs},
+          open("/tmp/_kmp_mem_smoke/batch.json", "w"))
+PYEOF
+KAMINPAR_TPU_HBM_BYTES=268435456 python -m kaminpar_tpu \
+    --serve-batch "$MEM_DIR/batch.json" \
+    --report-json "$MEM_DIR/serve.json" -q || exit 1
+python scripts/check_report_schema.py "$MEM_DIR/serve.json" || exit 1
+python - <<'PYEOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_mem_smoke/serve.json"))
+by_id = {q["request_id"]: q for q in r["serving"]["requests"]}
+assert by_id["fits"]["verdict"] == "served", by_id["fits"]
+assert by_id["oversized"]["verdict"] == "rejected", by_id["oversized"]
+assert by_id["oversized"]["reason"] == "insufficient-memory", by_id
+print("serving insufficient-memory OK")
+PYEOF
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [7/7] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [8/8] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [7/7] tier-1 pytest (ROADMAP.md) =="
+echo "== [8/8] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

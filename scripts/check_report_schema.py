@@ -88,8 +88,9 @@ def version_checks(report: Any) -> List[str]:
     validator subset cannot express (no if/then): v2+ reports must carry
     the `progress` and `compile` sections, v3+ additionally the
     `checkpoint` and `anytime` sections, v4+ additionally the `serving`
-    section, v5+ additionally the `perf` section; older reports remain
-    valid without them during the transition."""
+    section, v5+ additionally the `perf` section, v6+ additionally the
+    `memory_budget` section; older reports remain valid without them
+    during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -101,6 +102,7 @@ def version_checks(report: Any) -> List[str]:
         (3, ("checkpoint", "anytime")),
         (4, ("serving",)),
         (5, ("perf",)),
+        (6, ("memory_budget",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -167,6 +169,15 @@ def _minimal_v4_report() -> dict:
     return r
 
 
+def _minimal_v5_report() -> dict:
+    """A minimal schema_version-5 report (perf present, no
+    memory_budget section) — the fifth transition fixture."""
+    r = _minimal_v4_report()
+    r["schema_version"] = 5
+    r["perf"] = {"enabled": False}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
@@ -194,6 +205,15 @@ def _selftest_report(path: str) -> None:
         anytime={
             "anytime": True, "reason": "budget", "stage": "uncoarsen:1",
             "budget_s": 1.0, "grace_s": 30.0, "elapsed_s": 1.2,
+        },
+        memory_budget={
+            "enabled": True, "budget_bytes": 1 << 30,
+            "estimate_bytes": 900 << 20, "bucket": "8192/65536/4",
+            "rung": 2, "rung_name": "spill-hierarchy", "initial_rung": 0,
+            "exhausted": False, "watermark_bytes": 800 << 20,
+            "pressure_events": 1, "shed_cache_bytes": 4096,
+            "spills": {"count": 2, "bytes": 1 << 20, "reloads": 2,
+                       "reload_bytes": 1 << 20},
         },
         serving={
             "enabled": True,
@@ -244,7 +264,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v5) and validate it plus the embedded v1-v4 transition "
+        "v6) and validate it plus the embedded v1-v5 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -268,17 +288,18 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v5 (progress/compile +
-        # checkpoint/anytime + serving + perf)
-        if report.get("schema_version") != 5:
+        # live producer must emit v6 (progress/compile +
+        # checkpoint/anytime + serving + perf + memory_budget)
+        if report.get("schema_version") != 6:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 5",
+                f"expected 6",
                 file=sys.stderr,
             )
             return 1
-        for key in ("checkpoint", "anytime", "serving", "perf"):
+        for key in ("checkpoint", "anytime", "serving", "perf",
+                    "memory_budget"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -298,10 +319,11 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v4 layouts must STILL validate
+        # transition coverage: the v1-v5 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
+            ("v5", _minimal_v5_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

@@ -422,6 +422,11 @@ CHAOS_CASES = [
     ("refiner:nth=1", {}),
     ("device-balancer:nth=1", {}),
     ("compressed-stream:nth=1", {"compression": True}),
+    # allocator-shaped OOM at the device upload: absorbed by the memory
+    # governor's recovery ladder (retry at rung 1, tight pads) — the
+    # run must still end gate-valid with the degraded event naming the
+    # ladder as its fallback
+    ("device-oom:nth=1", {}),
 ]
 
 

@@ -64,7 +64,15 @@ def test_admission_queue_depth_cap():
 
 
 def test_admission_cost_caps():
-    svc = _svc(max_queued_cost=10_000, max_request_cost=8_000)
+    # caps are BYTES of estimated device footprint since the memory
+    # governor unified the sizing model (resilience/memory.py): derive
+    # the thresholds from the estimator so the test tracks calibration
+    from kaminpar_tpu.resilience.memory import estimate_run_bytes
+
+    small = estimate_run_bytes(600, 600 * 8, 4)
+    svc = _svc(
+        max_queued_cost=int(small * 1.5), max_request_cost=int(small * 2)
+    )
     # a single oversized request is refused outright
     rec = svc.submit(PartitionRequest(_gen(n=4096), k=4))
     assert rec is not None and rec.reason == "request-too-large"
@@ -75,6 +83,34 @@ def test_admission_cost_caps():
     # every admission decision is a record in the batch, nothing queued
     # was lost
     assert [r.verdict for r in svc.records] == ["rejected", "rejected"]
+
+
+def test_admission_insufficient_memory():
+    """A declared memory budget rejects requests whose MINIMUM
+    device-resident footprint (rung-2 spilled estimate) cannot fit —
+    sized from the gen spec, never a load; unsized file-backed inputs
+    skip the rule (the 'unsized' convention)."""
+    from kaminpar_tpu.presets import create_context_by_preset_name
+    from kaminpar_tpu.resilience.memory import min_serveable_bytes
+
+    ctx = create_context_by_preset_name("default")
+    ctx.resilience.memory_budget = float(
+        min_serveable_bytes(600, 4800, 4) + 1
+    )
+    svc = PartitionService(ctx, ServiceConfig())
+    # fits the budget: admitted
+    assert svc.submit(PartitionRequest(_gen(n=600), k=4)) is None
+    # far too big for the declared budget even spilled: rejected with
+    # the structured verdict, not queued toward an allocator death
+    rec = svc.submit(PartitionRequest(_gen(n=200_000), k=4))
+    assert rec is not None and rec.verdict == "rejected"
+    assert rec.reason == "insufficient-memory"
+    assert rec.n > 0  # sized without loading
+    # an unsized file path cannot be sized: the rule does not fire
+    rec2 = svc.submit(
+        PartitionRequest("/nonexistent/never-loaded.metis", k=4)
+    )
+    assert rec2 is None
 
 
 def test_admission_invalid_parameters():
@@ -200,11 +236,15 @@ def test_malformed_graph_fails_in_isolation(tmp_path):
 
 def test_crash_failures_open_per_class_breaker(monkeypatch):
     """Three crash-shaped failures in one request class reject the
-    fourth at admission — without poisoning other classes."""
+    fourth at admission — without poisoning other classes.  Since the
+    memory governor, a DeviceOOM is crash-shaped only once the recovery
+    ladder is EXHAUSTED (every rung including host-only failed)."""
     from kaminpar_tpu import kaminpar as kp
 
     def boom(self, **kwargs):
-        raise resilience.DeviceOOM("synthetic device OOM")
+        err = resilience.DeviceOOM("synthetic device OOM")
+        err.rungs_exhausted = True  # ladder ran out of rungs
+        raise err
 
     monkeypatch.setattr(kp.KaMinPar, "compute_partition", boom)
     svc = _svc()
@@ -218,6 +258,28 @@ def test_crash_failures_open_per_class_breaker(monkeypatch):
     assert rej is not None and rej.reason == "breaker-open"
     # a different class (different k bucket) is still admitted
     assert svc.submit(PartitionRequest(_gen(), k=16, seed=4)) is None
+
+
+def test_ladder_retryable_oom_never_latches_breaker(monkeypatch):
+    """A DeviceOOM that the recovery ladder could still retry (no
+    `rungs_exhausted` stamp — only reachable at this boundary in a
+    governor-disabled process) indicts the BUDGET, not the request
+    class: the per-class breaker must not advance."""
+    from kaminpar_tpu import kaminpar as kp
+
+    def boom(self, **kwargs):
+        raise resilience.DeviceOOM("retryable device OOM")
+
+    monkeypatch.setattr(kp.KaMinPar, "compute_partition", boom)
+    svc = _svc()
+    recs = svc.serve(
+        [PartitionRequest(_gen(), k=4, seed=s) for s in (1, 2, 3)]
+    )
+    assert [r.verdict for r in recs] == ["failed"] * 3
+    assert all(r.error == "DeviceOOM" for r in recs)
+    # the class breaker stayed closed: the next same-class request runs
+    assert svc._class_failures == {}
+    assert svc.submit(PartitionRequest(_gen(), k=4, seed=4)) is None
 
 
 def test_deadline_request_winds_down_anytime_and_next_is_clean():
@@ -583,7 +645,9 @@ def test_file_backed_crashes_latch_the_admission_visible_class(
     path.write_text("3 3\n2 3\n1 3\n1 2\n")
 
     def boom(self, **kwargs):
-        raise resilience.DeviceOOM("synthetic device OOM")
+        err = resilience.DeviceOOM("synthetic device OOM")
+        err.rungs_exhausted = True  # crash-shaped: the ladder ran dry
+        raise err
 
     monkeypatch.setattr(kp.KaMinPar, "compute_partition", boom)
     svc = _svc()
