@@ -60,16 +60,22 @@ def _init_platform() -> None:
         clear_backends()
 
 
-def _measure_large_coarsening() -> float | None:
+def _measure_large_coarsening(
+    reps: int = 2, budget_s: float = 0.0
+) -> float | None:
     """LP+coarsening wall-clock on the LARGE (10M-edge) bench graph —
     the scale where the repo's CPU-vs-TPU comparison is meaningful (the
     medium graph is launch-floor-dominated; see docs/performance.md).
     Same graph and phase boundary as BASELINE_CPU.json's
     large10m_coarsening_s (scripts/measure_cpu_baseline.py --large).
-    Returns seconds (best of two runs — the first pays executable-cache
-    loads even when compiled; the CPU denominator is likewise the
-    binary's fastest run), or None on failure (the bench line then
-    simply omits the large-graph ratio)."""
+    Returns seconds (best of `reps` runs — the first pays
+    executable-cache loads even when compiled; the CPU denominator is
+    likewise the binary's fastest run), or None on failure (the bench
+    line then reports the large-graph keys as null).
+
+    `budget_s` > 0 bounds the measurement wall (the CPU fallback): a
+    run that blows the budget mid-hierarchy reports None — a null
+    metric, never a silently-partial number."""
     import time
 
     import jax.numpy as jnp
@@ -84,13 +90,22 @@ def _measure_large_coarsening() -> float | None:
     ctx.partition.setup(host, k=BENCH_K, epsilon=BENCH_EPS)
     ctx.seed = 1
     best = None
-    for _ in range(2):
+    for _ in range(max(reps, 1)):
         dgraph = device_graph_from_host(host)
         int(jnp.sum(dgraph.src[:1]))  # force the upload before timing
         coarsener = Coarsener(ctx, dgraph, host.n)
         threshold = max(2 * ctx.coarsening.contraction_limit, 2)
         t0 = time.perf_counter()
         while coarsener.current_n > threshold:
+            if budget_s > 0 and time.perf_counter() - t0 > budget_s:
+                import sys
+
+                print(
+                    f"bench: 10M coarsening blew its {budget_s:.0f}s "
+                    "budget; reporting null",
+                    file=sys.stderr,
+                )
+                return best
             if not coarsener.coarsen():
                 break
         int(jnp.sum(coarsener.current.src[:1]))  # readback-synced stop
@@ -99,12 +114,17 @@ def _measure_large_coarsening() -> float | None:
     return best
 
 
-def _measure_large_total():
+def _measure_large_total(reps: int = 2, time_budget: float = 0.0):
     """Full end-to-end partition of the 10M-edge bench graph (default
     preset, warm cache): total wall + cut.  Catches SCALE regressions the
     medium line cannot (VERDICT r3 weak #4); compares against the
     reference binary's cut on the same graph
-    (BASELINE_CPU.json large10m_edge_cut)."""
+    (BASELINE_CPU.json large10m_edge_cut).
+
+    `time_budget` > 0 arms the PR-5 anytime deadline so the CPU
+    fallback stays wall-bounded: the run winds down at a pipeline
+    barrier and still returns a gate-valid partition (cut/feasible stay
+    honest numbers; the wall reads as the budget ceiling)."""
     import time
 
     import numpy as np
@@ -115,12 +135,16 @@ def _measure_large_total():
     from kaminpar_tpu.utils.logger import OutputLevel
 
     host = make_rmat(1 << 20, 10_000_000, seed=7)
-    # best of two: the first run pays per-process executable-cache loads
-    # even when fully compiled (solo warm steady state is the honest
-    # figure; the CPU denominator is likewise the binary's fastest run)
+    # best of `reps`: the first run pays per-process executable-cache
+    # loads even when fully compiled (solo warm steady state is the
+    # honest figure; the CPU denominator is likewise the binary's
+    # fastest run)
     total = None
-    for _ in range(2):
+    part = None
+    for _ in range(max(reps, 1)):
         p = KaMinPar("default")
+        if time_budget > 0:
+            p.ctx.resilience.time_budget = float(time_budget)
         p.set_output_level(OutputLevel.QUIET)
         t0 = time.perf_counter()
         part = p.set_graph(host).compute_partition(
@@ -233,6 +257,8 @@ def _bench_line() -> dict:
     best = None
     coarsening_times = []
     total_times = []
+    lp_times = []
+    contraction_times = []
     for seed in (1, 2):
         p = KaMinPar("default")
         p.set_output_level(OutputLevel.QUIET)
@@ -244,9 +270,21 @@ def _bench_line() -> dict:
         # LP clustering + contraction wall-clock of this run, from the
         # hierarchical timer (compute_partition resets it; the coarsener
         # forces a scalar readback inside each lp scope, so attribution
-        # is honest on the async remote backend)
+        # is honest on the async remote backend).  The per-kernel split
+        # (lp-clustering vs contraction) feeds the bench_trend kernel
+        # columns — "which kernel regressed" is a read, not a dig.
         coarsening_times.append(
             timer.GLOBAL_TIMER.elapsed("partitioning", "coarsening")
+        )
+        lp_times.append(
+            timer.GLOBAL_TIMER.elapsed(
+                "partitioning", "coarsening", "lp-clustering"
+            )
+        )
+        contraction_times.append(
+            timer.GLOBAL_TIMER.elapsed(
+                "partitioning", "coarsening", "contraction"
+            )
         )
         cand_res = host_partition_metrics(host, cand, BENCH_K)
         cand_feasible = bool(cand_res["block_weights"].max() <= cap)
@@ -292,7 +330,14 @@ def _bench_line() -> dict:
             vs_cpu = round(cpu_coarsening / coarsening_s, 3)
 
     # large-graph speed ratio at >=10M edges — the scale that decides
-    # the CPU-vs-TPU story (skippable for quick local runs)
+    # the CPU-vs-TPU story.  BENCH_r05 silently dropped every 10M metric
+    # because this section was gated on the accelerator being up; it now
+    # runs on EVERY platform (the keys must never vanish from the
+    # trajectory again) with CPU-sized effort: one rep instead of two
+    # and the PR-5 anytime deadline bounding the end-to-end wall
+    # (KAMINPAR_TPU_BENCH_LARGE_BUDGET_S, default 600 s on the CPU
+    # fallback).  KAMINPAR_TPU_BENCH_SKIP_LARGE=1 still skips for quick
+    # local runs.
     total_10m = cut_10m = feasible_10m = None
     util = {}
     import jax as _jax
@@ -302,12 +347,19 @@ def _bench_line() -> dict:
     if (
         base.get("large10m_coarsening_s")
         and os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1"
-        # the large section exists to measure TPU walls; on the CPU
-        # fallback it would burn ~an hour for numbers that mean nothing
-        and on_accel
     ):
+        reps = 2 if on_accel else 1
+        # unset env -> platform default (0 = unbudgeted on the
+        # accelerator, 600 s ceiling on the CPU fallback); an explicit
+        # env value — including "0" — wins
+        raw_budget = os.environ.get("KAMINPAR_TPU_BENCH_LARGE_BUDGET_S", "")
+        budget = float(raw_budget) if raw_budget else (
+            0.0 if on_accel else 600.0
+        )
         try:
-            coarsening_10m_s = _measure_large_coarsening()
+            coarsening_10m_s = _measure_large_coarsening(
+                reps=reps, budget_s=budget
+            )
         except Exception as e:  # never let the large run break the line
             import sys
 
@@ -318,11 +370,17 @@ def _bench_line() -> dict:
                 base["large10m_coarsening_s"] / coarsening_10m_s, 3
             )
         try:
-            total_10m, cut_10m, feasible_10m = _measure_large_total()
+            total_10m, cut_10m, feasible_10m = _measure_large_total(
+                reps=reps, time_budget=budget
+            )
         except Exception as e:
             import sys
 
             print(f"bench: 10M end-to-end failed: {e}", file=sys.stderr)
+    if os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1":
+        # the kernel-utilization probes are seconds of work on any
+        # platform — they ride every run (platform stamps the context:
+        # on the CPU fallback they are smoke signals, not measurements)
         try:
             util = _measure_utilization()
         except Exception as e:
@@ -337,6 +395,13 @@ def _bench_line() -> dict:
         "vs_baseline": round(vs, 3),
         "lp_coarsening_seconds": round(coarsening_s, 2),
         "total_seconds": round(total_s, 2),
+        # per-kernel split of the coarsening wall (min over seeds, same
+        # steady-state rule as coarsening_s) — the bench_trend kernel
+        # regression gate reads these
+        "kernel_seconds": {
+            "lp": round(min(lp_times), 2),
+            "contraction": round(min(contraction_times), 2),
+        },
         # cuts are platform-independent; every WALL figure is only
         # meaningful on the TPU — "cpu" here means the tunnel was down
         # and the speed ratios must not be read as TPU numbers
@@ -344,19 +409,34 @@ def _bench_line() -> dict:
     }
     if vs_cpu is not None:
         line["vs_cpu_coarsening"] = vs_cpu
-    if coarsening_10m_s is not None:
-        line["lp_coarsening_10m_seconds"] = round(coarsening_10m_s, 2)
-    if vs_cpu_10m is not None:
-        line["vs_cpu_coarsening_10m"] = vs_cpu_10m
-    if total_10m is not None:
-        line["total_10m_seconds"] = total_10m
-        line["cut_10m"] = cut_10m
-        line["feasible_10m"] = feasible_10m
-        ref_10m = base.get("large10m_edge_cut_k16")
-        if ref_10m and feasible_10m:
-            line["vs_baseline_cut_10m"] = round(ref_10m / max(cut_10m, 1), 3)
+    # the 10M block is ALWAYS present (BENCH_r05 dropped it silently;
+    # bench_trend --check now fails a round that loses these keys) —
+    # null means the measurement errored, not that it was skipped
+    line["lp_coarsening_10m_seconds"] = (
+        round(coarsening_10m_s, 2) if coarsening_10m_s is not None else None
+    )
+    line["vs_cpu_coarsening_10m"] = vs_cpu_10m
+    line["total_10m_seconds"] = total_10m
+    line["cut_10m"] = cut_10m
+    line["feasible_10m"] = feasible_10m
+    ref_10m = base.get("large10m_edge_cut_k16")
+    line["vs_baseline_cut_10m"] = (
+        round(ref_10m / max(cut_10m, 1), 3)
+        if (ref_10m and cut_10m and feasible_10m) else None
+    )
     line.update(util)
+    # the probe keys share the 10M block's always-present contract
+    # (bench_trend gates on ABSENCE; null marks a skipped/failed probe)
+    for key in ("util_gather_pct_hbm", "util_scatter_add_pct_hbm",
+                "util_stream_cumsum_pct_hbm"):
+        line.setdefault(key, None)
     if best_report is not None:
+        # rating-engine choices of the best run (ops/rating.py
+        # selection, from the embedded report's `rating` section):
+        # per-engine level counts, e.g. {"scatter": 3, "dense": 4}
+        line["rating_engines"] = (
+            best_report.get("rating", {}).get("engines", {})
+        )
         # perf-observatory headline figures promoted next to cut/seconds
         # (the full per-scope breakdown rides in the embedded report's
         # `perf` section; scripts/bench_trend.py renders these columns)

@@ -239,6 +239,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     # common algorithm overrides (kaminpar_arguments.cc coarsening/refinement)
     p.add_argument("--lp-iterations", type=int, default=None)
+    p.add_argument(
+        "--lp-rating", default=None,
+        choices=["auto", "scatter", "sort2", "sort", "hash", "dense"],
+        help="LP rating engine (default auto: per-level density-adaptive "
+        "selection; see ops/rating.py and docs/performance.md)",
+    )
+    p.add_argument(
+        "--lp-rating-slots", type=int, default=None,
+        help="hashed slots per node row for the scatter/hash engines",
+    )
     p.add_argument("--contraction-limit", type=int, default=None)
     p.add_argument(
         "--refinement", default=None,
@@ -275,6 +285,10 @@ def make_context(args: argparse.Namespace) -> Context:
         ctx.partitioning.mode = PartitioningMode(args.mode)
     if args.lp_iterations is not None:
         ctx.coarsening.clustering.lp.num_iterations = args.lp_iterations
+    if args.lp_rating is not None:
+        ctx.coarsening.clustering.lp.rating = args.lp_rating
+    if args.lp_rating_slots is not None:
+        ctx.coarsening.clustering.lp.rating_slots = args.lp_rating_slots
     if args.contraction_limit is not None:
         ctx.coarsening.contraction_limit = args.contraction_limit
     if args.refinement is not None:

@@ -104,6 +104,12 @@ class LabelPropagationContext:
     participation: float = 0.5
     allow_tie_moves: bool = True
     use_active_set: bool = True
+    # rating engine (ops/rating.py): "auto" = per-level density-adaptive
+    # selection (dense / scatter / sort2); "scatter"/"sort2"/"sort"/
+    # "hash"/"dense" force one for comparison runs (--lp-rating)
+    rating: str = "auto"
+    # hashed slots per node row for the scatter/hash engines
+    rating_slots: int = 32
 
 
 @dataclass

@@ -111,6 +111,14 @@ def build_parser() -> argparse.ArgumentParser:
         "shm-only — docs/robustness.md documents the limit)",
     )
     p.add_argument(
+        "--lp-rating", default=None,
+        choices=["auto", "scatter", "sort", "hash", "dense"],
+        help="dist LP rating engine (default auto resolves to "
+        "dense/sort — no per-shard skew measurement, so the scatter "
+        "quality gate stays closed; force 'scatter' for RMAT-class "
+        "skewed workloads; sort2 needs CSR row spans and is shm-only)",
+    )
+    p.add_argument(
         "--serve-batch", default=None, metavar="BATCH.json",
         help="serve/batch mode is served by the shm CLI "
         "(python -m kaminpar_tpu --serve-batch); the dist driver "
@@ -194,6 +202,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     mesh = make_mesh(args.num_devices)
     solver = dKaMinPar(args.preset, mesh=mesh)
+    if args.lp_rating is not None:
+        solver.ctx.lp_rating = args.lp_rating
     solver.set_graph(graph)
     if args.quiet:
         # instance-scoped: compute_partition applies and restores it

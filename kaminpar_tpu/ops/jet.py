@@ -37,12 +37,13 @@ from ..graphs.csr import DeviceGraph
 from ..telemetry import progress as progress_mod
 from .balancer import overload_balance_round
 from .metrics import edge_cut
+# the dense rate+argmax core is shared with LP through ops/rating.py —
+# one public home for every rating engine (see its module docstring)
+from .rating import best_from_dense, dense_block_ratings
 from .segments import (
     ACC_DTYPE,
     INT32_MIN,
     MAX_FUSED_EDGE_SLOTS,
-    best_from_dense,
-    dense_block_ratings,
     expand_active_rows,
     packed_afterburner_gain,
     packed_afterburner_gain_rows,

@@ -51,6 +51,7 @@ from jax import lax
 
 from ..graphs.csr import DeviceGraph
 from ..telemetry import progress as progress_mod
+from .rating import SCATTER_FALLBACK_FRAC
 from .segments import (
     ACC_DTYPE,
     INT32_MIN,
@@ -93,13 +94,18 @@ class LPConfig:
     # (LocalLPClusterer analog, kaminpar-dist/.../local_lp_clusterer.cc —
     # no cross-PE clusters, so contraction needs no label migration)
     dist_local_only: bool = False
-    # rating engine: "auto" picks dense (labels = k blocks, exact (n, k)
-    # table) or sort2 rows (everything else); "hash"/"sort" remain as
-    # forced options — see ops/segments.py "Sort-free rating engines"
+    # rating engine: "auto" delegates to ops/rating.select_engine (dense
+    # for refinement-sized label spaces, the scatter-add slot engine
+    # when the level's density fits the slot budget, sort2 rows
+    # otherwise); "scatter"/"hash"/"sort"/"sort2"/"dense" force one
     rating: str = "auto"
-    num_slots: int = 32  # hashed engine slots per node
+    num_slots: int = 32  # hashed/scatter engine slots per node (per pass)
     # sort2: how many top clusters to read per node (n-sized reads, cheap)
     topk: int = 6
+    # scatter engine: fall back to the exact sort rating when more than
+    # this fraction of the round's active real nodes stay contested
+    # (rationale at rating.SCATTER_FALLBACK_FRAC)
+    scatter_fallback: float = SCATTER_FALLBACK_FRAC
 
 
 def _select_engine(
@@ -107,21 +113,25 @@ def _select_engine(
     num_clusters: int,
     m_pad: int,
     has_communities: bool = False,
+    n_pad: int | None = None,
 ) -> str:
-    """Static (trace-time) rating engine choice.
+    """Static (trace-time) rating engine choice — delegates to the
+    density-adaptive rule in ops/rating.py (see its docstring for the
+    selection order).  Inputs are shapes (host ints), so the choice is
+    fixed per compiled executable.  The coarsener selects from MEASURED
+    per-level density/skew instead and stamps the RESOLVED engine name
+    into cfg.rating (never raw floats — cfg is a static jit argument,
+    and per-level float stats would retrace every level)."""
+    from .rating import select_engine
 
-    "auto" now always picks the row-based engines: dense (labels = k
-    blocks, exact (n, k) table) for refinement-sized label spaces, sort2
-    rows everywhere else.  Since sort2 gained the EXACT own-connection
-    (streaming masked cumsum over CSR row spans — no estimate, no extra
-    sort) and community filtering at the node-level select, the hashed
-    engine's old advantages on dense coarse levels are gone; hash/sort
-    remain as forced options for comparison runs."""
-    if cfg.rating != "auto":
-        return cfg.rating
-    if num_clusters <= 256:
-        return "dense"
-    return "sort2"
+    engine, _ = select_engine(
+        cfg.rating,
+        num_clusters,
+        n_pad if n_pad is not None else num_clusters,
+        m_pad,
+        num_slots=cfg.num_slots,
+    )
+    return engine
 
 
 # Below this many edge slots a graph's full round is cheap enough that the
@@ -136,10 +146,19 @@ def _delta_slots(graph: DeviceGraph, cfg: LPConfig, engine: str) -> int | None:
     round's cost (the crossover measured on v5e)."""
     if not cfg.use_active_set:
         return None
-    if engine not in ("sort2", "dense"):
+    if engine not in ("sort2", "dense", "scatter"):
         return None
     m_slots = graph.src.shape[0]
-    if m_slots < DELTA_MIN_EDGE_SLOTS:
+    # the scatter engine's per-round cost is segment-op bound, which
+    # shrinks with buffer width immediately — its delta crossover sits
+    # far lower than the sort engines' (measured in the round-9 CPU
+    # profile; on v5e the sort2 crossover stays where it was).  min()
+    # keeps the module-level knob authoritative when tests lower it.
+    floor = (
+        min(DELTA_MIN_EDGE_SLOTS, 1 << 20)
+        if engine == "scatter" else DELTA_MIN_EDGE_SLOTS
+    )
+    if m_slots < floor:
         return None
     return m_slots // 4
 
@@ -176,14 +195,21 @@ def lp_round(
     m_slots = graph.src.shape[0]
     C = cluster_weights.shape[0]
     cap = jnp.broadcast_to(max_cluster_weight, (C,))
-    engine = _select_engine(cfg, C, graph.m_pad, communities is not None)
-    if rows is not None and engine not in ("sort2", "dense"):
+    engine = _select_engine(
+        cfg, C, graph.m_pad, communities is not None, n_pad=n_pad
+    )
+    if rows is not None and engine not in ("sort2", "dense", "scatter"):
         raise ValueError(f"delta rounds are not supported by engine {engine}")
+
+    # nodes the rating engine could not rate exhaustively this round
+    # (scatter engine only): they are barred from moving and stay active
+    # so the next round's re-salted slots give them another chance
+    barred = jnp.zeros(n_pad, dtype=bool)
 
     # -- shared row view: either the raw CSR edge arrays (full round; src
     # is CSR-sorted so rows are contiguous spans) or the compacted active-
     # row buffer (delta round)
-    if engine in ("sort2", "dense"):
+    if engine in ("sort2", "dense", "scatter"):
         if rows is not None:
             owner_c, owner_key, edge_id, valid, start, end = rows
             eid = jnp.clip(edge_id, 0, m_slots - 1)
@@ -263,6 +289,79 @@ def lp_round(
             ok = (lab_j != own) & fits(lab_j)
             best = jnp.where(ok, lab_j, best)
             best_w = jnp.where(ok, val_j, best_w)
+    elif engine == "scatter":
+        # the one-launch scatter-add engine (ops/rating.py): ONE edge
+        # gather (labels[dst]), then segment-sum slot tables — no edge
+        # sort anywhere.  Rows the two elimination passes could not
+        # rate exhaustively are barred from moving; when too many rows
+        # are barred the whole round's rating falls back to the exact
+        # sort engine via lax.cond (collision-safe fallback — only the
+        # taken branch executes).
+        from .rating import best_from_slots, scatter_slot_ratings
+
+        nb = (
+            jnp.where(valid, labels[dst_b], -1)
+            if rows is not None
+            else labels[dst_b]
+        )
+        valid_slots = valid if rows is not None else None
+        seg_owner = (
+            jnp.where(valid, owner_c, -1) if rows is not None else owner_c
+        )
+        node_ids0 = jnp.arange(n_pad, dtype=jnp.int32)
+        is_real0 = node_ids0 < graph.n
+
+        # the slot tables are built ONCE, outside the cond: the fallback
+        # predicate needs fully_rated either way, and the (n, 2S) table
+        # is the cheap part to carry into the taken branch
+        slot_label, slot_w, fully_rated = scatter_slot_ratings(
+            owner_c, nb, w_b, n_pad, cfg.num_slots, salt,
+            valid=valid_slots, spans=(start, end),
+        )
+
+        def scatter_rate(_):
+            b, bw, w_own = best_from_slots(
+                slot_label, slot_w, labels, cluster_weights,
+                graph.node_w, cap, salt, communities=communities,
+            )
+            return b, bw, w_own, ~fully_rated
+
+        def sort_rate(_):
+            seg_g, key_g, w_g = aggregate_by_key(seg_owner, nb, w_b)
+            key_c = jnp.clip(key_g, 0, C - 1)
+            seg_c = jnp.clip(seg_g, 0, n_pad - 1)
+            fits_g = (
+                cluster_weights[key_c].astype(ACC_DTYPE)
+                + graph.node_w[seg_c].astype(ACC_DTYPE)
+                <= cap[key_c]
+            )
+            feasible = (seg_g >= 0) & (key_g != labels[seg_c]) & fits_g
+            if communities is not None:
+                key_n = jnp.clip(key_g, 0, n_pad - 1)
+                feasible = feasible & (
+                    communities[key_n] == communities[seg_c]
+                )
+            b, bw = argmax_per_segment(
+                seg_g, key_g, w_g, n_pad, tie_salt=salt, feasible=feasible
+            )
+            w_own = connection_to_label(seg_g, key_g, w_g, labels, n_pad)
+            return b, bw, w_own, jnp.zeros(n_pad, dtype=bool)
+
+        # fallback predicate on values already in hand: barred fraction
+        # of the ACTIVE real nodes (an n-wide reduce, no extra edge op)
+        act_real = active & is_real0
+        # node counts <= n, ID domain  # tpulint: disable=R3
+        n_bar = jnp.sum(act_real & ~fully_rated, dtype=jnp.int32)
+        # node counts <= n, ID domain  # tpulint: disable=R3
+        n_act = jnp.sum(act_real, dtype=jnp.int32)
+        use_scatter = n_bar.astype(jnp.float32) <= (
+            jnp.float32(cfg.scatter_fallback) * n_act.astype(jnp.float32)
+        )
+        best, best_w, w_cur, barred = lax.cond(
+            use_scatter, scatter_rate, sort_rate, None
+        )
+        best = jnp.where(barred, -1, best)
+        best_w = jnp.where(barred, INT32_MIN, best_w)
     elif engine == "dense":
         if plans is not None and rows is None:
             from .lane_gather import routed_block_ratings
@@ -407,7 +506,13 @@ def lp_round(
                 & (best_w > 0)
             )
         )
-        new_active = accept | neigh_moved | (may_move_later & ~accept)
+        # barred rows (scatter engine: still-contested after both
+        # elimination passes) keep their active bit — the next round's
+        # salt re-rolls their slots, so they get rated again
+        new_active = (
+            accept | neigh_moved | (may_move_later & ~accept)
+            | (barred & active)
+        )
     else:
         new_active = jnp.ones_like(active)
 
@@ -440,7 +545,9 @@ def _round_with_delta(
     the bulk-synchronous answer to the async reference's active-set
     work-skipping (label_propagation.h:507-513)."""
     C = weights.shape[0]
-    engine = _select_engine(cfg, C, graph.m_pad, communities is not None)
+    engine = _select_engine(
+        cfg, C, graph.m_pad, communities is not None, n_pad=graph.n_pad
+    )
     dslots = _delta_slots(graph, cfg, engine)
     if dslots is None:
         return lp_round(
@@ -888,8 +995,54 @@ def two_hop_cluster(
     # dispatch as lp_round; a singleton's own label never appears among
     # its neighbors' labels, so own-exclusion is harmless here)
     neighbor_cluster = labels[graph.dst]
-    engine = _select_engine(cfg, cluster_weights.shape[0], graph.m_pad)
-    if engine == "sort2":
+    engine = _select_engine(
+        cfg, cluster_weights.shape[0], graph.m_pad, n_pad=n_pad
+    )
+    if engine == "scatter":
+        # favored cluster = unconstrained best rated cluster from the
+        # scatter slot tables, with the same collision-safe fallback as
+        # the round rating: when too many singleton rows stay contested
+        # the exact sort rating takes over (lax.cond, one branch runs)
+        from .rating import best_from_slots, scatter_slot_ratings
+
+        slot_label, slot_w, fully_rated = scatter_slot_ratings(
+            graph.src, neighbor_cluster, graph.edge_w, n_pad,
+            cfg.num_slots, seed,
+        )
+
+        def scatter_fav(_):
+            fav, fav_w, _ = best_from_slots(
+                slot_label, slot_w, labels, cluster_weights,
+                graph.node_w,
+                jnp.broadcast_to(
+                    max_cluster_weight, (cluster_weights.shape[0],)
+                ),
+                seed, require_fit=False,
+            )
+            # zero-weight ratings (sparsified-away edges) are not real
+            # favorites — same mask as the sort2/hash branches
+            return jnp.where(fully_rated & (fav_w > 0), fav, -1)
+
+        def sort_fav(_):
+            seg_g, key_g, w_g = aggregate_by_key(
+                graph.src, neighbor_cluster, graph.edge_w
+            )
+            fav, _ = argmax_per_segment(
+                seg_g, key_g, w_g, n_pad, tie_salt=seed
+            )
+            return fav
+
+        # singleton counts <= n, ID domain  # tpulint: disable=R3
+        n_bad = jnp.sum(singleton & ~fully_rated, dtype=jnp.int32)
+        # singleton counts <= n, ID domain  # tpulint: disable=R3
+        n_sing = jnp.sum(singleton, dtype=jnp.int32)
+        favored = lax.cond(
+            n_bad.astype(jnp.float32)
+            <= jnp.float32(cfg.scatter_fallback)
+            * n_sing.astype(jnp.float32),
+            scatter_fav, sort_fav, None,
+        )
+    elif engine == "sort2":
         # a singleton's own label never appears among its neighbors, so
         # the top-1 rated cluster IS the favored cluster; zero-weight
         # ratings (sparsified-away or pad edges) are not real favorites

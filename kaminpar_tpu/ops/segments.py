@@ -80,6 +80,17 @@ def hash_u32(x: jax.Array, salt) -> jax.Array:
     return (x >> jnp.uint32(1)).astype(jnp.int32)
 
 
+def hash_tie16(x: jax.Array, salt) -> jax.Array:
+    """Top 16 bits of hash_u32 as non-negative int16 — the narrow
+    TIE-BREAK dtype for edge-wide sort operands (round-9 dtype packing:
+    a tie key only needs enough entropy to order ties deterministically,
+    and halving the operand width cuts the sort's streamed bytes; an
+    equal-16-bit tie falls through to the sort's stable order, which is
+    itself deterministic).  NEVER for weights/gains — those keep
+    ACC_DTYPE per the dtypes.py policy (tpulint R3)."""
+    return (hash_u32(x, salt) >> jnp.int32(16)).astype(jnp.int16)
+
+
 def sort_by_two_keys(
     primary: jax.Array, secondary: jax.Array, *values: jax.Array
 ) -> Tuple[jax.Array, ...]:
@@ -112,16 +123,25 @@ def aggregate_by_key(
     total = cum - base
     is_last = jnp.concatenate([is_new[1:], jnp.array([True])])
     # compact group-last entries to the front, preserving (seg, key)
-    # order, with one more sort instead of a scatter
+    # order: one position scatter + three cheap gathers.  This replaced
+    # a second 5-operand 2-key sort — bitwise-identical output (the
+    # group prefix keeps its (seg, key) order, the suffix is the same
+    # masked fill), at one indexed pass instead of a multi-operand
+    # comparator sort (the round-9 CPU profile put that sort at ~45% of
+    # aggregate_by_key's wall; on TPU a 1-index-per-slot scatter and
+    # the sort price within noise of each other).
     pos = jnp.arange(m, dtype=jnp.int32)
-    not_last = (~is_last).astype(jnp.int32)
-    nl2, _, seg_g, key_g, w_g = lax.sort(
-        (not_last, pos, seg_s, key_s, total), num_keys=2
+    # group g's output slot; non-lasts routed to the dropped slot m
+    out_slot = jnp.cumsum(is_last.astype(jnp.int32)) - 1
+    dest = jnp.where(is_last, out_slot, m)
+    src_pos = (
+        jnp.full(m, m, dtype=jnp.int32).at[dest].set(pos, mode="drop")
     )
-    in_groups = nl2 == 0
-    seg_g = jnp.where(in_groups, seg_g, -1)
-    key_g = jnp.where(in_groups, key_g, -1)
-    w_g = jnp.where(in_groups, w_g, 0)
+    in_groups = src_pos < m
+    sp = jnp.clip(src_pos, 0, m - 1)
+    seg_g = jnp.where(in_groups, seg_s[sp], -1)
+    key_g = jnp.where(in_groups, key_s[sp], -1)
+    w_g = jnp.where(in_groups, total[sp], 0)
     return seg_g, key_g, w_g
 
 
@@ -554,7 +574,8 @@ def rating_top3_by_sort(
     total = cum - base
     is_last = jnp.concatenate([new_grp[1:], jnp.array([True])])
 
-    tb = hash_u32(nb_s, salt)
+    # 16-bit tie operand (hash_tie16): half the third sort key's bytes
+    tb = hash_tie16(nb_s, salt)
     prio = jnp.where(is_last, total, -1)
     _, prio2, _, lab2 = lax.sort((src_s, prio, tb, nb_s), num_keys=3)
 
@@ -724,7 +745,8 @@ def rating_topk_rows(
     base = lax.cummax(jnp.where(new_grp, cum - w_s, 0))
     total = cum - base
     is_last = jnp.concatenate([new_grp[1:], jnp.array([True])])
-    tb = hash_u32(nb_s, salt)
+    # 16-bit tie operand (hash_tie16): half the third sort key's bytes
+    tb = hash_tie16(nb_s, salt)
     prio = jnp.where(is_last, total, -1)
     _, prio2, _, lab2 = lax.sort((o_s, prio, tb, nb_s), num_keys=3)
     D = prio2.shape[0]

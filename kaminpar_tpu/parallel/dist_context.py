@@ -80,6 +80,13 @@ class DistContext:
     lp_num_iterations: int = 5
     clp_num_iterations: int = 5
     hem_rounds: int = 5
+    # LP rating engine over the sharded COO layout (ops/rating.py):
+    # "auto" resolves to dense / sort — the dist path has no measured
+    # degree skew, and select_engine's skew quality gate keeps scatter
+    # out without one; force "scatter" explicitly (--lp-rating) on
+    # workloads known to be RMAT-class skewed.  sort2 is unavailable
+    # here (no CSR row spans)
+    lp_rating: str = "auto"
     # mesh-subgroup replication (deep_multilevel.cc:79-153 + replicator.cc
     # replicate_graph / distribute_best_partition analog): once the graph
     # drops below this many nodes PER DEVICE, G replicas coarsen
@@ -238,11 +245,12 @@ def create_dist_clusterer(ctx: DistContext) -> Callable:
             graph.n_pad, dtype=jnp.int32
         )
     if algo == DistClusteringAlgorithm.GLOBAL_LP:
+        cfg = LPConfig(rating=ctx.lp_rating)
         return lambda graph, mcw, seed: dist_lp_cluster(
-            graph, mcw, seed, num_iterations=ctx.lp_num_iterations
+            graph, mcw, seed, cfg=cfg, num_iterations=ctx.lp_num_iterations
         )
     if algo == DistClusteringAlgorithm.LOCAL_LP:
-        cfg = LPConfig(dist_local_only=True)
+        cfg = LPConfig(dist_local_only=True, rating=ctx.lp_rating)
         return lambda graph, mcw, seed: dist_lp_cluster(
             graph, mcw, seed, cfg=cfg, num_iterations=ctx.lp_num_iterations
         )

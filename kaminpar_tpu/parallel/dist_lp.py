@@ -48,6 +48,7 @@ from ..ops.lp import LPConfig
 from ..telemetry import progress as progress_mod
 from ..ops.segments import (
     ACC_DTYPE,
+    INT32_MIN,
     accept_prefix_by_capacity,
     aggregate_by_key,
     argmax_per_segment,
@@ -109,7 +110,7 @@ def _dist_lp_round(
     # same engine dispatch as the single-chip lp_round (ops/lp.py): the
     # device holds every edge of its owned nodes, so hashed winner sums
     # and dense tables are exact locally
-    from ..ops.lp import _select_engine
+    from ..ops.rating import select_engine
 
     neighbor_cluster = lab_tab[jnp.clip(dstloc_l, 0, n_loc + g_loc - 1)]
     seg = src_l - offset
@@ -119,15 +120,80 @@ def _dist_lp_round(
         # a different engine
         raise ValueError(
             "rating='sort2' is not available on the distributed path; "
-            "use 'hash', 'sort', or 'auto'"
+            "use 'scatter', 'hash', 'sort', or 'auto'"
         )
-    engine = _select_engine(cfg, C, src_l.shape[0])
-    if engine == "sort2":
-        # auto selection: sort2 needs CSR row spans, which the sharded COO
-        # layout does not carry.  Small shards keep the exact 'sort'
-        # engine; large ones take the hashed table (the fast path here).
-        engine = "sort" if src_l.shape[0] < (1 << 21) else "hash"
-    if engine == "dense":
+    # the engine flag is trace-time static: cfg threads through
+    # shard_map as a closure constant, so every device compiles the
+    # same engine (row_spans=False removes the sort2 row engines)
+    engine, _ = select_engine(
+        cfg.rating, C, n_loc, src_l.shape[0],
+        num_slots=cfg.num_slots, row_spans=False,
+    )
+    barred_l = jnp.zeros(n_loc, dtype=bool)
+    if engine == "scatter":
+        # scatter-add slot tables (ops/rating.py): each device holds
+        # every edge of its owned nodes, so the per-row elimination
+        # passes are exact locally; still-contested rows are barred
+        # from moving this round, and the round falls back to the
+        # exact sort rating when too many owned rows are barred (the
+        # predicate is LOCAL by design — a lax.cond inside shard_map
+        # must not branch on a collective, and per-device engine
+        # divergence is fine: the commit protocol is engine-agnostic)
+        from ..ops.rating import best_from_slots, scatter_slot_ratings
+
+        in_range = (seg >= 0) & (seg < n_loc)
+        # rows are the n_loc OWNED nodes, labels are GLOBAL cluster ids
+        # (C-wide) — label_space keeps the winner packing and clipping
+        # in the global domain
+        slot_label, slot_w, fully_rated = scatter_slot_ratings(
+            jnp.clip(seg, 0, n_loc - 1), neighbor_cluster, ew_l,
+            n_loc, cfg.num_slots, salt, valid=in_range, label_space=C,
+        )
+        label_range = None
+        if cfg.dist_local_only:
+            label_range = (offset, offset + n_loc)
+
+        def scatter_rate(_):
+            b, bw, w_own = best_from_slots(
+                slot_label, slot_w, labels_l, weights, nw_l, cap,
+                salt, label_range=label_range,
+            )
+            return b, bw, w_own, ~fully_rated
+
+        def sort_rate(_):
+            seg_g, key_g, w_g = aggregate_by_key(
+                jnp.where(in_range, seg, -1), neighbor_cluster, ew_l
+            )
+            key_c = jnp.clip(key_g, 0, C - 1)
+            seg_c = jnp.clip(seg_g, 0, n_loc - 1)
+            fits = (
+                weights[key_c].astype(ACC_DTYPE)
+                + nw_l[seg_c].astype(ACC_DTYPE)
+                <= cap[key_c]
+            )
+            feasible = (seg_g >= 0) & (key_g != labels_l[seg_c]) & fits
+            if cfg.dist_local_only:
+                owned = (key_g >= offset) & (key_g < offset + n_loc)
+                feasible = feasible & owned
+            b, bw = argmax_per_segment(
+                seg_g, key_g, w_g, n_loc, tie_salt=salt, feasible=feasible
+            )
+            w_own = connection_to_label(seg_g, key_g, w_g, labels_l, n_loc)
+            return b, bw, w_own, jnp.zeros(n_loc, dtype=bool)
+
+        # local node counts <= n_loc, ID domain  # tpulint: disable=R3
+        n_bar = jnp.sum(active_l & ~fully_rated, dtype=jnp.int32)
+        # local node counts <= n_loc, ID domain  # tpulint: disable=R3
+        n_act = jnp.sum(active_l, dtype=jnp.int32)
+        use_scatter = n_bar.astype(jnp.float32) <= (
+            jnp.float32(cfg.scatter_fallback) * n_act.astype(jnp.float32)
+        )
+        best, best_w, w_cur, barred_l = lax.cond(
+            use_scatter, scatter_rate, sort_rate, None
+        )
+        best = jnp.where(barred_l, -1, best)
+        best_w = jnp.where(barred_l, INT32_MIN, best_w)
+    elif engine == "dense":
         conn = dense_block_ratings(
             seg, jnp.clip(dstloc_l, 0, n_loc + g_loc - 1), ew_l, lab_tab,
             n_loc, C,
@@ -258,7 +324,13 @@ def _dist_lp_round(
         neigh_moved = jax.ops.segment_max(
             moved_tab[dstloc_c], seg, num_segments=n_loc
         )
-        new_active_l = ((moved_l | neigh_moved) > 0) | (wants & ~accept_l)
+        # barred rows (scatter engine) stay active for the re-salted
+        # slots next round — same retention rule as the shm kernel
+        new_active_l = (
+            ((moved_l | neigh_moved) > 0)
+            | (wants & ~accept_l)
+            | (barred_l & active_l)
+        )
     else:
         new_active_l = jnp.ones_like(active_l)
 

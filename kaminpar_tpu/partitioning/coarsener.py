@@ -67,7 +67,68 @@ class Coarsener:
             two_hop=lp_ctx.two_hop_strategy != TwoHopStrategy.DISABLE,
             cluster_isolated=lp_ctx.isolated_nodes_strategy
             != IsolatedNodesStrategy.KEEP,
+            rating=lp_ctx.rating,
+            num_slots=lp_ctx.rating_slots,
         )
+
+    def _level_lp_cfg(self, graph: DeviceGraph) -> LPConfig:
+        """Per-level rating-engine selection from MEASURED density and
+        degree skew (the 1402.3281 adaptivity rule, ops/rating.py).
+
+        Host-side, between launches: n/m are level metadata the driver
+        already holds, the max degree is one scalar readback off the
+        degrees array the graph already carries.  The chosen engine is
+        stamped into the level's LPConfig (trace-time static, so each
+        shape bucket compiles the engine it will actually run) and
+        exposed as a `rating-engine` telemetry event -> the run
+        report's `rating` section."""
+        from dataclasses import replace
+
+        from ..ops.rating import select_engine
+
+        # REAL sizes only — never padded shapes: the memory governor's
+        # recovery ladder re-buckets the same graph into tighter pads,
+        # and a pad-sensitive engine choice would make spilled/reloaded
+        # runs diverge from unspilled ones (rung-2 cut-identity test)
+        n = max(int(self.current_n), 1)
+        m = int(graph.m) or int(graph.src.shape[0])
+        avg_degree = m / n
+        max_degree = int(jnp.max(graph.degrees))
+        degree_skew = max_degree / max(avg_degree, 1e-9)
+        engine, reason = select_engine(
+            self._lp_cfg.rating, graph.n_pad, n, m,
+            num_slots=self._lp_cfg.num_slots,
+            avg_degree=avg_degree, degree_skew=degree_skew,
+        )
+        from .. import telemetry
+
+        telemetry.event(
+            "rating-engine",
+            level=self.level,
+            engine=engine,
+            reason=reason,
+            avg_degree=round(avg_degree, 2),
+            degree_skew=round(degree_skew, 2),
+            n=n,
+            m=int(graph.m),
+        )
+        # the RESOLVED engine name is stamped (a handful of distinct
+        # cfg values across the hierarchy), never the raw float stats —
+        # LPConfig is a static jit argument and a per-level float would
+        # force a retrace per level.  The slot budget steps with the
+        # measured density (quantized to two values for the same
+        # retrace reason): denser levels contest more slots, and a
+        # doubled budget costs less than the fallback rounds it avoids
+        # (measured on the 600k bench: S=64 at avg degree 18 is both
+        # faster and coarsens further than S=32).
+        slots = self._lp_cfg.num_slots
+        if (
+            engine == "scatter"
+            and avg_degree > slots / 2
+            and 4 * n * slots <= 12 * m  # doubled table stays in budget
+        ):
+            slots = 2 * slots
+        return replace(self._lp_cfg, rating=engine, num_slots=slots)
 
     @property
     def level(self) -> int:
@@ -121,6 +182,9 @@ class Coarsener:
             min(max_cluster_weight, int(jnp.iinfo(WEIGHT_DTYPE).max)),
             dtype=WEIGHT_DTYPE,
         )
+        # density-adaptive rating engine for THIS level, from the graph
+        # actually being clustered (the sparsified copy when active)
+        lp_cfg = self._level_lp_cfg(cluster_input)
 
         def cluster_once(cap, salt_off):
             if c_ctx.algorithm == CoarseningAlgorithm.OVERLAY_CLUSTERING:
@@ -134,14 +198,14 @@ class Coarsener:
                     li = lp_cluster(
                         cluster_input, cap,
                         seed + jnp.int32(7 * r + 1 + salt_off),
-                        self._lp_cfg,
+                        lp_cfg,
                     )
                     labels = (
                         li if labels is None else combine_labels(labels, li)
                     )
                 return labels
             return lp_cluster(
-                cluster_input, cap, seed + jnp.int32(salt_off), self._lp_cfg
+                cluster_input, cap, seed + jnp.int32(salt_off), lp_cfg
             )
 
         # dispatch is async and block_until_ready is unreliable over the

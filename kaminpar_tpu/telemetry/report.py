@@ -163,6 +163,24 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         for e in _events("coarsening-level")
     ]
 
+    # per-level rating-engine choices (ops/rating.select_engine via the
+    # coarsener's `rating-engine` events) + a per-engine level count —
+    # the report-field twin of the telemetry event, so "which engine ran
+    # where and why" is a read (bench_trend renders the counts column)
+    rating_levels = [
+        {k: e.attrs[k]
+         for k in ("level", "engine", "reason", "avg_degree",
+                   "degree_skew", "n", "m")
+         if k in e.attrs}
+        for e in _events("rating-engine")
+    ]
+    rating_counts: Dict[str, int] = {}
+    for lv in rating_levels:
+        eng = lv.get("engine")
+        if eng:
+            rating_counts[eng] = rating_counts.get(eng, 0) + 1
+    rating_section = {"levels": rating_levels, "engines": rating_counts}
+
     try:
         from ..parallel import mesh
 
@@ -211,6 +229,9 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         "result": result,
         "scope_tree": _scope_tree(timer.GLOBAL_TIMER.root),
         "levels": levels,
+        # schema v6 (additive): per-level rating-engine choices — the
+        # density-adaptive selection audit trail (ops/rating.py)
+        "rating": rating_section,
         "comm": comm,
         "events": [e.to_dict() for e in _events()],
         "counters": statistics.as_dict() if statistics.enabled() else {},

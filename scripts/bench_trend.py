@@ -14,12 +14,22 @@ Usage:
 
 `--check` exits non-zero when a recorded round is malformed (unreadable
 JSON, rc==0 without a parsed BENCH line, parsed line missing the metric
-fields, a schema-v5 report without its `perf` section) — cut/wall and
-the perf-observatory columns' movements between rounds (hbm_util,
+fields, a schema-v5 report without its `perf` section) — the
+perf-observatory columns' movements between rounds (hbm_util,
 pad_waste, p95_ms) are PRINTED, not gated: rounds run on different code
 by design, and the per-PR regression gate is `telemetry.diff` on
 like-for-like reports (scripts/check_all.sh), which DOES gate serving
 hit-rate and served-count regressions.
+
+`--check` is ALSO the kernel regression gate (round 9): the LATEST
+parsed round must keep `vs_baseline` above the cut floor (cuts are
+platform-independent), must still carry every 10M-coverage key
+(BENCH_r05 dropped them silently — presence is gated, null marks a
+failed measurement), and — on accelerator rounds only, where walls are
+meaningful — must keep `lp_coarsening_seconds` under the ceiling and
+`hbm_util` above the utilization floor.  Floors are flags
+(--cut-floor/--coarsening-ceiling/--hbm-util-floor) so a deliberate
+re-baseline is an explicit diff, not a silent drift.
 """
 
 from __future__ import annotations
@@ -32,6 +42,30 @@ import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 REQUIRED_PARSED_KEYS = ("metric", "value", "unit")
+
+#: 10M-edge coverage keys every round from r06 on must carry (null =
+#: the measurement failed; ABSENT = the bench silently lost coverage,
+#: which is what r05 did and what this gate exists to catch), plus the
+#: kernel-utilization probes.
+LARGE_COVERAGE_KEYS = (
+    "lp_coarsening_10m_seconds", "cut_10m", "feasible_10m",
+    "vs_baseline_cut_10m", "util_gather_pct_hbm",
+    "util_scatter_add_pct_hbm", "util_stream_cumsum_pct_hbm",
+)
+#: Rounds at or below this index predate the coverage contract.
+LARGE_COVERAGE_SINCE = 6
+
+#: Platforms whose wall/utilization figures are meaningful (the CPU
+#: fallback's walls are smoke signals by repo doctrine — bench.py
+#: stamps `platform` exactly so gates can tell).
+ACCEL_PLATFORMS = ("tpu", "axon")
+
+
+def _round_number(name: str) -> Optional[int]:
+    """BENCH_r07.json -> 7 (None for non-conforming names)."""
+    stem = os.path.splitext(name)[0]
+    digits = "".join(ch for ch in stem if ch.isdigit())
+    return int(digits) if digits else None
 
 
 def load_rounds(repo: str) -> List[Tuple[str, dict]]:
@@ -95,6 +129,24 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         ((serving.get("latency") or {}).get("phases") or {})
         .get("total", {}).get("p95_ms")
     )
+    # per-kernel seconds (round-9 bench.py `kernel_seconds`); older
+    # rounds fall back to the embedded report's scope tree
+    kernels = parsed.get("kernel_seconds") or {}
+    if not kernels:
+        coars = (
+            (report.get("scope_tree") or {})
+            .get("partitioning", {}).get("children", {})
+            .get("coarsening", {}).get("children", {})
+        )
+        kernels = {
+            short: coars[scope]["elapsed_s"]
+            for short, scope in (("lp", "lp-clustering"),
+                                 ("contraction", "contraction"))
+            if scope in coars
+        }
+    engines = parsed.get("rating_engines") or (
+        (report.get("rating") or {}).get("engines") or {}
+    )
     return {
         "round": os.path.basename(path),
         "rc": entry.get("rc"),
@@ -102,6 +154,11 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "vs_baseline": parsed.get("vs_baseline"),
         "total_s": parsed.get("total_seconds"),
         "coarsening_s": parsed.get("lp_coarsening_seconds"),
+        "lp_s": kernels.get("lp"),
+        "contract_s": kernels.get("contraction"),
+        "engines": ",".join(
+            f"{k}:{v}" for k, v in sorted(engines.items())
+        ) or None,
         "platform": parsed.get("platform"),
         "compile_s": compile_totals.get("compile_s"),
         "cache_hit": cache_hit,
@@ -124,7 +181,8 @@ def _fmt(v: Optional[Any]) -> str:
 
 def render(rows: List[Dict[str, Any]]) -> str:
     cols = ("round", "rc", "cut", "vs_baseline", "total_s",
-            "coarsening_s", "compile_s", "cache_hit", "hbm_util",
+            "coarsening_s", "lp_s", "contract_s", "engines",
+            "compile_s", "cache_hit", "hbm_util",
             "pad_waste", "p95_ms", "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
@@ -178,7 +236,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true", help="emit rows as JSON")
     ap.add_argument(
         "--check", action="store_true",
-        help="CI mode: exit non-zero on structurally malformed rounds",
+        help="CI mode: exit non-zero on structurally malformed rounds "
+        "or a latest round past the kernel/cut gates",
+    )
+    ap.add_argument(
+        "--cut-floor", type=float, default=0.9,
+        help="latest round must keep vs_baseline >= this "
+        "(platform-independent; default 0.9)",
+    )
+    ap.add_argument(
+        "--coarsening-ceiling", type=float, default=2.0,
+        help="latest ACCELERATOR round must keep lp_coarsening_seconds "
+        "<= this (default 2.0 s; CPU-fallback rounds skip wall gates)",
+    )
+    ap.add_argument(
+        "--hbm-util-floor", type=float, default=0.005,
+        help="latest ACCELERATOR round must keep hbm_util >= this when "
+        "the column is present (default 0.005)",
     )
     args = ap.parse_args(argv)
 
@@ -194,6 +268,60 @@ def main(argv: Optional[List[str]] = None) -> int:
     errors: List[str] = []
     for path, entry in rounds:
         errors.extend(check_round(path, entry))
+        # 10M-coverage contract for rounds newer than r05 (see
+        # LARGE_COVERAGE_KEYS): presence gated, null tolerated
+        name = os.path.basename(path)
+        parsed = entry.get("parsed") if isinstance(entry, dict) else None
+        rno = _round_number(name)
+        if (
+            isinstance(parsed, dict)
+            and rno is not None and rno >= LARGE_COVERAGE_SINCE
+        ):
+            for key in LARGE_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: 10M coverage key {key!r} missing "
+                        "(r05 regression class — bench.py must emit it "
+                        "every run)"
+                    )
+    # kernel/cut regression gate on the LATEST parsed round (--check):
+    # older rounds ran older code and are history, not a gate target
+    latest = None
+    for path, entry in reversed(rounds):
+        if isinstance(entry, dict) and isinstance(entry.get("parsed"), dict):
+            latest = (os.path.basename(path), entry["parsed"])
+            break
+    if latest is not None:
+        name, parsed = latest
+        vs = parsed.get("vs_baseline")
+        if isinstance(vs, (int, float)) and vs > 0 and vs < args.cut_floor:
+            errors.append(
+                f"{name}: vs_baseline {vs} under the cut floor "
+                f"{args.cut_floor}"
+            )
+        if parsed.get("platform") in ACCEL_PLATFORMS:
+            wall = parsed.get("lp_coarsening_seconds")
+            if (
+                isinstance(wall, (int, float))
+                and wall > args.coarsening_ceiling
+            ):
+                errors.append(
+                    f"{name}: lp_coarsening_seconds {wall} over the "
+                    f"ceiling {args.coarsening_ceiling}"
+                )
+            hbm = parsed.get("hbm_util")
+            if isinstance(hbm, (int, float)) and hbm < args.hbm_util_floor:
+                errors.append(
+                    f"{name}: hbm_util {hbm} under the floor "
+                    f"{args.hbm_util_floor}"
+                )
+        elif args.check:
+            print(
+                f"kernel gate: {name} ran on "
+                f"platform={parsed.get('platform')!r} — wall/util gates "
+                "skipped (CPU-fallback walls are not TPU numbers); cut "
+                "and coverage gates still applied"
+            )
     rows = [_row(p, e) for p, e in rounds if isinstance(e, dict)]
     if args.json:
         print(json.dumps(rows))

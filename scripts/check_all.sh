@@ -66,7 +66,7 @@ EOF
 python -m kaminpar_tpu.telemetry.top /tmp/_kmp_chaos_report.json \
     --require-roofline > /dev/null || exit 1
 
-echo "== [4/8] telemetry.diff self-test + BENCH trend =="
+echo "== [4/8] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -84,6 +84,9 @@ if python -m kaminpar_tpu.telemetry.diff \
     echo "ERROR: telemetry.diff accepted an injected 50% regression" >&2
     exit 1
 fi
+# the trend check is also the kernel regression gate: latest-round cut
+# floor, 10M-coverage key presence (the r05 silent-drop class), and —
+# on accelerator rounds — lp_coarsening_seconds / hbm_util floors
 python scripts/bench_trend.py --check || exit 1
 
 

@@ -14,3 +14,9 @@ def cut_accumulator(weights, mask):
 def narrowed(weights, owners, n):
     sums = jax.ops.segment_sum(weights, owners, num_segments=n)
     return jnp.cumsum(sums).astype(jnp.int32)  # line 16: R3 narrowing
+
+
+def slot_table_sums(edge_w, flat, total):
+    """Scatter-add rating table (round 9): slot sums are WEIGHTS."""
+    return jax.ops.segment_sum(edge_w, flat, num_segments=total,
+                               dtype=jnp.int32)  # line 21: R3

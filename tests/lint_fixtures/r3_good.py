@@ -10,3 +10,12 @@ def edge_prefix_sums(counts):
 
 def cut_accumulator(weights, mask):
     return jnp.sum(jnp.where(mask, weights, 0), dtype=ACC_DTYPE)
+
+
+def slot_table_sums(edge_w, flat, total):
+    """Scatter-add rating table (round 9): weights keep ACC_DTYPE."""
+    import jax
+
+    return jax.ops.segment_sum(
+        edge_w.astype(ACC_DTYPE), flat, num_segments=total
+    )

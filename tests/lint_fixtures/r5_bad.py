@@ -10,3 +10,9 @@ def plan_level_logged_only(dst, n_pad, telemetry):
     plan = build_gather_plan(dst, n_pad)  # line 10: R5 logging != a cap
     telemetry.event("plan", num_slots=plan.num_slots)
     return plan
+
+
+def rating_plan(dst, n_pad):
+    """Round 9: a rating engine routing labels[dst] through the lane
+    gather must still cap the plan."""
+    return build_gather_plan(dst, n_pad)  # line 18: R5

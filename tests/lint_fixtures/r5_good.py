@@ -7,3 +7,8 @@ def plan_level(dst, n_pad):
     if not plan_within_cap(plan, dst.shape[0]):
         return None
     return plan
+
+
+def rating_plan(dst, n_pad):
+    """Round 9: the builder's max_slots= abort is itself a cap."""
+    return build_gather_plan(dst, n_pad, max_slots=4 * dst.shape[0])

@@ -33,9 +33,9 @@ def _findings(name):
 BAD_EXPECT = {
     "r1_bad.py": [("R1", 20), ("R1", 22), ("R1", 23), ("R1", 24), ("R1", 30)],
     "r2_bad.py": [("R2", 5), ("R2", 9)],
-    "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16)],
+    "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16), ("R3", 21)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
-    "r5_bad.py": [("R5", 6), ("R5", 10)],
+    "r5_bad.py": [("R5", 6), ("R5", 10), ("R5", 18)],
     "r6_bad.py": [("R6", 7), ("R6", 11), ("R6", 15), ("R6", 19)],
 }
 
@@ -166,7 +166,7 @@ def test_cli_json_format(capsys):
     bad = os.path.join(FIXTURES, "r5_bad.py")
     assert main([bad, "--no-baseline", "--format", "json"]) == 1
     payload = json.loads(capsys.readouterr().out)
-    assert payload["total"] == 2
+    assert payload["total"] == 3
     assert payload["new"][0]["rule"] == "R5"
 
 
