@@ -26,6 +26,22 @@ from __future__ import annotations
 import json
 import os
 
+#: Quality-attribution keys the BENCH line ALWAYS carries (the same
+#: never-vanish contract as the 10M block: null marks a run whose report
+#: produced no attribution, ABSENCE is a coverage regression —
+#: scripts/bench_trend.py gates presence from r06 on, and check_all.sh
+#: asserts this contract without running the full bench).
+QUALITY_KEYS = ("coarsening_locked_frac", "refinement_left_frac")
+
+
+def quality_keys(report) -> dict:
+    """The BENCH line's quality-attribution keys from an embedded run
+    report (telemetry/quality.py totals); every key present, null when
+    the report carries no attribution."""
+    totals = ((report or {}).get("quality") or {}).get("totals") or {}
+    return {key: totals.get(key) for key in QUALITY_KEYS}
+
+
 MED_N = 1 << 16
 MED_M = 600_000
 MED_SEED = 3
@@ -430,6 +446,11 @@ def _bench_line() -> dict:
     for key in ("util_gather_pct_hbm", "util_scatter_add_pct_hbm",
                 "util_stream_cumsum_pct_hbm"):
         line.setdefault(key, None)
+    # quality-attribution headline (telemetry/quality.py): which share
+    # of the per-level cut gap is locked by coarsening vs left by
+    # refinement — ALWAYS present (null = no attribution recorded), so
+    # the trajectory can never silently lose the quality signal
+    line.update(quality_keys(best_report))
     if best_report is not None:
         # rating-engine choices of the best run (ops/rating.py
         # selection, from the embedded report's `rating` section):

@@ -456,6 +456,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.quiet:
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
+        # one-line cut-loss attribution headline (telemetry/quality.py)
+        # next to RESULT/TIME — None when the quality layer recorded
+        # nothing (telemetry off, KAMINPAR_TPU_QUALITY=0, no hierarchy)
+        from .telemetry import quality as quality_mod
+
+        quality_line = quality_mod.headline()
+        if quality_line:
+            print(quality_line)
     if args.timers and not args.quiet:
         print(timer.GLOBAL_TIMER.render())
     if args.machine_timers and not args.quiet:

@@ -241,6 +241,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the facade logs the single RESULT line (cli.py pattern: the
         # library prints the result, the CLI prints only timings)
         print(f"TIME io={io_s:.3f}s partitioning={wall:.3f}s")
+        # cut-loss attribution headline (telemetry/quality.py), printed
+        # by the primary process only — same guard as the exporters
+        from .telemetry import quality as quality_mod
+
+        if telemetry.is_primary_process():
+            quality_line = quality_mod.headline()
+            if quality_line:
+                print(quality_line)
         if args.timers:
             # dist timer finalize (kaminpar-dist/timer.cc analog):
             # min/avg/max per scope across processes — on one host the

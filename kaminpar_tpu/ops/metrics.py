@@ -38,6 +38,48 @@ def edge_cut(graph: DeviceGraph, partition: jax.Array) -> jax.Array:
     return cut2 // 2
 
 
+#: Jitted twin of :func:`edge_cut` for the host-driven observability
+#: paths (telemetry/quality.py evaluates per-level projected / refined /
+#: floor cuts between launches): one compiled reduction per shape
+#: bucket, reused across levels, entirely separate from the LP / Jet /
+#: contraction programs (their jaxprs stay bitwise-identical whether
+#: the quality layer runs or not).
+edge_cut_jit = jax.jit(edge_cut)
+
+
+@jax.jit
+def coarsening_stats(
+    fine_graph: DeviceGraph, coarse_graph: DeviceGraph, cmap: jax.Array
+):
+    """Per-contraction coarsening-quality scalars (telemetry/quality.py):
+
+    returns (fine_edge_weight, coarse_edge_weight, max_cluster_size,
+    singleton_clusters, max_cluster_weight) — both edge-weight sums
+    count each undirected edge twice (pad edges carry weight 0), so
+    1 - coarse/fine is the exact internalized-edge-weight ratio; the
+    cluster-size figures come from the projection map and the coarse
+    node weights ARE the cluster weights."""
+    fine_ew = jnp.sum(fine_graph.edge_w.astype(ACC_DTYPE))
+    coarse_ew = jnp.sum(coarse_graph.edge_w.astype(ACC_DTYPE))
+    n_pad_f = cmap.shape[0]
+    n_pad_c = coarse_graph.node_w.shape[0]
+    is_real_f = jnp.arange(n_pad_f) < fine_graph.n
+    sizes = jax.ops.segment_sum(
+        is_real_f.astype(ACC_DTYPE),
+        jnp.clip(cmap, 0, n_pad_c - 1),
+        num_segments=n_pad_c,
+    )
+    is_real_c = jnp.arange(n_pad_c) < coarse_graph.n
+    max_size = jnp.max(jnp.where(is_real_c, sizes, 0))
+    singletons = jnp.sum(
+        jnp.where(is_real_c & (sizes == 1), 1, 0).astype(ACC_DTYPE)
+    )
+    max_w = jnp.max(
+        jnp.where(is_real_c, coarse_graph.node_w.astype(ACC_DTYPE), 0)
+    )
+    return fine_ew, coarse_ew, max_size, singletons, max_w
+
+
 def imbalance(graph: DeviceGraph, partition: jax.Array, k: int) -> jax.Array:
     """max_b weight(b) / ceil(total/k) - 1 (metrics.h imbalance)."""
     bw = block_weights(graph, partition, k)

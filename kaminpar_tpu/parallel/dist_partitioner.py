@@ -304,6 +304,16 @@ class dKaMinPar:
                     telemetry.annotate(
                         perf_ranks=perf_mod.rank_memory_rollup()
                     )
+                # per-rank quality rollup (quality.ranks): collective —
+                # every rank contributes its attribution headline, so
+                # the dist report shows where cut responsibility sits
+                # per rank next to the residency/wall skew
+                from ..telemetry import quality as quality_mod
+
+                if quality_mod.enabled():
+                    telemetry.annotate(
+                        quality_ranks=quality_mod.rank_rollup()
+                    )
                 if mgr is not None and mgr.enabled:
                     final_part = partition
                     ckpt_mod.barrier(
@@ -333,6 +343,34 @@ class dKaMinPar:
     # -- multilevel driver ------------------------------------------------
 
     def _partition(self, graph: HostGraph, k: int) -> np.ndarray:
+        from ..telemetry import quality as quality_mod
+
+        # quality observatory (telemetry/quality.py): the dist driver
+        # records its own hierarchy; nested shm IP runs open (and close)
+        # their own scopes below this one without corrupting it
+        qh = quality_mod.begin("dist")
+        try:
+            return self._partition_recorded(graph, k, qh)
+        finally:
+            quality_mod.end(qh)
+
+    def _quality_cut(self, dg, n: int, partition) -> Optional[int]:
+        """Sharded cut of a host partition, only when the quality layer
+        is live (collective — quality.enabled() is env+telemetry state,
+        identical on all ranks, so every rank calls or none does)."""
+        from ..telemetry import quality as quality_mod
+
+        if not quality_mod.enabled():
+            return None
+        full = np.zeros(dg.n_pad, dtype=np.int32)
+        full[: int(n)] = partition
+        return dist_edge_cut_of(dg, jnp.asarray(full))
+
+    def _partition_recorded(
+        self, graph: HostGraph, k: int, qh
+    ) -> np.ndarray:
+        from ..telemetry import quality as quality_mod
+
         ctx = self.ctx
         c_ctx = ctx.coarsening
         total_node_weight = ctx.partition.total_node_weight
@@ -388,6 +426,21 @@ class dKaMinPar:
                     break
                 coarse, cmap = contracted
                 levels.append((dg, cmap, current))
+                quality_mod.note_cmap(
+                    level=len(levels), cmap=cmap, fine_n=current.n
+                )
+                if quality_mod.enabled():
+                    # coarsening-quality stats, host-side; compressed
+                    # fine levels skip the edge-weight sum (no decode)
+                    quality_mod.note_contraction_host(
+                        level=len(levels), coarse_host=coarse, cmap=cmap,
+                        fine_n=current.n, max_cluster_weight=mcw,
+                        total_node_weight=int(total_node_weight),
+                        fine_edge_weight=(
+                            None if self._is_compressed(current)
+                            else int(current.edge_weight_array().sum())
+                        ),
+                    )
                 current = coarse
                 from ..resilience import checkpoint as ckpt
 
@@ -456,6 +509,21 @@ class dKaMinPar:
         from ..resilience import checkpoint as ckpt
 
         ckpt.barrier("dist-initial", level=len(levels), scheme="dist")
+        # quality: the coarsest level's cut — dist runs no coarsest-level
+        # refinement, so projected == refined there (both recorded so
+        # the level still gets an attribution row)
+        coarsest_cut = (
+            (self._replication_info or {}).get("cut") if replicated
+            else best_cut
+        )
+        if coarsest_cut is not None:
+            quality_mod.note_projected(
+                len(levels), cut=coarsest_cut, k=ip_k
+            )
+            quality_mod.note_refined(
+                len(levels), cut=coarsest_cut, k=ip_k,
+                spans=spans, input_k=k,
+            )
 
         # uncoarsening + distributed refinement (deep_multilevel.cc:181+):
         # project up, refine at the current k, and in DEEP mode extend the
@@ -469,6 +537,9 @@ class dKaMinPar:
             ):
                 partition = partition[cmap]  # project up
                 level = num_levels - 1 - level_idx
+                cut = self._quality_cut(dg, fine_host.n, partition)
+                if cut is not None:
+                    quality_mod.note_projected(level, cut=cut, k=current_k)
                 seed = (self.ctx.seed * 92821 + level_idx) & 0x7FFFFFFF
                 partition = self._refine_dist(
                     refiner, dg, fine_host, partition, current_k, spans,
@@ -488,6 +559,12 @@ class dKaMinPar:
                             refiner, dg, fine_host, partition, current_k,
                             spans, seed ^ (0x9E37 + current_k), level,
                         )
+                cut = self._quality_cut(dg, fine_host.n, partition)
+                if cut is not None:
+                    quality_mod.note_refined(
+                        level, cut=cut, k=current_k,
+                        spans=spans, input_k=k,
+                    )
                 part_now, k_now = partition, current_k
                 ckpt.barrier(
                     "dist-uncoarsen", level=level, scheme="dist",
@@ -523,6 +600,14 @@ class dKaMinPar:
                 k=k, epsilon=self.ctx.partition.epsilon, seed=self.ctx.seed
             )
             current_k = k
+        # quality: coarsening floors from the final partition.  A
+        # still-compressed input is not decoded just for the floors —
+        # the attribution keeps the recorded cut rows only (documented
+        # caveat, docs/observability.md).
+        if not self._is_compressed(graph):
+            quality_mod.finalize_host(qh, graph, partition)
+        elif self._plain_cache is not None and self._plain_cache[0] is graph:
+            quality_mod.finalize_host(qh, self._plain_cache[1], partition)
         return partition
 
     # -- deep-mode helpers -------------------------------------------------

@@ -314,6 +314,23 @@ class Coarsener:
                 + coarse.cmap.nbytes
             ),
         )
+        # quality observatory (telemetry/quality.py): per-level
+        # coarsening-quality metrics — internalized edge weight, cluster
+        # sizes vs the cap, weight skew.  A separate small reduction
+        # pulled host-side between launches; no-op while disabled, and
+        # the LP/contraction jaxprs above are untouched either way.
+        from ..telemetry import quality as quality_mod
+
+        quality_mod.note_contraction(
+            level=self.level,
+            fine_graph=self.levels[-1].fine_graph,
+            coarse=coarse,
+            fine_n=self.levels[-1].fine_n,
+            coarse_n=c_n,
+            coarse_m=c_m,
+            max_cluster_weight=mcw,
+            total_node_weight=self.total_node_weight,
+        )
         return True
 
     def uncoarsen(self, partition: jnp.ndarray) -> Tuple[DeviceGraph, jnp.ndarray]:
@@ -346,6 +363,15 @@ class Coarsener:
                 self.levels[-1].coarse.graph
                 if self.levels else self._input_graph
             )
+        # quality observatory: the popped contraction's projection map,
+        # host-copied here where it is already in hand (spilled levels
+        # are host-side already) — finalize composes these into the
+        # coarsening floors.  No-op while disabled.
+        from ..telemetry import quality as quality_mod
+
+        quality_mod.note_cmap(
+            level=len(self.levels) + 1, cmap=cmap, fine_n=level.fine_n
+        )
         fine_part = partition[cmap]
         self.current = fine
         self.current_n = level.fine_n

@@ -70,18 +70,33 @@ class DeepMultilevelPartitioner:
         self._spans: List[_BlockSpan] = []
 
     def partition(self, graph: HostGraph) -> np.ndarray:
+        from ..resilience import memory as memory_mod
+        from ..telemetry import quality as quality_mod
+
+        # pre-upload budget check: refuse the allocation BEFORE bytes
+        # land on the device; the facade's recovery ladder catches the
+        # structured DeviceOOM and retries at the next rung
+        memory_mod.preflight(
+            graph.n, graph.m, self.ctx.partition.k, where="deep"
+        )
+        # quality observatory (telemetry/quality.py): one hierarchy
+        # recording scope per driver run — nesting-safe, so a nested IP
+        # run inside the dist driver records its own tiny hierarchy
+        # without corrupting the outer one; no-op while disabled
+        qh = quality_mod.begin("deep")
+        try:
+            return self._partition_recorded(graph, qh)
+        finally:
+            quality_mod.end(qh)
+
+    def _partition_recorded(self, graph: HostGraph, qh) -> np.ndarray:
         ctx = self.ctx
         input_k = ctx.partition.k
         rng = rng_mod.host_rng(ctx.seed ^ 0xDEE9)
 
         from . import debug
         from ..resilience import checkpoint as ckpt
-        from ..resilience import memory as memory_mod
-
-        # pre-upload budget check: refuse the allocation BEFORE bytes
-        # land on the device; the facade's recovery ladder catches the
-        # structured DeviceOOM and retries at the next rung
-        memory_mod.preflight(graph.n, graph.m, input_k, where="deep")
+        from ..telemetry import quality as quality_mod
         with timer.scoped_timer("device-upload"):
             from ..graphs.compressed import CompressedHostGraph
 
@@ -175,6 +190,12 @@ class DeepMultilevelPartitioner:
                 padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
                 padded[: coarsest_host.n] = part_host
                 partition = jnp.asarray(padded)
+                # quality: the coarsest level's entry cut (the cut the
+                # initial partitioner handed uncoarsening)
+                quality_mod.note_projected(
+                    coarsener.level, coarsener.current, partition,
+                    k=current_k,
+                )
             num_levels = coarsener.level + 1
             ckpt.barrier(
                 "initial", level=coarsener.level, scheme="deep",
@@ -201,6 +222,10 @@ class DeepMultilevelPartitioner:
                     level,
                     num_levels,
                 )
+                quality_mod.note_refined(
+                    level, coarsener.current, partition, k=current_k,
+                    spans=spans, input_k=input_k,
+                )
                 ckpt.barrier(
                     "uncoarsen", level=level, scheme="deep",
                     payload=lambda: self._ckpt_state_payload(
@@ -213,6 +238,9 @@ class DeepMultilevelPartitioner:
                 fine_graph, partition = coarsener.uncoarsen(partition)
                 sample_device_memory()  # per-level live-HBM peak
                 level -= 1
+                quality_mod.note_projected(
+                    level, fine_graph, partition, k=current_k
+                )
                 partition, spans, current_k = self._extend_and_refine(
                     fine_graph,
                     coarsener.current_n,
@@ -222,6 +250,10 @@ class DeepMultilevelPartitioner:
                     rng,
                     level,
                     num_levels,
+                )
+                quality_mod.note_refined(
+                    level, fine_graph, partition, k=current_k,
+                    spans=spans, input_k=input_k,
                 )
                 if ctx.debug.dump_partition_hierarchy:
                     debug.dump_partition_hierarchy(
@@ -254,6 +286,9 @@ class DeepMultilevelPartitioner:
             dgraph, partition,
             np.asarray(self.ctx.partition.max_block_weights), where="deep",
         )
+        # quality: push the FINAL partition back up through the recorded
+        # cluster maps — the coarsening floors + per-level attribution
+        quality_mod.finalize_device(qh, dgraph, partition, graph.n)
         return np.asarray(partition)[: graph.n]
 
     # -- checkpoint payloads / restore (resilience/checkpoint.py) -------

@@ -43,15 +43,28 @@ class KWayMultilevelPartitioner:
         }}
 
     def partition(self, graph: HostGraph) -> np.ndarray:
+        from ..resilience import memory as memory_mod
+        from ..telemetry import quality as quality_mod
+
+        # pre-upload budget check (see deep.py): a budget the bucket
+        # cannot fit is refused before the upload, not after the OOM
+        memory_mod.preflight(
+            graph.n, graph.m, self.ctx.partition.k, where="kway"
+        )
+        # quality observatory: one hierarchy recording scope per run
+        # (telemetry/quality.py; no-op while disabled)
+        qh = quality_mod.begin("kway")
+        try:
+            return self._partition_recorded(graph, qh)
+        finally:
+            quality_mod.end(qh)
+
+    def _partition_recorded(self, graph: HostGraph, qh) -> np.ndarray:
         ctx = self.ctx
         k = ctx.partition.k
         rng = rng_mod.host_rng(ctx.seed)
         from ..resilience import checkpoint as ckpt
-        from ..resilience import memory as memory_mod
-
-        # pre-upload budget check (see deep.py): a budget the bucket
-        # cannot fit is refused before the upload, not after the OOM
-        memory_mod.preflight(graph.n, graph.m, k, where="kway")
+        from ..telemetry import quality as quality_mod
         with timer.scoped_timer("device-upload"):
             dgraph = device_graph_from_host(graph)
 
@@ -145,6 +158,10 @@ class KWayMultilevelPartitioner:
                 part_padded = np.zeros(coarsener.current.n_pad, dtype=np.int32)
                 part_padded[: coarsest_host.n] = init_part
                 partition = jnp.asarray(part_padded)
+                # quality: the coarsest level's entry cut
+                quality_mod.note_projected(
+                    coarsener.level, coarsener.current, partition, k=k
+                )
             num_levels = coarsener.level + 1
             ckpt.barrier(
                 "initial", level=coarsener.level, scheme="kway",
@@ -171,6 +188,9 @@ class KWayMultilevelPartitioner:
                     level=level,
                     num_levels=num_levels,
                 )
+                quality_mod.note_refined(
+                    level, coarsener.current, partition, k=k
+                )
                 part_now = partition
                 ckpt.barrier(
                     "uncoarsen", level=level, scheme="kway",
@@ -183,6 +203,7 @@ class KWayMultilevelPartitioner:
             while not coarsener.empty():
                 fine_graph, partition = coarsener.uncoarsen(partition)
                 level -= 1
+                quality_mod.note_projected(level, fine_graph, partition, k=k)
                 partition = refiner.refine(
                     fine_graph,
                     partition,
@@ -192,6 +213,7 @@ class KWayMultilevelPartitioner:
                     level=level,
                     num_levels=num_levels,
                 )
+                quality_mod.note_refined(level, fine_graph, partition, k=k)
                 if ctx.debug.dump_partition_hierarchy:
                     debug.dump_partition_hierarchy(
                         ctx,
@@ -213,4 +235,7 @@ class KWayMultilevelPartitioner:
             dgraph, partition, np.asarray(ctx.partition.max_block_weights),
             where="kway",
         )
+        # quality: coarsening floors + per-level attribution from the
+        # final partition (telemetry/quality.py)
+        quality_mod.finalize_device(qh, dgraph, partition, graph.n)
         return np.asarray(partition)[: graph.n]

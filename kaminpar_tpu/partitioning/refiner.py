@@ -67,8 +67,18 @@ class RefinerPipeline:
             self.k, max_block_weights, min_block_weights
         )
         # label every refiner's progress series with the uncoarsening
-        # level — the timer path repeats per level, the tag does not
-        with progress_mod.tag(level=level):
+        # level — the timer path repeats per level, the tag does not.
+        # num_levels rides along so the quality observatory's verdicts
+        # (telemetry/quality.py) can tell a coarse-level stall from a
+        # fine-level one, and the active hierarchy id keeps a nested IP
+        # run's series (same stream, same level numbering) out of the
+        # outer hierarchy's verdict join.
+        from ..telemetry import quality as quality_mod
+
+        with progress_mod.tag(
+            level=level, num_levels=num_levels,
+            quality_hierarchy=quality_mod.current_id(),
+        ):
             return self._refine_tagged(
                 graph, partition, k, max_block_weights, min_block_weights,
                 seed, level, num_levels,
@@ -89,6 +99,20 @@ class RefinerPipeline:
             # output gate keep the balance guarantee on the best
             # partition reached so far
             if deadline_mod.should_stop():
+                # the quality observatory joins this into the level's
+                # refinement-efficacy verdict: a skipped refiner is
+                # budget-capped by definition, not stalled
+                from .. import telemetry
+
+                from ..telemetry import quality as quality_mod
+
+                telemetry.event(
+                    "refine-skipped",
+                    level=level,
+                    algorithm=algorithm.value,
+                    reason="deadline",
+                    quality_hierarchy=quality_mod.current_id(),
+                )
                 log_debug(
                     f"deadline: skipping {algorithm.value} at level "
                     f"{level} (wind-down)"

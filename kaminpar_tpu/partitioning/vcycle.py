@@ -100,6 +100,22 @@ class VcycleDeepMultilevelPartitioner:
         self, graph: HostGraph, part: np.ndarray, cycle: int
     ) -> np.ndarray:
         """Community-restricted coarsen -> project down -> refine up."""
+        from ..telemetry import quality as quality_mod
+
+        # quality observatory: each cycle records its own hierarchy
+        # (last finalize wins the report section, so the FINAL cycle's
+        # attribution describes the returned partition)
+        qh = quality_mod.begin("vcycle")
+        try:
+            return self._one_vcycle_recorded(graph, part, cycle, qh)
+        finally:
+            quality_mod.end(qh)
+
+    def _one_vcycle_recorded(
+        self, graph: HostGraph, part: np.ndarray, cycle: int, qh
+    ) -> np.ndarray:
+        from ..telemetry import quality as quality_mod
+
         ctx = self.ctx
         k = ctx.partition.k
         dgraph = device_graph_from_host(graph)
@@ -154,6 +170,15 @@ class VcycleDeepMultilevelPartitioner:
             # project the partition down: clusters never span blocks
             coarse_part = coarse.project_down(current_part)
             levels.append((current, coarse, current_part))
+            quality_mod.note_cmap(
+                level=len(levels), cmap=coarse.cmap, fine_n=current_n
+            )
+            quality_mod.note_contraction(
+                level=len(levels), fine_graph=current, coarse=coarse,
+                fine_n=current_n, coarse_n=c_n, coarse_m=c_m,
+                max_cluster_weight=max_cw,
+                total_node_weight=int(ctx.partition.total_node_weight),
+            )
             current = coarse.graph
             current_part = coarse_part
             current_n = c_n
@@ -163,6 +188,7 @@ class VcycleDeepMultilevelPartitioner:
         # refine back up
         refiner = RefinerPipeline(ctx, k)
         num_levels = len(levels) + 1
+        quality_mod.note_projected(len(levels), current, current_part, k=k)
         current_part = refiner.refine(
             current,
             current_part,
@@ -172,9 +198,11 @@ class VcycleDeepMultilevelPartitioner:
             level=len(levels),
             num_levels=num_levels,
         )
+        quality_mod.note_refined(len(levels), current, current_part, k=k)
         for lvl in range(len(levels) - 1, -1, -1):
             fine_graph, coarse, _ = levels[lvl]
             current_part = coarse.project_up(current_part)
+            quality_mod.note_projected(lvl, fine_graph, current_part, k=k)
             current_part = refiner.refine(
                 fine_graph,
                 current_part,
@@ -184,9 +212,11 @@ class VcycleDeepMultilevelPartitioner:
                 level=lvl,
                 num_levels=num_levels,
             )
+            quality_mod.note_refined(lvl, fine_graph, current_part, k=k)
 
         current_part = refiner.enforce_balance_host(
             dgraph, current_part,
             np.asarray(ctx.partition.max_block_weights), where="vcycle",
         )
+        quality_mod.finalize_device(qh, dgraph, current_part, graph.n)
         return np.asarray(current_part)[: graph.n]

@@ -163,6 +163,12 @@ def reset() -> None:
         perf.reset()
     except Exception:
         pass
+    try:
+        from . import quality
+
+        quality.reset()
+    except Exception:
+        pass
 
 
 def jsonable(v: Any) -> Any:
@@ -354,13 +360,18 @@ def export_cli_outputs(args, extra_run=None, quiet: bool = False) -> int:
     if getattr(args, "report_json", None):
         from .report import write_run_report
 
-        write_run_report(args.report_json, extra_run=extra_run)
+        report = write_run_report(args.report_json, extra_run=extra_run)
         if not quiet and primary:
             print(f"REPORT written to {args.report_json}")
             print(
                 "  triage: python -m kaminpar_tpu.telemetry.top "
                 f"{args.report_json}"
             )
+            if (report.get("quality") or {}).get("levels"):
+                print(
+                    "  quality: python -m kaminpar_tpu.telemetry.quality "
+                    f"{args.report_json}"
+                )
     if getattr(args, "diff_base", None):
         if not getattr(args, "report_json", None):
             import sys

@@ -157,6 +157,36 @@ def chrome_trace() -> dict:
                     "args": e.get("attrs", {}),
                 }
             )
+            if e["name"] == "quality-level":
+                # per-level cut-loss attribution renders as counter
+                # tracks (telemetry/quality.py): the projected / refined
+                # / floor cut curve and the locked/left split per level
+                attrs = e.get("attrs", {})
+                cuts = {
+                    key: attrs[key]
+                    for key in ("projected_cut", "refined_cut",
+                                "floor_cut")
+                    if attrs.get(key) is not None
+                }
+                split = {
+                    key: attrs[key]
+                    for key in ("coarsening_locked", "refinement_left")
+                    if attrs.get(key) is not None
+                }
+                for name, counters in (("quality.cut", cuts),
+                                       ("quality.attribution", split)):
+                    if counters:
+                        trace_events.append(
+                            {
+                                "ph": "C",
+                                "cat": "quality",
+                                "name": name,
+                                "ts": round(e["t"] * 1e6, 3),
+                                "pid": pid,
+                                "tid": 0,
+                                "args": counters,
+                            }
+                        )
             if e["name"] == "perf-memory":
                 # barrier memory watermarks render as a counter track
                 # (telemetry/perf.py samples; one curve per byte figure)

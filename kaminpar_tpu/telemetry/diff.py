@@ -28,6 +28,8 @@ Informational (printed, never gated):
   * per-request verdict transitions (serving requests aligned by id)
   * roofline totals deltas (schema v5 `perf` section: bytes, hbm_util,
     pad waste)
+  * quality attribution deltas (schema v7 `quality` section: per-level
+    coarsening_locked / refinement_left movement and verdict flips)
 
 Exit codes: 0 pass, 1 regression, 2 usage/IO error.  check_all.sh runs
 the self-diff (identical reports, expect 0) and a perturbed diff
@@ -213,6 +215,62 @@ def diff_serving(
     return lines, failures
 
 
+def diff_quality(base: dict, cand: dict) -> Tuple[List[str], List[str]]:
+    """Quality-section diff (schema v7): align levels by level index,
+    report per-level locked/left deltas and verdict flips, plus the
+    headline fraction movement.  Informational — the cut gate above is
+    the pass/fail signal; attribution tells you WHERE it moved.  Both
+    reports must carry an enabled quality section (a pre-v7 baseline is
+    a schema transition, not a regression)."""
+    qb = base.get("quality") or {}
+    qc = cand.get("quality") or {}
+    lines: List[str] = []
+    failures: List[str] = []
+    if not (qb.get("enabled") and qc.get("enabled")):
+        if qb.get("enabled") != qc.get("enabled"):
+            lines.append(
+                "quality: only "
+                + ("base" if qb.get("enabled") else "cand")
+                + " carries a quality section (not compared)"
+            )
+        return lines, failures
+
+    tb = qb.get("totals") or {}
+    tc = qc.get("totals") or {}
+    lines.append(
+        "quality: gap_mass {} -> {}, coarsening_locked_frac {} -> {}, "
+        "refinement_left_frac {} -> {}".format(
+            tb.get("gap_mass"), tc.get("gap_mass"),
+            tb.get("coarsening_locked_frac"),
+            tc.get("coarsening_locked_frac"),
+            tb.get("refinement_left_frac"),
+            tc.get("refinement_left_frac"),
+        )
+    )
+    lb = {row.get("level"): row for row in qb.get("levels") or []}
+    lc = {row.get("level"): row for row in qc.get("levels") or []}
+    for level in sorted(set(lb) & set(lc)):
+        rb_, rc_ = lb[level], lc[level]
+        bits = []
+        for key, label in (("coarsening_locked", "locked"),
+                           ("refinement_left", "left")):
+            vb, vc = rb_.get(key), rc_.get(key)
+            if vb is not None and vc is not None and vb != vc:
+                bits.append(f"{label} {vb} -> {vc}")
+        vb, vc = rb_.get("verdict"), rc_.get("verdict")
+        if vb is not None and vc is not None and vb != vc:
+            bits.append(f"verdict {vb} -> {vc}")
+        if bits:
+            lines.append(f"  quality level {level}: " + ", ".join(bits))
+    only_b = sorted(set(lb) - set(lc))
+    only_c = sorted(set(lc) - set(lb))
+    if only_b:
+        lines.append(f"  quality levels only in base: {only_b[:5]}")
+    if only_c:
+        lines.append(f"  quality levels only in cand: {only_c[:5]}")
+    return lines, failures
+
+
 def diff_reports(
     base: dict,
     cand: dict,
@@ -328,6 +386,11 @@ def diff_reports(
     )
     lines.extend(s_lines)
     failures.extend(s_failures)
+
+    # -- quality attribution (schema v7; informational) ------------------
+    q_lines, q_failures = diff_quality(base, cand)
+    lines.extend(q_lines)
+    failures.extend(q_failures)
 
     # -- perf roofline totals (schema v5; informational) -----------------
     pb = (base.get("perf") or {}).get("totals") or {}

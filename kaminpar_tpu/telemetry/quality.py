@@ -1,0 +1,980 @@
+"""Quality observatory: hierarchical cut-loss attribution, per-level
+coarsening-quality metrics, and refinement-efficacy verdicts.
+
+PR 7's perf observatory answers "where do the seconds and bytes go";
+this layer answers ROADMAP item 1's prior question: **which hierarchy
+level is responsible for the lost cut** — is the damage locked in by
+coarsening (clusters that internalize too little edge weight, the
+failure mode size-constrained clustering addresses, arXiv 1402.3281) or
+left on the table by refinement that stalls at coarse levels.  Three
+concerns, one module:
+
+  * **cut-loss attribution** — during uncoarsening the drivers record
+    the projected-in cut and the post-refinement cut per level, and the
+    coarsener records each level's fine->coarse cluster map.  At the
+    end of the run `finalize_*` pushes the FINAL fine partition back up
+    through the recorded cluster maps (weighted-majority block per
+    cluster) and evaluates the **coarsening floor** per level: the cut
+    of the best cluster-constant approximation of the final partition —
+    i.e. the best cut level L could have reached given the contraction
+    decisions.  Each level's total gap vs the level-0 lower bound (the
+    final cut itself) then splits EXACTLY into
+
+        coarsening_locked(L) = floor_cut(L)    - final_cut
+        refinement_left(L)   = refined_cut(L)  - floor_cut(L)
+        gap(L)               = refined_cut(L)  - final_cut
+                             = coarsening_locked(L) + refinement_left(L)
+
+    A level with a high locked fraction had its structure destroyed by
+    coarsening before refinement ever saw it; a high left fraction
+    means the level could express a much better partition and the
+    refiners stalled (tests/test_quality.py pins the sum invariant and
+    the floor math against a brute-force recompute).
+  * **coarsening-quality metrics** — per contraction: internalized
+    edge-weight ratio, cluster-size distribution vs the size constraint
+    (max/mean/singleton fraction), and weight skew / cap utilization,
+    from one small device reduction per level (ops/metrics.
+    coarsening_stats) pulled host-side between launches.
+  * **refinement-efficacy verdicts** — at snapshot time the PR-4
+    progress series (LP/Jet/FM/balancer, tagged with the uncoarsening
+    level) are joined into per-level ``converged | stalled |
+    budget-capped`` verdicts with realized-vs-remaining gain mass,
+    plus any deadline `refine-skipped` events.
+
+Instrumentation contract (pinned by tests/test_quality.py's
+jaxpr-equality test): every hook is host-side driver code between
+device launches — cluster-map pulls at uncoarsening pops, cut
+evaluations through the separately-jitted ``ops.metrics.edge_cut_jit``,
+stats through ``ops.metrics.coarsening_stats`` — NEVER inside the
+LP/Jet/contraction programs, so their jaxprs are bitwise-identical
+whether the layer is on, off (``KAMINPAR_TPU_QUALITY=0``), or telemetry
+is disabled entirely.  Host readbacks live in this module's helpers,
+outside the drivers' timer-span blocks (the tpulint R1 hook shape,
+tests/lint_fixtures/r1_quality_*.py).
+
+Caveats (stamped on the section): the floor is relative to the RUN'S
+OWN final partition, not a true optimum — it bounds what refinement at
+a level could have recovered *of the result actually reached*; the
+level-0 row is the identity push (floor == final cut, locked == 0).
+The surface: run-report ``quality`` section (schema v7), the triage CLI
+``python -m kaminpar_tpu.telemetry.quality REPORT [--diff BASE]``,
+Chrome-trace counter tracks, and the BENCH keys
+``coarsening_locked_frac`` / ``refinement_left_frac``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+ENV_VAR = "KAMINPAR_TPU_QUALITY"
+
+CAVEAT = (
+    "floors are measured against the run's own final partition pushed "
+    "back up through the recorded cluster maps (weighted-majority block "
+    "per cluster) — they bound what refinement at a level could have "
+    "recovered of the result actually reached, not a true optimum; "
+    "disable with KAMINPAR_TPU_QUALITY=0"
+)
+
+#: Keys a level row carries when the attribution pass completed for it.
+ATTRIBUTION_KEYS = ("floor_cut", "refined_cut", "coarsening_locked",
+                    "refinement_left", "gap")
+
+_lock = threading.Lock()
+#: the last finalized (or partially recorded) hierarchy's section —
+#: report.py snapshots it; "last wins" so a v-cycle's final cycle (and
+#: an outer run after its nested IP runs) owns the report section.
+#: Stored with the hierarchy's id so the verdict join only picks up
+#: progress series tagged by THIS hierarchy's refiners.
+_last: Optional[dict] = None
+_last_hid: Optional[int] = None
+_next_hid = 0
+
+_tls = threading.local()  # .stack: list of _Hierarchy (nesting-safe)
+
+
+def enabled() -> bool:
+    """True iff telemetry is on and KAMINPAR_TPU_QUALITY is not 0 — the
+    one gate every hook checks before doing any work."""
+    if os.environ.get(ENV_VAR, "") == "0":
+        return False
+    from . import enabled as _telemetry_enabled
+
+    return _telemetry_enabled()
+
+
+def reset() -> None:
+    """Clear the module state (called by telemetry.reset at run start);
+    a stack left behind by an exceptional unwind is dropped too."""
+    global _last, _last_hid
+    with _lock:
+        _last = None
+        _last_hid = None
+    _tls.stack = []
+
+
+def _stack() -> List["_Hierarchy"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _top() -> Optional["_Hierarchy"]:
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def current_id() -> Optional[int]:
+    """The active hierarchy's id (None outside a recording scope).  The
+    refiner tags its progress series and refine-skipped events with it,
+    so the verdict join can tell THIS hierarchy's series apart from a
+    nested IP run's or an earlier v-cycle's — they share one telemetry
+    stream and one level numbering."""
+    h = _top()
+    return h.hid if h is not None else None
+
+
+class _Hierarchy:
+    """One multilevel hierarchy's recorded state (per driver run or
+    v-cycle; nesting-safe via the thread-local stack)."""
+
+    def __init__(self, scheme: str):
+        global _next_hid
+        with _lock:
+            _next_hid += 1
+            self.hid = _next_hid
+        self.scheme = scheme
+        # contraction level L (>= 1) -> i32[fine_n(G_{L-1})] cluster map
+        # into G_L's coarse ids (host copies, recorded at uncoarsen pops)
+        self.cmaps: Dict[int, np.ndarray] = {}
+        # level (graph index; 0 = input) -> recorded per-level fields
+        self.levels: Dict[int, Dict[str, Any]] = {}
+        self.final_cut: Optional[int] = None
+        self.finalized = False
+
+
+# ---------------------------------------------------------------------------
+# recording hooks (drivers + coarsener; all no-ops while disabled)
+# ---------------------------------------------------------------------------
+
+
+def begin(scheme: str) -> Optional[_Hierarchy]:
+    """Open a hierarchy recording scope.  Returns None (and records
+    nothing) while the layer is disabled; `end()` accepts either."""
+    if not enabled():
+        return None
+    h = _Hierarchy(scheme)
+    _stack().append(h)
+    return h
+
+
+def end(handle: Optional[_Hierarchy]) -> None:
+    """Close a hierarchy scope (always call from a finally).  A
+    hierarchy that recorded data but never finalized (interrupted run)
+    still publishes its partial section — cuts and coarsening stats
+    without floors."""
+    if handle is None:
+        return
+    stack = _stack()
+    if handle in stack:
+        # drop this handle and anything a crashed nested run left above
+        del stack[stack.index(handle):]
+    if not handle.finalized and (handle.levels or handle.cmaps):
+        _publish(handle)
+
+
+def _level_entry(h: _Hierarchy, level: int) -> Dict[str, Any]:
+    return h.levels.setdefault(int(level), {"level": int(level)})
+
+
+def note_cmap(level: int, cmap, fine_n: int) -> None:
+    """Record contraction `level`'s fine->coarse cluster map (the map
+    INTO graph G_level); one host pull of fine_n ints, at the
+    uncoarsening pop where the map is already in hand (or already
+    host-side for a spilled level)."""
+    h = _top()
+    if h is None:
+        return
+    h.cmaps[int(level)] = np.asarray(cmap)[: int(fine_n)].astype(np.int64)
+
+
+def note_contraction(
+    level: int,
+    fine_graph,
+    coarse,
+    fine_n: int,
+    coarse_n: int,
+    coarse_m: int,
+    max_cluster_weight,
+    total_node_weight: int,
+) -> None:
+    """Record one contraction's coarsening-quality metrics (`level` is
+    the coarse graph's index).  One small device reduction
+    (ops/metrics.coarsening_stats) pulled host-side between launches —
+    the existing kernels' jaxprs are untouched."""
+    h = _top()
+    if h is None:
+        return
+    from ..ops import metrics
+
+    fine_ew, coarse_ew, max_size, singletons, max_w = (
+        int(x) for x in metrics.coarsening_stats(
+            fine_graph, coarse.graph, coarse.cmap
+        )
+    )
+    _note_coarsening(
+        h, level, fine_n, coarse_n, coarse_m, fine_ew, coarse_ew,
+        max_size, singletons, max_w, int(max_cluster_weight),
+        total_node_weight,
+    )
+
+
+def note_contraction_host(
+    level: int,
+    coarse_host,
+    cmap,
+    fine_n: int,
+    max_cluster_weight,
+    total_node_weight: int,
+    fine_edge_weight: Optional[int] = None,
+) -> None:
+    """Host-CSR twin of :func:`note_contraction` (the dist driver keeps
+    its hierarchy host-side).  `fine_edge_weight` may be None when the
+    fine level is still compressed — the internalized ratio is then
+    omitted rather than forcing a decode."""
+    h = _top()
+    if h is None:
+        return
+    cm = np.asarray(cmap)[: int(fine_n)]
+    coarse_n = max(int(coarse_host.n), 1)
+    sizes = np.bincount(cm, minlength=coarse_n)
+    nw = coarse_host.node_weight_array()
+    _note_coarsening(
+        h, level, fine_n, coarse_n, int(coarse_host.m),
+        int(fine_edge_weight) if fine_edge_weight else 0,
+        int(coarse_host.edge_weight_array().sum())
+        if fine_edge_weight else 0,
+        int(sizes.max(initial=0)), int((sizes == 1).sum()),
+        int(nw.max(initial=0)), int(max_cluster_weight),
+        total_node_weight,
+    )
+
+
+def _note_coarsening(
+    h: _Hierarchy, level: int, fine_n: int, coarse_n: int, coarse_m: int,
+    fine_ew: int, coarse_ew: int, max_size: int, singletons: int,
+    max_w: int, mcw: int, total_node_weight: int,
+) -> None:
+    coarse_n = max(int(coarse_n), 1)
+    mean_w = max(total_node_weight, 1) / coarse_n
+    stats = {
+        # fraction of the fine level's edge weight the clustering
+        # internalized (1 - coarse/fine; both sums count each
+        # undirected edge twice, so the ratio is exact)
+        "internal_ew_ratio": (
+            round(1.0 - coarse_ew / fine_ew, 4) if fine_ew > 0 else None
+        ),
+        "max_cluster_size": max_size,
+        "mean_cluster_size": round(int(fine_n) / coarse_n, 2),
+        "singleton_frac": round(singletons / coarse_n, 4),
+        "max_cluster_weight": max_w,
+        "weight_skew": round(max_w / max(mean_w, 1e-9), 2),
+        "cap_utilization": round(max_w / max(mcw, 1), 4),
+        "mcw": mcw,
+    }
+    entry = _level_entry(h, level)
+    entry["fine_n"] = int(fine_n)
+    entry["coarse_n"] = int(coarse_n)
+    entry["coarse_m"] = int(coarse_m)
+    entry["coarsening"] = stats
+    from . import event
+
+    event("coarsening-quality", level=int(level), **stats)
+
+
+def _cut_device(graph, partition) -> int:
+    from ..ops import metrics
+
+    return int(metrics.edge_cut_jit(graph, partition))
+
+
+def note_projected(level: int, graph=None, partition=None,
+                   cut: Optional[int] = None,
+                   k: Optional[int] = None) -> None:
+    """Record the projected-in cut at `level` (right after projecting
+    the coarser partition up, before any refinement there).  Pass a
+    precomputed `cut` (the dist driver) or a device graph+partition;
+    `k` is the block count the cut was measured at (deep mode doubles k
+    during uncoarsening, so coarse-level cuts live at a smaller k)."""
+    h = _top()
+    if h is None:
+        return
+    if cut is None:
+        cut = _cut_device(graph, partition)
+    entry = _level_entry(h, level)
+    entry["projected_cut"] = int(cut)
+    if k is not None:
+        entry["projected_k"] = int(k)
+
+
+def note_refined(level: int, graph=None, partition=None,
+                 cut: Optional[int] = None, k: Optional[int] = None,
+                 spans=None, input_k: Optional[int] = None) -> None:
+    """Record the post-refinement cut at `level` (after the level's
+    refinement — and, in deep mode, its k-doubling extensions).
+
+    `k` is the block count the level ran at.  When it differs from the
+    final k, the driver's span bookkeeping (`spans` + `input_k`) yields
+    a final-block -> level-block map that lets finalize measure this
+    level's lower bound at the level's OWN k — the final partition
+    mapped down in k — so the locked/left split stays coherent across
+    deep mode's k-doubling.  The map is built HERE, after the disabled
+    early-return, so dormant runs do no span work."""
+    h = _top()
+    if h is None:
+        return
+    if cut is None:
+        cut = _cut_device(graph, partition)
+    entry = _level_entry(h, level)
+    entry["refined_cut"] = int(cut)
+    if k is not None:
+        entry["k_at_level"] = int(k)
+    if spans is not None and input_k:
+        bm = block_map_from_spans(spans, input_k)
+        if bm is not None:
+            entry["_block_map"] = bm
+
+
+# ---------------------------------------------------------------------------
+# finalize: the coarsening floors + per-level attribution
+# ---------------------------------------------------------------------------
+
+
+def block_map_from_spans(spans, input_k: int) -> Optional[np.ndarray]:
+    """final block -> current block from a driver's span bookkeeping
+    (one shared implementation for the shm deep and dist drivers —
+    this mapping is what keeps small-k levels' bounds coherent under
+    k-doubling).  Accepts _BlockSpan-like objects (`.first`/`.count`)
+    or (first, count) tuples; None when the level already runs at the
+    final k."""
+    if len(spans) == int(input_k):
+        return None
+    bm = np.zeros(int(input_k), dtype=np.int32)
+    for b, span in enumerate(spans):
+        first, count = (
+            (span.first, span.count) if hasattr(span, "first") else span
+        )
+        bm[first: first + count] = b
+    return bm
+
+
+def weighted_majority(phi: np.ndarray, part: np.ndarray,
+                      node_w: np.ndarray, coarse_n: int) -> np.ndarray:
+    """Per-cluster weighted-majority block: Q[c] = the block holding the
+    most node weight among fine nodes with phi == c (ties broken toward
+    the smaller block id; clusters with no nodes get block 0).  Pure
+    numpy, sort-based — no (coarse_n x k) dense table, so huge-k runs
+    stay bounded."""
+    phi = np.asarray(phi, dtype=np.int64)
+    part = np.asarray(part, dtype=np.int64)
+    w = np.asarray(node_w, dtype=np.int64)
+    k = int(part.max(initial=0)) + 1
+    key = phi * k + part
+    order = np.argsort(key, kind="stable")
+    sk = key[order]
+    sw = w[order]
+    if sk.size == 0:
+        return np.zeros(coarse_n, dtype=np.int32)
+    starts = np.flatnonzero(np.concatenate([[True], sk[1:] != sk[:-1]]))
+    sums = np.add.reduceat(sw, starts)
+    uk = sk[starts]
+    cluster = uk // k
+    block = uk % k
+    # per-cluster argmax with smallest-block tie-break: sort by
+    # (cluster, -weight, block) and keep each cluster's first row
+    sel = np.lexsort((block, -sums, cluster))
+    cl = cluster[sel]
+    first = np.concatenate([[True], cl[1:] != cl[:-1]])
+    out = np.zeros(coarse_n, dtype=np.int32)
+    out[cl[first]] = block[sel][first].astype(np.int32)
+    return out
+
+
+def _finalize(h: _Hierarchy, part: np.ndarray, node_w: np.ndarray,
+              cut_of: Callable[[np.ndarray], int]) -> None:
+    """Compute every level's coarsening floor by pushing the final
+    partition up through the recorded cluster maps, then split each
+    level's gap into locked vs left (module docstring identity).
+
+    Each level's lower bound is the final partition mapped to the
+    level's OWN k (identity when the level ran at the final k; via the
+    recorded span block-map under deep's k-doubling), so the identity
+
+        gap(L) = refined_cut(L) - bound_cut(L)
+               = coarsening_locked(L) + refinement_left(L)
+
+    holds exactly at every level regardless of where the k-doubling
+    schedule stood when the level refined."""
+    final_cut = cut_of(part.astype(np.int32))
+    h.final_cut = int(final_cut)
+    final_k = int(part.max(initial=0)) + 1
+    # level 0 is the identity push: floor == bound == final cut,
+    # locked == 0 — "the level-0 lower bound".  Its left/gap only make
+    # sense when the recorded cut was measured at the final k (the dist
+    # tiny-graph fallback re-partitions at full k AFTER the level-0
+    # note, leaving a stale smaller-k cut behind).
+    ent0 = _level_entry(h, 0)
+    ent0["floor_cut"] = int(final_cut)
+    ent0["bound_cut"] = int(final_cut)
+    ent0["coarsening_locked"] = 0
+    k0 = ent0.get("k_at_level")
+    if "refined_cut" in ent0 and (k0 is None or k0 >= final_k):
+        ent0["refinement_left"] = int(ent0["refined_cut"]) - int(final_cut)
+        ent0["gap"] = ent0["refinement_left"]
+    from . import event
+
+    # the final partition mapped down in k, memoized per distinct
+    # block-map (deep runs share one map across its small-k levels)
+    bound_cache: Dict[int, int] = {}
+    phi = np.arange(part.shape[0], dtype=np.int64)
+    for level in sorted(h.cmaps):
+        cmap = h.cmaps[level]
+        if phi.size and int(phi.max()) >= cmap.shape[0]:
+            # inconsistent recording (a level restored outside this
+            # hierarchy's scope) — stop composing rather than mis-index
+            break
+        phi = cmap[phi]
+        entry = _level_entry(h, level)
+        bm = entry.pop("_block_map", None)
+        if bm is None:
+            base_part = part
+            bound = int(final_cut)
+        else:
+            base_part = bm[np.clip(part, 0, bm.shape[0] - 1)]
+            key = hash(bm.tobytes())
+            if key not in bound_cache:
+                bound_cache[key] = cut_of(base_part.astype(np.int32))
+            bound = bound_cache[key]
+        coarse_n = int(phi.max(initial=-1)) + 1
+        q = weighted_majority(phi, base_part, node_w, max(coarse_n, 1))
+        floor = cut_of(q[phi].astype(np.int32))
+        entry["floor_cut"] = int(floor)
+        entry["bound_cut"] = int(bound)
+        entry["coarsening_locked"] = int(floor) - int(bound)
+        if "refined_cut" in entry:
+            entry["refinement_left"] = int(entry["refined_cut"]) - int(floor)
+            entry["gap"] = int(entry["refined_cut"]) - int(bound)
+        event(
+            "quality-level",
+            level=int(level),
+            floor_cut=int(floor),
+            bound_cut=int(bound),
+            projected_cut=entry.get("projected_cut"),
+            refined_cut=entry.get("refined_cut"),
+            coarsening_locked=entry.get("coarsening_locked"),
+            refinement_left=entry.get("refinement_left"),
+            k_at_level=entry.get("k_at_level"),
+        )
+    h.finalized = True
+    _publish(h)
+
+
+def finalize_device(handle: Optional[_Hierarchy], dgraph, partition,
+                    n: int) -> None:
+    """Finalize against a device input graph: floors are evaluated by
+    uploading each pushed partition into the input pad bucket and
+    running the separately-jitted edge-cut reduction (one executable,
+    reused per level)."""
+    if handle is None or not enabled():
+        return
+    import jax.numpy as jnp
+
+    n = int(n)
+    part = np.asarray(partition)[:n]
+    node_w = np.asarray(dgraph.node_w)[:n]
+    n_pad = dgraph.n_pad
+
+    def cut_of(p_real: np.ndarray) -> int:
+        full = np.zeros(n_pad, dtype=np.int32)
+        full[:n] = p_real
+        return _cut_device(dgraph, jnp.asarray(full))
+
+    _finalize(handle, part, node_w, cut_of)
+
+
+def finalize_host(handle: Optional[_Hierarchy], host_graph,
+                  partition) -> None:
+    """Finalize against a host CSR (the dist driver and tests): floors
+    are plain numpy cut sweeps over the input adjacency."""
+    if handle is None or not enabled():
+        return
+    part = np.asarray(partition)[: host_graph.n]
+    node_w = host_graph.node_weight_array()
+    src = host_graph.edge_sources()
+    adj = host_graph.adjncy
+    ew = host_graph.edge_weight_array()
+
+    def cut_of(p_real: np.ndarray) -> int:
+        return int(ew[p_real[src] != p_real[adj]].sum() // 2)
+
+    _finalize(handle, part, node_w, cut_of)
+
+
+def _publish(h: _Hierarchy) -> None:
+    global _last, _last_hid
+    section = _assemble(h)
+    with _lock:
+        _last = section
+        _last_hid = h.hid
+
+
+def _assemble(h: _Hierarchy) -> dict:
+    levels = [
+        {key: v for key, v in h.levels[lv].items()
+         if not key.startswith("_")}
+        for lv in sorted(h.levels)
+    ]
+    attributed = [
+        row for row in levels
+        if row.get("gap") is not None and row["level"] > 0
+    ]
+    gap_mass = sum(int(row["gap"]) for row in attributed)
+    locked_mass = sum(int(row["coarsening_locked"]) for row in attributed)
+    left_mass = sum(int(row["refinement_left"]) for row in attributed)
+    # headline fractions over the POSITIVE components: a level whose
+    # floor undercuts its bound (majority rounding traded balance for
+    # cut) carries negative locked mass — real, kept in the raw masses
+    # and per-level rows, but the two headline fractions stay in [0, 1]
+    # and sum to 1 so bench_trend can plot them round-over-round
+    locked_pos = sum(
+        max(int(row["coarsening_locked"]), 0) for row in attributed
+    )
+    left_pos = sum(
+        max(int(row["refinement_left"]), 0) for row in attributed
+    )
+    pos_mass = locked_pos + left_pos
+    worst = max(attributed, key=lambda r: r["gap"], default=None)
+    totals: Dict[str, Any] = {
+        "attribution_rows": len(attributed),
+        "gap_mass": gap_mass,
+        "locked_mass": locked_mass,
+        "left_mass": left_mass,
+        "coarsening_locked_frac": (
+            round(locked_pos / pos_mass, 4) if pos_mass > 0 else None
+        ),
+        "refinement_left_frac": (
+            round(left_pos / pos_mass, 4) if pos_mass > 0 else None
+        ),
+        "worst_level": worst["level"] if worst is not None else None,
+    }
+    return {
+        "enabled": True,
+        "caveat": CAVEAT,
+        "scheme": h.scheme,
+        "finalized": h.finalized,
+        "final_cut": h.final_cut,
+        "levels": levels,
+        "totals": totals,
+    }
+
+
+# ---------------------------------------------------------------------------
+# refinement-efficacy verdicts (joined from the PR-4 progress series)
+# ---------------------------------------------------------------------------
+
+
+def classify_series(series: Dict[str, list]) -> Dict[str, Any]:
+    """One progress series -> {verdict, realized, remaining}.
+
+    ``converged``    — the loop self-terminated with nothing left to do
+                       (moved reached 0 / the last FM pass gained <= 0).
+    ``budget-capped`` — the loop was still making progress when its
+                       iteration budget (or a deadline) stopped it.
+    ``stalled``      — movement without cut progress: the loop ended
+                       with nodes still wanting to move but the tail of
+                       the series gained nothing.
+
+    Gain mass: `realized` is the improvement the series achieved (cut
+    delta for Jet, committed gain for FM, total moves for LP/balancer);
+    `remaining` is the final iteration's residual movement/gain — the
+    mass a deeper schedule could still chase.  Deterministic, pinned by
+    tests/test_quality.py's unit table."""
+    moved = [int(v) for v in (series.get("moved") or [])]
+    cut = [int(v) for v in (series.get("cut") or [])]
+    gain = [int(v) for v in (series.get("gain") or [])]
+    if cut:
+        realized = max(cut[0] - min(cut), 0)
+        remaining = moved[-1] if moved else 0
+        if moved and moved[-1] == 0:
+            verdict = "converged"
+        else:
+            tail_n = max(1, len(cut) // 3)
+            head_min = min(cut[:-tail_n]) if len(cut) > tail_n else cut[0]
+            tail_gain = max(head_min - min(cut[-tail_n:]), 0)
+            verdict = "budget-capped" if tail_gain > 0 else "stalled"
+        return {"verdict": verdict, "realized": realized,
+                "remaining": remaining}
+    if gain:  # FM: per-pass committed gain (terminates on gain <= 0)
+        realized = sum(g for g in gain if g > 0)
+        remaining = max(gain[-1], 0)
+        verdict = "converged" if gain[-1] <= 0 else "budget-capped"
+        return {"verdict": verdict, "realized": realized,
+                "remaining": remaining}
+    if moved:
+        realized = sum(moved)
+        remaining = moved[-1]
+        if moved[-1] == 0:
+            verdict = "converged"
+        elif moved[-1] >= 0.25 * max(moved):
+            # exited while still moving in bulk: the iteration budget
+            # (not convergence) ended the loop
+            verdict = "budget-capped"
+        else:
+            verdict = "stalled"
+        return {"verdict": verdict, "realized": realized,
+                "remaining": remaining}
+    return {"verdict": "converged", "realized": 0, "remaining": 0}
+
+
+#: level verdict = the worst of its series verdicts, in this order
+_VERDICT_SEVERITY = {"converged": 0, "stalled": 1, "budget-capped": 2}
+
+
+def _verdicts_by_level(hid: Optional[int]) -> Dict[int, List[dict]]:
+    """Refinement-side progress series grouped by uncoarsening level,
+    each classified; plus deadline `refine-skipped` events (a skipped
+    refiner is budget-capped by definition).
+
+    Series carrying a `quality_hierarchy` tag (the shm RefinerPipeline
+    stamps `current_id()`) join only when it matches the published
+    hierarchy's id — nested IP runs and earlier v-cycle cycles share
+    the telemetry stream AND the level numbering, so an id mismatch
+    would flip a converged level to budget-capped with someone else's
+    series.  Untagged series (the dist refiners) join unconditionally."""
+    from . import events as _events
+    from . import progress_series as _progress_series
+
+    out: Dict[int, List[dict]] = {}
+    for entry in _progress_series():
+        attrs = entry.attrs or {}
+        if attrs.get("phase") == "cluster":
+            continue  # coarsening LP: not a refinement series
+        level = attrs.get("level")
+        if level is None:
+            continue
+        tag = attrs.get("quality_hierarchy")
+        if tag is not None and hid is not None and tag != hid:
+            continue
+        v = classify_series(entry.series)
+        v["kind"] = entry.kind
+        if attrs.get("round") is not None:
+            v["round"] = attrs["round"]
+        out.setdefault(int(level), []).append(v)
+    for e in _events("refine-skipped"):
+        level = e.attrs.get("level")
+        if level is None:
+            continue
+        tag = e.attrs.get("quality_hierarchy")
+        if tag is not None and hid is not None and tag != hid:
+            continue
+        out.setdefault(int(level), []).append({
+            "verdict": "budget-capped",
+            "kind": e.attrs.get("algorithm", "refiner"),
+            "realized": 0,
+            "remaining": None,
+            "skipped": True,
+        })
+    return out
+
+
+def level_verdict(verdicts: List[dict]) -> Optional[str]:
+    if not verdicts:
+        return None
+    return max(
+        (v["verdict"] for v in verdicts),
+        key=lambda s: _VERDICT_SEVERITY.get(s, 0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# snapshot: the run report's `quality` section
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """The report section: the last published hierarchy with the
+    refinement-efficacy verdicts joined in (verdicts come from the live
+    progress stream, so they are computed at report-build time)."""
+    with _lock:
+        section = None if _last is None else dict(_last)
+        hid = _last_hid
+    if section is None:
+        return {"enabled": False}
+    verdicts = _verdicts_by_level(hid)
+    levels = []
+    for row in section["levels"]:
+        row = dict(row)
+        vs = verdicts.get(int(row["level"]))
+        if vs:
+            row["verdicts"] = vs
+            row["verdict"] = level_verdict(vs)
+        levels.append(row)
+    section["levels"] = levels
+    return section
+
+
+def headline() -> Optional[str]:
+    """One-line CLI summary (None when nothing was recorded) — the
+    QUALITY line both CLIs print next to RESULT."""
+    section = snapshot()
+    if not section.get("enabled"):
+        return None
+    totals = section.get("totals") or {}
+    if not totals.get("attribution_rows"):
+        return None
+    parts = [
+        f"levels={totals['attribution_rows']}",
+        f"gap_mass={totals.get('gap_mass')}",
+        f"coarsening_locked_frac={totals.get('coarsening_locked_frac')}",
+        f"refinement_left_frac={totals.get('refinement_left_frac')}",
+    ]
+    if totals.get("worst_level") is not None:
+        parts.append(f"worst=level{totals['worst_level']}")
+    return "QUALITY " + " ".join(parts)
+
+
+def rank_rollup() -> List[dict]:
+    """Per-process attribution headline ([{rank, gap_mass, locked_mass,
+    left_mass}]) — collective on multi-host runs (allgather, same
+    contract as perf.rank_memory_rollup); the dist driver stamps it
+    into the report (`quality.ranks`)."""
+    with _lock:
+        section = _last
+    totals = (section or {}).get("totals") or {}
+    local = [
+        int(totals.get("gap_mass") or 0),
+        int(totals.get("locked_mass") or 0),
+        int(totals.get("left_mass") or 0),
+    ]
+    try:
+        from ..utils.platform import process_count, process_index
+
+        nproc = process_count()
+        rank = process_index()
+    except Exception:
+        nproc, rank = 1, 0
+    rows = [{"rank": int(rank), "gap_mass": local[0],
+             "locked_mass": local[1], "left_mass": local[2]}]
+    if nproc <= 1:
+        return rows
+    from jax.experimental import multihost_utils
+
+    gathered = np.asarray(
+        multihost_utils.process_allgather(
+            np.asarray(local, dtype=np.int64)
+        )
+    ).reshape(nproc, 3)
+    return [
+        {"rank": p, "gap_mass": int(gathered[p][0]),
+         "locked_mass": int(gathered[p][1]),
+         "left_mass": int(gathered[p][2])}
+        for p in range(nproc)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# triage CLI: python -m kaminpar_tpu.telemetry.quality REPORT [--diff BASE]
+# ---------------------------------------------------------------------------
+
+
+def attribution_rows(report: dict) -> List[dict]:
+    """Level rows carrying a complete attribution split (floor +
+    refined + the locked/left components)."""
+    section = report.get("quality") or {}
+    return [
+        row for row in section.get("levels") or []
+        if all(row.get(key) is not None for key in ATTRIBUTION_KEYS)
+        and row.get("level", 0) > 0
+    ]
+
+
+# one table renderer per package: telemetry/top.py owns it
+from .top import _fmt, _table  # noqa: E402
+
+
+def render_report(report: dict, top_n: int = 16) -> List[str]:
+    """Levels ranked by cut responsibility (gap vs the level-0 bound),
+    with the coarsening stats cross-reference next to each verdict —
+    the docs/performance.md quality-triage workflow in one table."""
+    lines: List[str] = []
+    section = report.get("quality") or {}
+    if not section.get("enabled"):
+        lines.append(
+            "no quality section (schema < 7, KAMINPAR_TPU_QUALITY=0, or "
+            "the run recorded no hierarchy)"
+        )
+        return lines
+    totals = section.get("totals") or {}
+    lines.append(
+        f"scheme={section.get('scheme', '?')} "
+        f"final_cut={_fmt(section.get('final_cut'))} "
+        f"gap_mass={_fmt(totals.get('gap_mass'))} "
+        f"coarsening_locked_frac={_fmt(totals.get('coarsening_locked_frac'))} "
+        f"refinement_left_frac={_fmt(totals.get('refinement_left_frac'))}"
+    )
+    if not section.get("finalized", True):
+        lines.append("(hierarchy not finalized — interrupted run; floors "
+                     "may be missing)")
+    rows = attribution_rows(report)
+    if rows:
+        ranked = sorted(rows, key=lambda r: -int(r["gap"]))[:top_n]
+        lines.append("")
+        lines.append(
+            "levels by cut responsibility (gap = locked + left vs the "
+            "level-0 bound):"
+        )
+        table_rows = []
+        for r in ranked:
+            gap = int(r["gap"])
+            locked = int(r["coarsening_locked"])
+            stats = r.get("coarsening") or {}
+            table_rows.append([
+                r["level"], r.get("coarse_n"), r.get("k_at_level"),
+                gap, locked, int(r["refinement_left"]),
+                round(locked / gap, 3) if gap > 0 else None,
+                r.get("projected_cut"), r.get("refined_cut"),
+                r.get("floor_cut"), r.get("bound_cut"),
+                r.get("verdict"),
+                stats.get("internal_ew_ratio"),
+                stats.get("singleton_frac"),
+            ])
+        lines.extend(_table(
+            ["level", "n", "k", "gap", "locked", "left", "locked%",
+             "projected", "refined", "floor", "bound", "verdict",
+             "int_ew", "singleton"],
+            table_rows,
+        ))
+        worst = ranked[0]
+        if int(worst["gap"]) > 0:
+            # clamped share: with a negative refinement_left component
+            # the raw locked/gap ratio exceeds 1 (the headline totals
+            # clamp for the same reason) — print a [0, 1] share
+            share = max(
+                0.0, min(1.0, int(worst["coarsening_locked"])
+                         / int(worst["gap"]))
+            )
+            blame = (
+                "coarsening (re-cluster: raise internal_ew_ratio, check "
+                "the size constraint)"
+                if share >= 0.5
+                else "refinement (deepen the schedule at this level)"
+            )
+            lines.append(
+                f"worst: level {worst['level']} — "
+                f"{_fmt(round(share, 3))} "
+                f"of its gap is locked by coarsening; aim at {blame}"
+            )
+        else:
+            lines.append(
+                "no positive gap mass: every level's refined cut sits at "
+                "or below its bound (a NEGATIVE gap at a small-k level "
+                "means the k-doubling extensions below it leaked quality "
+                "— the signed rows above are the signal)"
+            )
+    else:
+        lines.append("")
+        lines.append("no attribution rows (run interrupted before "
+                     "finalize, or no coarsening levels)")
+    # verdict-only rows (level 0 + levels without floors) still matter
+    other = [
+        row for row in section.get("levels") or []
+        if row.get("verdict") is not None
+        and row not in rows
+    ]
+    if other:
+        lines.append("")
+        lines.extend(_table(
+            ["level", "verdict", "series"],
+            [[r["level"], r["verdict"], len(r.get("verdicts") or [])]
+             for r in other],
+        ))
+    return lines
+
+
+def render_diff(base: dict, cand: dict) -> List[str]:
+    """Per-level locked/left deltas + verdict flips (shared with
+    telemetry.diff's quality block)."""
+    from .diff import diff_quality
+
+    lines, _ = diff_quality(base, cand)
+    return lines or ["no quality sections to compare"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json
+    import sys
+
+    from .diff import load_report
+
+    ap = argparse.ArgumentParser(
+        prog="kaminpar_tpu.telemetry.quality",
+        description="per-level convergence triage: rank hierarchy levels "
+        "by cut responsibility (coarsening_locked vs refinement_left), "
+        "with coarsening-quality stats and refinement verdicts",
+    )
+    ap.add_argument("report", help="run-report JSON (--report-json)")
+    ap.add_argument(
+        "--top", type=int, default=16, metavar="N",
+        help="level rows to print (default 16)",
+    )
+    ap.add_argument(
+        "--diff", default=None, metavar="BASE.report.json",
+        help="also print per-level locked/left deltas and verdict flips "
+        "against a baseline report",
+    )
+    ap.add_argument(
+        "--require-attribution", action="store_true",
+        help="exit 1 when the report carries no attribution rows (CI "
+        "assertion that the observatory ran)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the quality section as JSON instead of tables",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        report = load_report(args.report)
+        base = load_report(args.diff) if args.diff else None
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps(report.get("quality") or {}))
+    else:
+        for line in render_report(report, top_n=args.top):
+            print(line)
+        if base is not None:
+            print()
+            for line in render_diff(base, report):
+                print(line)
+    if args.require_attribution and not attribution_rows(report):
+        print(
+            "error: report carries no attribution rows "
+            "(--require-attribution)", file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 6
+SCHEMA_VERSION = 7
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -97,6 +97,24 @@ def _perf_section(levels, perf_ranks=None) -> dict:
     return section
 
 
+def _quality_section(ranks=None) -> dict:
+    """Schema v7 `quality` section: per-level cut-loss attribution
+    (projected / refined / floor cuts, coarsening_locked vs
+    refinement_left), coarsening-quality stats, and refinement-efficacy
+    verdicts (telemetry/quality.py).  Well-formed disabled default when
+    the observatory recorded nothing."""
+    try:
+        from . import quality
+
+        section = quality.snapshot()
+    except Exception:
+        return {"enabled": False,
+                "caveat": "quality observatory unavailable"}
+    if ranks:
+        section["ranks"] = ranks
+    return section
+
+
 def _fault_section() -> dict:
     """The fault-plan echo (CLI satellite): plan, sites, injected log."""
     try:
@@ -149,6 +167,10 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # — budget, estimate, ladder rung, spill/reload accounting); runs
     # with no declared budget and no OOM carry the disabled default
     memory_budget = info.pop("memory_budget", {"enabled": False})
+    # schema v7: the dist driver's per-rank attribution rollup
+    # (collective, gathered before the report) folds into the quality
+    # section below
+    quality_ranks = info.pop("quality_ranks", None)
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -268,6 +290,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # estimate vs watermark, the recovery-ladder rung the run ended
         # at, and spill/reload byte accounting (docs/robustness.md)
         "memory_budget": memory_budget,
+        # schema v7: the quality observatory — per-level cut-loss
+        # attribution (coarsening_locked vs refinement_left vs the
+        # level-0 lower bound), coarsening-quality stats, and
+        # refinement-efficacy verdicts (telemetry/quality.py)
+        "quality": _quality_section(quality_ranks),
     }
     if agg is not None:
         report["timers_aggregated"] = agg

@@ -308,6 +308,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for line in render_report(report, top_n=args.top):
             print(line)
+        # v7 quality cross-pointer: seconds/bytes triage lives here, cut
+        # responsibility lives in the quality observatory
+        quality = report.get("quality") or {}
+        if quality.get("levels"):
+            totals = quality.get("totals") or {}
+            print()
+            print(
+                "quality: "
+                f"{totals.get('attribution_rows', 0)} attribution "
+                "level(s), coarsening_locked_frac="
+                f"{_fmt(totals.get('coarsening_locked_frac'))} — "
+                "python -m kaminpar_tpu.telemetry.quality "
+                f"{args.report}"
+            )
         if base is not None:
             print()
             for line in render_diff(base, report, top_n=args.top):

@@ -52,8 +52,18 @@ LARGE_COVERAGE_KEYS = (
     "vs_baseline_cut_10m", "util_gather_pct_hbm",
     "util_scatter_add_pct_hbm", "util_stream_cumsum_pct_hbm",
 )
-#: Rounds at or below this index predate the coverage contract.
+#: Rounds BELOW this index predate the coverage contract (the gate
+#: applies to rno >= LARGE_COVERAGE_SINCE, i.e. r06 onward).
 LARGE_COVERAGE_SINCE = 6
+
+#: Quality-attribution keys (round 11, telemetry/quality.py): the BENCH
+#: line must always carry them from r06 on (same presence contract as
+#: the 10M block — null marks a run without attribution, absence a
+#: silent coverage loss).  Their VALUES are advisory only (see
+#: --locked-frac-ceiling): the floor is relative to each run's own
+#: final partition, so the fraction is a direction signal, not a gate.
+QUALITY_COVERAGE_KEYS = ("coarsening_locked_frac",
+                         "refinement_left_frac")
 
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
@@ -147,6 +157,15 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
     engines = parsed.get("rating_engines") or (
         (report.get("rating") or {}).get("engines") or {}
     )
+    # round-11 quality attribution: promoted BENCH keys first, falling
+    # back to the embedded report's quality totals for older rounds
+    q_totals = (report.get("quality") or {}).get("totals") or {}
+    locked = parsed.get(
+        "coarsening_locked_frac", q_totals.get("coarsening_locked_frac")
+    )
+    left = parsed.get(
+        "refinement_left_frac", q_totals.get("refinement_left_frac")
+    )
     return {
         "round": os.path.basename(path),
         "rc": entry.get("rc"),
@@ -166,6 +185,8 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "pad_waste": parsed.get(
             "pad_waste", perf_totals.get("pad_waste")
         ),
+        "locked": locked,
+        "left": left,
         "p95_ms": p95_ms,
         "schema": report.get("schema_version"),
     }
@@ -183,7 +204,8 @@ def render(rows: List[Dict[str, Any]]) -> str:
     cols = ("round", "rc", "cut", "vs_baseline", "total_s",
             "coarsening_s", "lp_s", "contract_s", "engines",
             "compile_s", "cache_hit", "hbm_util",
-            "pad_waste", "p95_ms", "platform", "schema")
+            "pad_waste", "locked", "left", "p95_ms", "platform",
+            "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -204,6 +226,7 @@ def render(rows: List[Dict[str, Any]]) -> str:
             # perf-observatory movement notes (printed, never gated —
             # see the module docstring's gating rationale)
             for col, floor in (("hbm_util", 0.01), ("pad_waste", 0.05),
+                               ("locked", 0.1), ("left", 0.1),
                                ("p95_ms", None)):
                 a, b = prev.get(col), r.get(col)
                 if a is None or b is None:
@@ -254,6 +277,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="latest ACCELERATOR round must keep hbm_util >= this when "
         "the column is present (default 0.005)",
     )
+    ap.add_argument(
+        "--locked-frac-ceiling", type=float, default=0.75,
+        metavar="FRAC",
+        help="ADVISORY ceiling on the latest round's "
+        "coarsening_locked_frac: past it a note is printed (never a "
+        "violation — the attribution floor is relative to each run's "
+        "own final partition, a lower bound like hbm_util); default "
+        "0.75",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -284,6 +316,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(r05 regression class — bench.py must emit it "
                         "every run)"
                     )
+            for key in QUALITY_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: quality coverage key {key!r} missing "
+                        "(bench.py must emit it every run; null marks a "
+                        "run without attribution)"
+                    )
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target
     latest = None
@@ -293,6 +332,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             break
     if latest is not None:
         name, parsed = latest
+        # advisory quality-attribution note (never gated): a round whose
+        # gap mass is mostly locked by coarsening says the next quality
+        # PR should aim at clustering, not refinement schedules
+        locked_frac = parsed.get("coarsening_locked_frac")
+        if (
+            isinstance(locked_frac, (int, float))
+            and locked_frac > args.locked_frac_ceiling
+        ):
+            print(
+                f"advisory: {name} coarsening_locked_frac {locked_frac} "
+                f"exceeds {args.locked_frac_ceiling} — most of the cut "
+                "gap is locked in by coarsening; triage with "
+                "python -m kaminpar_tpu.telemetry.quality (not gated)"
+            )
         vs = parsed.get("vs_baseline")
         if isinstance(vs, (int, float)) and vs > 0 and vs < args.cut_floor:
             errors.append(

@@ -25,7 +25,7 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 echo "== [1/8] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/8] run-report schema (producer selftest, v1-v5 fixtures + v6 producer) =="
+echo "== [2/8] run-report schema (producer selftest, v1-v6 fixtures + v7 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
 echo "== [3/8] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
@@ -65,6 +65,36 @@ EOF
 # roofline rows asserted by the flag)
 python -m kaminpar_tpu.telemetry.top /tmp/_kmp_chaos_report.json \
     --require-roofline > /dev/null || exit 1
+# v7 quality observatory: the chaos run coarsened >= 1 level, so the
+# report must carry at least one cut-loss attribution row and the
+# quality triage CLI must render it (exit 0; the flag asserts the row)
+python -m kaminpar_tpu.telemetry.quality /tmp/_kmp_chaos_report.json \
+    --require-attribution > /dev/null || exit 1
+python - <<'EOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_chaos_report.json"))
+q = r["quality"]
+assert q["enabled"] and q["levels"], q.get("enabled")
+rows = [lv for lv in q["levels"]
+        if lv.get("gap") is not None and lv["level"] > 0]
+assert rows, "no attribution rows in the chaos report"
+# the exact per-level identity the observatory is built on
+for lv in rows:
+    assert lv["coarsening_locked"] + lv["refinement_left"] == lv["gap"], lv
+# BENCH-line contract: bench.py must ALWAYS emit the two quality keys
+# (null when a run carries no attribution — absence is the regression
+# class bench_trend gates from r06 on)
+import bench
+line_keys = bench.quality_keys({})
+assert set(line_keys) == {"coarsening_locked_frac",
+                          "refinement_left_frac"}, line_keys
+assert all(v is None for v in line_keys.values()), line_keys
+filled = bench.quality_keys(r)
+assert set(filled) == set(line_keys), filled
+print(f"quality smoke OK: {len(rows)} attribution row(s), "
+      f"locked_frac={q['totals'].get('coarsening_locked_frac')}, "
+      "BENCH quality keys present")
+EOF
 
 echo "== [4/8] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...

@@ -89,8 +89,8 @@ def version_checks(report: Any) -> List[str]:
     the `progress` and `compile` sections, v3+ additionally the
     `checkpoint` and `anytime` sections, v4+ additionally the `serving`
     section, v5+ additionally the `perf` section, v6+ additionally the
-    `memory_budget` section; older reports remain valid without them
-    during the transition."""
+    `memory_budget` section, v7+ additionally the `quality` section;
+    older reports remain valid without them during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -103,6 +103,7 @@ def version_checks(report: Any) -> List[str]:
         (4, ("serving",)),
         (5, ("perf",)),
         (6, ("memory_budget",)),
+        (7, ("quality",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -178,6 +179,15 @@ def _minimal_v5_report() -> dict:
     return r
 
 
+def _minimal_v6_report() -> dict:
+    """A minimal schema_version-6 report (memory_budget present, no
+    quality section) — the sixth transition fixture."""
+    r = _minimal_v5_report()
+    r["schema_version"] = 6
+    r["memory_budget"] = {"enabled": False}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
@@ -247,6 +257,28 @@ def _selftest_report(path: str) -> None:
 
     perf.record_padding(n=100, n_pad=256, m=400, m_pad=512, k=4, k_pad=4)
     perf.sample_memory("selftest")
+    # exercise the v7 quality producer surface: drive the recorder over
+    # a tiny handmade hierarchy (pure numpy — no device work) so the
+    # section carries a real attribution row, not just its default
+    from kaminpar_tpu.graphs.factories import make_cycle
+    from kaminpar_tpu.telemetry import quality
+
+    if quality.enabled():
+        import numpy as np
+
+        g = make_cycle(8)
+        qh = quality.begin("selftest")
+        try:
+            # one contraction: pair up the cycle's nodes
+            quality.note_cmap(
+                1, np.repeat(np.arange(4, dtype=np.int64), 2), 8
+            )
+            part = np.asarray([0, 0, 0, 0, 1, 1, 1, 1], dtype=np.int32)
+            quality.note_projected(1, cut=4)
+            quality.note_refined(1, cut=3)
+            quality.finalize_host(qh, g, part)
+        finally:
+            quality.end(qh)
     write_run_report(path)
 
 
@@ -264,7 +296,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v6) and validate it plus the embedded v1-v5 transition "
+        "v7) and validate it plus the embedded v1-v6 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -288,18 +320,18 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v6 (progress/compile +
-        # checkpoint/anytime + serving + perf + memory_budget)
-        if report.get("schema_version") != 6:
+        # live producer must emit v7 (progress/compile +
+        # checkpoint/anytime + serving + perf + memory_budget + quality)
+        if report.get("schema_version") != 7:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 6",
+                f"expected 7",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
-                    "memory_budget"):
+                    "memory_budget", "quality"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -319,11 +351,23 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v5 layouts must STILL validate
+        # the injected hierarchy must surface as a non-default quality
+        # section (catches a silently dead quality observatory);
+        # KAMINPAR_TPU_QUALITY=0 legitimately disables the layer
+        if report["quality"].get("enabled") and not report["quality"].get(
+            "levels"
+        ):
+            print(
+                "SCHEMA VIOLATION $: selftest quality section carries "
+                "no level rows despite an injected hierarchy",
+                file=sys.stderr,
+            )
+            return 1
+        # transition coverage: the v1-v6 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
-            ("v5", _minimal_v5_report()),
+            ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

@@ -1,0 +1,22 @@
+"""R1 bad fixture: the quality-observatory hook shape done WRONG —
+per-level cut readbacks and cluster-map pulls written lexically inside
+a driver's uncoarsening timer span (the PR-11 hook hazard: every level
+would host-sync inside the measured region and charge the span).
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def uncoarsen_with_inline_metrics(coarsener, graph, partition, cuts):
+    with scoped_timer("uncoarsening"):
+        while not coarsener.empty():
+            graph, partition = coarsener.uncoarsen(partition)
+            projected = int(jnp.sum(partition))  # line 19: R1 int()
+            cmap_host = np.asarray(coarsener.cmap)  # line 20: R1 copy
+            cuts.append((projected, cmap_host.shape[0]))
+    return cuts

@@ -1,0 +1,24 @@
+"""R1 good fixture: the quality-observatory hook shape done RIGHT —
+the per-level readbacks live in a helper OUTSIDE the driver's timer
+span (telemetry/quality.py's note_* pattern: the driver's span body
+only makes function calls; the host syncs happen in plain module code
+that tpulint's span tracking does not cover)."""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def _note_level(graph, partition, cmap, cuts):
+    # plain helper, not jit-reachable, not lexically inside a span:
+    # host readbacks are fine here (the quality.py hook shape)
+    cuts.append((int(jnp.sum(partition)), np.asarray(cmap).shape[0]))
+    return cuts
+
+
+def uncoarsen_with_hooked_metrics(coarsener, graph, partition, cuts):
+    with scoped_timer("uncoarsening"):
+        while not coarsener.empty():
+            graph, partition = coarsener.uncoarsen(partition)
+            _note_level(graph, partition, coarsener.cmap, cuts)
+    return cuts

@@ -32,6 +32,9 @@ def _findings(name):
 
 BAD_EXPECT = {
     "r1_bad.py": [("R1", 20), ("R1", 22), ("R1", 23), ("R1", 24), ("R1", 30)],
+    # the PR-11 quality-observatory hook shape: per-level cut/cmap
+    # pulls lexically inside a driver's uncoarsening span
+    "r1_quality_bad.py": [("R1", 19), ("R1", 20)],
     "r2_bad.py": [("R2", 5), ("R2", 9)],
     "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16), ("R3", 21)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
@@ -47,8 +50,8 @@ def test_rule_fires_on_bad_fixture(name):
 
 
 @pytest.mark.parametrize(
-    "name", ["r1_good.py", "r2_good.py", "r3_good.py", "r4_good.py",
-             "r5_good.py", "r6_good.py"]
+    "name", ["r1_good.py", "r1_quality_good.py", "r2_good.py",
+             "r3_good.py", "r4_good.py", "r5_good.py", "r6_good.py"]
 )
 def test_rule_silent_on_good_fixture(name):
     assert _findings(name) == []

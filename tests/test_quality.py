@@ -1,0 +1,640 @@
+"""Quality observatory (telemetry/quality.py): attribution invariants,
+coarsening-floor correctness vs a brute-force recompute, jaxpr-dormancy
+pin, verdict classification units, schema v7 transition, the triage CLI
+contract, and the dist rollup smoke."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import kaminpar_tpu as ktp
+from kaminpar_tpu import telemetry
+from kaminpar_tpu.graphs import factories
+from kaminpar_tpu.graphs.host import HostGraph, host_partition_metrics
+from kaminpar_tpu.telemetry import quality
+from kaminpar_tpu.utils.logger import OutputLevel
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _run_report(graph, k=4, seed=1, preset="default"):
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    telemetry.enable()
+    p = ktp.KaMinPar(preset)
+    p.set_output_level(OutputLevel.QUIET)
+    part = p.set_graph(graph).compute_partition(k=k, epsilon=0.05,
+                                                seed=seed)
+    return build_run_report(), part
+
+
+# ---------------------------------------------------------------------------
+# the attribution-sums-to-total invariant, end to end (rgg2d)
+# ---------------------------------------------------------------------------
+
+
+_RGG_CACHE = {}
+
+
+def _rgg_run():
+    """One shared small rgg2d pipeline run (module-memoized: the
+    invariant test and the end-to-end CLI test read the same report, so
+    tier-1 pays for one partition, not two)."""
+    if "report" not in _RGG_CACHE:
+        g = factories.make_rgg2d(4096, avg_degree=8, seed=1)
+        report, part = _run_report(g, k=4)
+        _RGG_CACHE.update(report=report, part=part, graph=g,
+                          headline=quality.headline())
+    return _RGG_CACHE
+
+
+def test_attribution_invariant_on_rgg2d():
+    """Every attribution row satisfies the exact per-level identity
+    coarsening_locked + refinement_left == gap == refined - bound, the
+    level-0 row is the identity push (floor == bound == final cut,
+    locked == 0), and the headline fractions are consistent."""
+    run = _rgg_run()
+    g, report, part = run["graph"], run["report"], run["part"]
+    q = report["quality"]
+    assert q["enabled"] and q["finalized"], q.get("enabled")
+    levels = q["levels"]
+    assert levels and q["final_cut"] is not None
+    by_level = {row["level"]: row for row in levels}
+    l0 = by_level[0]
+    assert l0["floor_cut"] == q["final_cut"] == l0["bound_cut"]
+    assert l0["coarsening_locked"] == 0
+    rows = [r for r in levels if r.get("gap") is not None and r["level"] > 0]
+    assert rows, "no attribution rows on an rgg2d run"
+    for row in rows:
+        assert (
+            row["coarsening_locked"] + row["refinement_left"] == row["gap"]
+        ), row
+        assert row["gap"] == row["refined_cut"] - row["bound_cut"], row
+        # a level that ran at the final k is bounded by the final cut
+        if row.get("k_at_level") == 4:
+            assert row["bound_cut"] == q["final_cut"], row
+    totals = q["totals"]
+    assert totals["attribution_rows"] == len(rows)
+    assert totals["gap_mass"] == sum(r["gap"] for r in rows)
+    lf, rf = (totals["coarsening_locked_frac"],
+              totals["refinement_left_frac"])
+    if lf is not None:
+        assert 0.0 <= lf <= 1.0 and 0.0 <= rf <= 1.0
+        assert abs(lf + rf - 1.0) < 1e-6
+    # the final cut the attribution is anchored to matches the real one
+    assert q["final_cut"] == host_partition_metrics(g, part, 4)["cut"]
+    # coarsening stats rode along for every contraction
+    for row in rows:
+        stats = row.get("coarsening")
+        assert stats and 0.0 <= stats["singleton_frac"] <= 1.0, row
+        assert stats["max_cluster_size"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# coarsening-floor correctness vs a brute-force recompute (tiny graph)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_graph():
+    """A weighted path of 8 nodes (edge i-(i+1) has weight i+1)."""
+    n = 8
+    src = np.arange(n - 1)
+    dst = src + 1
+    w = src + 1
+    edges = np.concatenate([np.stack([src, dst, w], 1),
+                            np.stack([dst, src, w], 1)])
+    order = np.lexsort((edges[:, 1], edges[:, 0]))
+    edges = edges[order]
+    xadj = np.zeros(n + 1, dtype=np.int64)
+    np.add.at(xadj, edges[:, 0] + 1, 1)
+    return HostGraph(
+        xadj=np.cumsum(xadj),
+        adjncy=edges[:, 1].astype(np.int32),
+        node_weights=np.arange(1, n + 1),
+        edge_weights=edges[:, 2],
+    )
+
+
+def _brute_force_floor(g, cmaps, part):
+    """Independent recompute: compose the maps, pick each cluster's
+    weighted-majority block (ties -> smaller block id), push back to the
+    input graph and sum the cut."""
+    node_w = g.node_weight_array()
+    src, adj, ew = g.edge_sources(), g.adjncy, g.edge_weight_array()
+
+    def cut(p):
+        return int(ew[p[src] != p[adj]].sum() // 2)
+
+    floors = {}
+    phi = np.arange(g.n)
+    for level in sorted(cmaps):
+        phi = np.asarray(cmaps[level])[phi]
+        q = {}
+        for c in np.unique(phi):
+            weights = {}
+            for v in np.flatnonzero(phi == c):
+                weights[part[v]] = weights.get(part[v], 0) + int(node_w[v])
+            best = max(weights.items(), key=lambda kv: (kv[1], -kv[0]))
+            q[c] = best[0]
+        pushed = np.asarray([q[c] for c in phi], dtype=np.int32)
+        floors[level] = cut(pushed)
+    return floors
+
+
+def test_floor_matches_bruteforce_on_tiny_graph():
+    g = _tiny_graph()
+    # two handmade contractions: pairs, then quads
+    cmaps = {
+        1: np.repeat(np.arange(4), 2),   # 8 -> 4
+        2: np.repeat(np.arange(2), 2),   # 4 -> 2
+    }
+    part = np.asarray([0, 0, 1, 1, 1, 0, 1, 1], dtype=np.int32)
+
+    telemetry.enable()
+    qh = quality.begin("test")
+    assert qh is not None
+    try:
+        quality.note_cmap(1, cmaps[1], 8)
+        quality.note_cmap(2, cmaps[2], 4)
+        quality.note_refined(1, cut=7, k=2)
+        quality.note_refined(2, cut=9, k=2)
+        quality.finalize_host(qh, g, part)
+    finally:
+        quality.end(qh)
+
+    section = quality.snapshot()
+    assert section["enabled"] and section["finalized"]
+    expected = _brute_force_floor(g, cmaps, part)
+    final_cut = section["final_cut"]
+    by_level = {row["level"]: row for row in section["levels"]}
+    for level, floor in expected.items():
+        row = by_level[level]
+        assert row["floor_cut"] == floor, (level, row, floor)
+        assert row["coarsening_locked"] == floor - final_cut
+        assert row["refinement_left"] == row["refined_cut"] - floor
+        assert row["gap"] == row["coarsening_locked"] + row["refinement_left"]
+    # floors are NOT monotone and may undercut the final cut: majority
+    # rounding can trade balance for cut (here level 2 collapses to one
+    # block — cut 0 — which is exactly the documented caveat)
+    assert expected[2] == 0 and expected[1] > 0
+
+
+def test_weighted_majority_ties_and_weights():
+    phi = np.asarray([0, 0, 1, 1, 1])
+    part = np.asarray([2, 1, 0, 0, 1])
+    # cluster 0: block 2 (w=1) vs block 1 (w=1) -> tie -> smaller id 1
+    # cluster 1: block 0 (w=1+1) vs block 1 (w=5) -> block 1
+    w = np.asarray([1, 1, 1, 1, 5])
+    q = quality.weighted_majority(phi, part, w, 2)
+    assert q.tolist() == [1, 1]
+    # unweighted majority
+    q2 = quality.weighted_majority(phi, part, np.ones(5, np.int64), 2)
+    assert q2.tolist() == [1, 0]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr dormancy: LP / Jet / contraction trace identically on / off
+# ---------------------------------------------------------------------------
+
+
+def test_quality_layer_has_zero_jaxpr_impact(monkeypatch):
+    """The acceptance pin: the LP, Jet, and contraction programs trace
+    to bitwise-identical jaxprs whether the quality layer is on, off via
+    KAMINPAR_TPU_QUALITY=0, or telemetry is disabled entirely — every
+    hook is host-side driver code (cuts go through the separately-jitted
+    ops.metrics.edge_cut_jit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.graphs.csr import device_graph_from_host
+    from kaminpar_tpu.ops import jet as jet_mod
+    from kaminpar_tpu.ops import lp as lp_mod
+    from kaminpar_tpu.ops.contraction import _contract_part1
+
+    g = factories.make_grid_graph(8, 8)
+    dg = device_graph_from_host(g)
+    part0 = jnp.asarray((np.arange(dg.n_pad) % 4).astype(np.int32))
+    mbw = jnp.asarray(np.full(4, g.n, dtype=np.int64).astype(np.int32))
+    cfg = lp_mod.LPConfig(refinement=True)
+
+    def traces():
+        lp = str(jax.make_jaxpr(
+            lambda p: lp_mod.lp_refine(
+                dg, p, 4, mbw, jnp.int32(1), cfg, num_iterations=2
+            )
+        )(part0))
+        cluster = str(jax.make_jaxpr(
+            lambda s: lp_mod.lp_cluster(
+                dg, jnp.asarray(64, dtype=dg.node_w.dtype), s,
+                lp_mod.LPConfig(num_iterations=2),
+            )
+        )(jnp.int32(3)))
+        jet = str(jax.make_jaxpr(
+            lambda p: jet_mod._jet_build_conn(dg, p, 4)
+        )(part0))
+        contraction = str(jax.make_jaxpr(
+            lambda lab: _contract_part1(dg, lab)
+        )(part0))
+        return lp, cluster, jet, contraction
+
+    # progress capture off so only the QUALITY toggle varies
+    monkeypatch.setenv("KAMINPAR_TPU_PROGRESS", "0")
+    telemetry.disable()
+    j_telemetry_off = traces()
+
+    telemetry.enable()
+    monkeypatch.setenv("KAMINPAR_TPU_QUALITY", "0")
+    assert not quality.enabled()
+    j_quality_off = traces()
+
+    monkeypatch.delenv("KAMINPAR_TPU_QUALITY")
+    assert quality.enabled()
+    # an OPEN recording scope must not change tracing either
+    qh = quality.begin("test")
+    try:
+        j_quality_on = traces()
+    finally:
+        quality.end(qh)
+
+    assert j_telemetry_off == j_quality_off == j_quality_on
+
+
+def test_hooks_are_noops_when_disabled(monkeypatch):
+    monkeypatch.setenv("KAMINPAR_TPU_QUALITY", "0")
+    telemetry.enable()
+    assert quality.begin("x") is None
+    quality.end(None)  # balanced no-op
+    # hooks without an open scope record nothing and never touch args
+    quality.note_cmap(1, object(), 4)  # would explode if not gated
+    quality.note_projected(1, cut=5)
+    quality.note_refined(1, cut=5)
+    assert quality.snapshot() == {"enabled": False}
+    assert quality.headline() is None
+
+
+# ---------------------------------------------------------------------------
+# verdict classification units
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("series,expected", [
+    # LP-style moved series: reached zero -> converged
+    ({"moved": [50, 10, 0]}, "converged"),
+    # still moving in bulk when the loop ended -> budget-capped
+    ({"moved": [50, 45, 40]}, "budget-capped"),
+    # decayed to a trickle but nonzero -> stalled
+    ({"moved": [100, 20, 3]}, "stalled"),
+    # Jet: cut stopped improving with movers left -> stalled
+    ({"cut": [100, 90, 90, 90], "moved": [9, 9, 9, 9]}, "stalled"),
+    # Jet: still improving in the tail -> budget-capped
+    ({"cut": [100, 90, 80, 70], "moved": [9, 9, 9, 9]}, "budget-capped"),
+    # Jet: movers drained -> converged
+    ({"cut": [100, 90, 80], "moved": [9, 3, 0]}, "converged"),
+    # FM: last pass gained nothing -> converged
+    ({"gain": [40, 10, 0]}, "converged"),
+    # FM: still gaining when the pass budget ended -> budget-capped
+    ({"gain": [40, 30, 20]}, "budget-capped"),
+    # empty series -> converged (the loop never ran)
+    ({}, "converged"),
+])
+def test_classify_series(series, expected):
+    v = quality.classify_series(series)
+    assert v["verdict"] == expected, (series, v)
+    assert v["realized"] >= 0
+
+
+def test_classify_series_gain_mass():
+    v = quality.classify_series({"cut": [100, 70, 60], "moved": [5, 4, 2]})
+    assert v["realized"] == 40 and v["remaining"] == 2
+    v = quality.classify_series({"moved": [30, 20, 0]})
+    assert v["realized"] == 50 and v["remaining"] == 0
+
+
+def test_level_verdict_rollup_and_skip_events():
+    assert quality.level_verdict([]) is None
+    assert quality.level_verdict(
+        [{"verdict": "converged"}, {"verdict": "stalled"}]
+    ) == "stalled"
+    assert quality.level_verdict(
+        [{"verdict": "stalled"}, {"verdict": "budget-capped"}]
+    ) == "budget-capped"
+    # a deadline refine-skipped event marks its level budget-capped
+    telemetry.enable()
+    qh = quality.begin("test")
+    try:
+        quality.note_refined(2, cut=10, k=2)
+        telemetry.event("refine-skipped", level=2, algorithm="jet",
+                        reason="deadline")
+    finally:
+        quality.end(qh)
+    section = quality.snapshot()
+    row = {r["level"]: r for r in section["levels"]}[2]
+    assert row["verdict"] == "budget-capped"
+    assert any(v.get("skipped") for v in row["verdicts"])
+
+
+# ---------------------------------------------------------------------------
+# schema v7 + fixtures
+# ---------------------------------------------------------------------------
+
+
+def _load_checker():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(_REPO, "scripts", "check_report_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_schema_v7_quality_section_and_fixtures():
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH
+
+    checker = _load_checker()
+    schema = json.loads(open(SCHEMA_PATH).read())
+    # every transition fixture v1..v6 still validates
+    for fixture in (checker._minimal_v1_report(),
+                    checker._minimal_v2_report(),
+                    checker._minimal_v3_report(),
+                    checker._minimal_v4_report(),
+                    checker._minimal_v5_report(),
+                    checker._minimal_v6_report()):
+        assert checker.validate_instance(fixture, schema) == []
+        assert checker.version_checks(fixture) == []
+    # v7 requires the quality section
+    v7_missing = dict(checker._minimal_v6_report(), schema_version=7)
+    assert any("quality" in e for e in checker.version_checks(v7_missing))
+    v7 = dict(v7_missing, quality={"enabled": False})
+    assert checker.validate_instance(v7, schema) == []
+    assert checker.version_checks(v7) == []
+    # a populated quality section validates against the declared shape
+    v7full = dict(v7, quality={
+        "enabled": True, "scheme": "deep", "finalized": True,
+        "final_cut": 10,
+        "levels": [{"level": 1, "projected_cut": 14, "refined_cut": 12,
+                    "floor_cut": 11, "bound_cut": 10,
+                    "coarsening_locked": 1, "refinement_left": 1,
+                    "gap": 2, "verdict": "stalled",
+                    "coarsening": {"internal_ew_ratio": 0.5,
+                                   "singleton_frac": 0.1}}],
+        "totals": {"attribution_rows": 1, "gap_mass": 2,
+                   "locked_mass": 1, "left_mass": 1,
+                   "coarsening_locked_frac": 0.5,
+                   "refinement_left_frac": 0.5, "worst_level": 1},
+        "ranks": [{"rank": 0, "gap_mass": 2}],
+    })
+    assert checker.validate_instance(v7full, schema) == []
+    # a bad verdict enum is caught
+    v7bad = json.loads(json.dumps(v7full))
+    v7bad["quality"]["levels"][0]["verdict"] = "fine"
+    assert any("verdict" in e or "enum" in e
+               for e in checker.validate_instance(v7bad, schema))
+
+
+# ---------------------------------------------------------------------------
+# triage CLI: render + exit codes (the telemetry.top contract)
+# ---------------------------------------------------------------------------
+
+
+def _cli_report(with_quality=True):
+    report = {"schema_version": 7}
+    if with_quality:
+        report["quality"] = {
+            "enabled": True, "scheme": "deep", "finalized": True,
+            "final_cut": 100,
+            "levels": [
+                {"level": 0, "refined_cut": 100, "floor_cut": 100,
+                 "bound_cut": 100, "coarsening_locked": 0,
+                 "refinement_left": 0, "gap": 0},
+                {"level": 1, "coarse_n": 64, "projected_cut": 130,
+                 "refined_cut": 120, "floor_cut": 104, "bound_cut": 100,
+                 "coarsening_locked": 4, "refinement_left": 16,
+                 "gap": 20, "k_at_level": 4, "verdict": "stalled",
+                 "coarsening": {"internal_ew_ratio": 0.4,
+                                "singleton_frac": 0.3}},
+                {"level": 2, "coarse_n": 16, "projected_cut": 140,
+                 "refined_cut": 130, "floor_cut": 124, "bound_cut": 100,
+                 "coarsening_locked": 24, "refinement_left": 6,
+                 "gap": 30, "k_at_level": 4,
+                 "verdict": "budget-capped"},
+            ],
+            "totals": {"attribution_rows": 2, "gap_mass": 50,
+                       "locked_mass": 28, "left_mass": 22,
+                       "coarsening_locked_frac": 0.56,
+                       "refinement_left_frac": 0.44, "worst_level": 2},
+        }
+    return report
+
+
+def test_cli_renders_and_ranks(tmp_path, capsys):
+    path = tmp_path / "r.json"
+    path.write_text(json.dumps(_cli_report()))
+    assert quality.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    # ranked by gap: level 2 (gap 30) before level 1 (gap 20)
+    assert out.index("\n2 ") < out.index("\n1 ")
+    assert "coarsening_locked_frac=0.56" in out
+    assert "budget-capped" in out
+    # the worst level is mostly locked -> the advice targets coarsening
+    assert "aim at coarsening" in out
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    path = tmp_path / "r.json"
+    path.write_text(json.dumps(_cli_report()))
+    assert quality.main([str(path), "--require-attribution"]) == 0
+    # no quality section: renders a note, exits 0; the CI flag makes it 1
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps(_cli_report(with_quality=False)))
+    assert quality.main([str(bare)]) == 0
+    assert quality.main([str(bare), "--require-attribution"]) == 1
+    capsys.readouterr()
+    # IO / not-a-report errors exit 2 (telemetry.top contract)
+    assert quality.main([str(tmp_path / "missing.json")]) == 2
+    notreport = tmp_path / "x.json"
+    notreport.write_text("{}")
+    assert quality.main([str(notreport)]) == 2
+    # --json emits the section as one JSON object
+    assert quality.main([str(path), "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["totals"]["worst_level"] == 2
+
+
+def test_cli_diff_mode(tmp_path, capsys):
+    base = _cli_report()
+    cand = json.loads(json.dumps(base))
+    cand["quality"]["levels"][2]["coarsening_locked"] = 10
+    cand["quality"]["levels"][2]["verdict"] = "converged"
+    pb, pc = tmp_path / "b.json", tmp_path / "c.json"
+    pb.write_text(json.dumps(base))
+    pc.write_text(json.dumps(cand))
+    assert quality.main([str(pc), "--diff", str(pb)]) == 0
+    out = capsys.readouterr().out
+    assert "locked 24 -> 10" in out
+    assert "verdict budget-capped -> converged" in out
+
+
+def test_telemetry_diff_carries_quality_block(tmp_path, capsys):
+    from kaminpar_tpu.telemetry.diff import diff_quality
+
+    base, cand = _cli_report(), _cli_report()
+    cand["quality"]["levels"][1]["refinement_left"] = 2
+    lines, failures = diff_quality(base, cand)
+    assert failures == []  # informational, never gated
+    assert any("left 16 -> 2" in ln for ln in lines)
+    # pre-v7 baseline: a schema transition, not a regression
+    lines, failures = diff_quality({}, cand)
+    assert failures == [] and any("only cand" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# report integration + dist rollup smoke
+# ---------------------------------------------------------------------------
+
+
+def test_report_quality_section_disabled_default():
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    telemetry.enable()
+    report = build_run_report()
+    assert report["schema_version"] == 7
+    assert report["quality"] == {"enabled": False}
+
+
+def test_rank_rollup_single_process():
+    telemetry.enable()
+    qh = quality.begin("test")
+    try:
+        quality.note_cmap(1, np.repeat(np.arange(4), 2), 8)
+        quality.note_refined(1, cut=9, k=2)
+        quality.finalize_host(qh, _tiny_graph(),
+                              np.asarray([0, 0, 0, 0, 1, 1, 1, 1]))
+    finally:
+        quality.end(qh)
+    rows = quality.rank_rollup()
+    assert len(rows) == 1 and rows[0]["rank"] == 0
+    assert rows[0]["gap_mass"] == quality.snapshot()["totals"]["gap_mass"]
+    # the dist driver annotates this into the report's quality section
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    telemetry.annotate(quality_ranks=rows)
+    report = build_run_report()
+    assert report["quality"]["ranks"] == rows
+    assert report["quality"]["enabled"]
+
+
+def test_verdicts_exclude_other_hierarchies_series():
+    """Progress series share one stream AND one level numbering across
+    nested/sequential hierarchies; the verdict join must only pick up
+    series tagged with the PUBLISHED hierarchy's id (a nested IP run's
+    budget-capped LP must not flip the outer level's verdict)."""
+    from kaminpar_tpu.telemetry import progress as progress_mod
+
+    telemetry.enable()
+    outer = quality.begin("deep")
+    quality.note_refined(1, cut=9, k=2)
+    with progress_mod.tag(level=1,
+                          quality_hierarchy=quality.current_id()):
+        progress_mod.emit_host("lp", {"moved": [5, 0]}, phase="refine")
+    inner = quality.begin("deep")
+    assert quality.current_id() == inner.hid != outer.hid
+    with progress_mod.tag(level=1,
+                          quality_hierarchy=quality.current_id()):
+        # still moving in bulk -> budget-capped, but it belongs to the
+        # INNER hierarchy's level 1
+        progress_mod.emit_host("lp", {"moved": [50, 40]}, phase="refine")
+    quality.end(inner)
+    quality.finalize_host(outer, _tiny_graph(),
+                          np.asarray([0, 0, 0, 0, 1, 1, 1, 1]))
+    quality.end(outer)
+    section = quality.snapshot()
+    row = {r["level"]: r for r in section["levels"]}[1]
+    assert row["verdict"] == "converged", row
+    assert len(row["verdicts"]) == 1
+
+
+def test_block_map_from_spans():
+    class Span:
+        def __init__(self, first, count):
+            self.first, self.count = first, count
+
+    # tuples and span objects produce the same map
+    tuples = [(0, 2), (2, 1), (3, 1)]
+    objs = [Span(*t) for t in tuples]
+    bm = quality.block_map_from_spans(tuples, 4)
+    assert bm.tolist() == [0, 0, 1, 2]
+    assert quality.block_map_from_spans(objs, 4).tolist() == bm.tolist()
+    # at the final k there is nothing to map
+    assert quality.block_map_from_spans([(0, 1)] * 4, 4) is None
+
+
+def test_interrupted_hierarchy_publishes_partial_section():
+    """A hierarchy that recorded cuts but never finalized (preempted
+    run) still lands in the report — marked unfinalized, no floors."""
+    telemetry.enable()
+    qh = quality.begin("deep")
+    try:
+        quality.note_projected(2, cut=40, k=2)
+        quality.note_refined(2, cut=30, k=2)
+    finally:
+        quality.end(qh)
+    section = quality.snapshot()
+    assert section["enabled"] and not section["finalized"]
+    row = {r["level"]: r for r in section["levels"]}[2]
+    assert row["refined_cut"] == 30 and "floor_cut" not in row
+    assert quality.attribution_rows({"quality": section}) == []
+
+
+def test_nested_hierarchies_do_not_corrupt_outer():
+    """A nested IP run (dist driver's shm KaMinPar) opens its own scope;
+    the outer hierarchy's record is untouched and its later finalize
+    wins the published section."""
+    g = _tiny_graph()
+    telemetry.enable()
+    outer = quality.begin("dist")
+    quality.note_cmap(1, np.repeat(np.arange(4), 2), 8)
+    quality.note_refined(1, cut=9, k=2)
+    inner = quality.begin("deep")
+    quality.note_cmap(1, np.zeros(2, dtype=np.int64), 2)
+    quality.note_refined(1, cut=1, k=2)
+    quality.finalize_host(inner, _tiny_graph(), np.zeros(8, np.int32))
+    quality.end(inner)
+    # outer state is intact
+    assert outer.cmaps[1].shape[0] == 8
+    quality.finalize_host(outer, g, np.asarray([0, 0, 0, 0, 1, 1, 1, 1]))
+    quality.end(outer)
+    section = quality.snapshot()
+    assert section["scheme"] == "dist"
+    assert {r["level"] for r in section["levels"]} >= {0, 1}
+
+
+def test_end_to_end_report_cli_and_headline(tmp_path):
+    """Full pipeline -> report -> quality CLI exit 0 with an
+    attribution row; the CLI headline line is available."""
+    report = _rgg_run()["report"]
+    assert _rgg_run()["headline"] is not None
+    path = tmp_path / "report.json"
+    path.write_text(json.dumps(report))
+    assert quality.main([str(path), "--require-attribution"]) == 0
+    # the generic schema checker accepts the produced report
+    checker = _load_checker()
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH
+
+    schema = json.loads(open(SCHEMA_PATH).read())
+    errors = (checker.validate_instance(report, schema)
+              + checker.version_checks(report))
+    assert errors == [], errors

@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 6
+    assert loaded["schema_version"] == 7
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -601,11 +601,11 @@ def test_diff_aligns_progress_by_kind_path_level(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# schema v1..v6 transition (scripts/check_report_schema.py)
+# schema v1..v7 transition (scripts/check_report_schema.py)
 # ---------------------------------------------------------------------------
 
 
-def test_schema_accepts_v1_through_v6(tmp_path):
+def test_schema_accepts_v1_through_v7(tmp_path):
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH
 
     checker = _load_checker()
@@ -648,13 +648,19 @@ def test_schema_accepts_v1_through_v6(tmp_path):
     v6_missing = dict(v5, schema_version=6)
     assert any("memory_budget" in e
                for e in checker.version_checks(v6_missing))
-    v6 = dict(v6_missing, memory_budget={"enabled": False})
+    v6 = checker._minimal_v6_report()
     assert checker.validate_instance(v6, schema) == []
     assert checker.version_checks(v6) == []
-    # v7 is not a known version
-    v7 = dict(v1, schema_version=7)
+    # v7 additionally requires the quality section
+    v7_missing = dict(v6, schema_version=7)
+    assert any("quality" in e for e in checker.version_checks(v7_missing))
+    v7 = dict(v7_missing, quality={"enabled": False})
+    assert checker.validate_instance(v7, schema) == []
+    assert checker.version_checks(v7) == []
+    # v8 is not a known version
+    v8 = dict(v1, schema_version=8)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v7, schema))
+               for e in checker.validate_instance(v8, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
