@@ -83,14 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--checkpoint-dir", default=None, metavar="DIR",
         help="write atomic pipeline-barrier checkpoints under DIR "
-        "(rank 0 writes; barrier-consistent stage ids); dist resume "
-        "currently restores completed `result` snapshots only "
-        "(docs/robustness.md)",
+        "(rank 0 writes; barrier-consistent stage ids; per-level coarse "
+        "CSR/cmap snapshots + a per-rank shard-fingerprint vector in "
+        "the manifest — full-hierarchy dist resume, docs/robustness.md)",
     )
     p.add_argument(
         "--resume", action="store_true",
-        help="resume from --checkpoint-dir (fingerprint-validated; "
-        "mismatch degrades to a clean restart)",
+        help="resume from --checkpoint-dir at the recorded dist barrier "
+        "(fingerprint-validated; a graph/ctx mismatch OR a changed "
+        "device count — detected via the shard fingerprints — degrades "
+        "to a logged clean restart, never a wrong answer)",
     )
     p.add_argument(
         "--time-budget", type=float, default=None, metavar="SECS",
@@ -104,11 +106,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--memory-budget", type=float, default=None, metavar="BYTES",
-        help="declared device-memory budget (bytes; or "
-        "KAMINPAR_TPU_HBM_BYTES): the dist driver pre-checks the "
-        "upload against it and refuses with a structured DeviceOOM "
-        "instead of an allocator death (the full recovery ladder is "
-        "shm-only — docs/robustness.md documents the limit)",
+        help="declared PER-DEVICE memory budget (bytes; or "
+        "KAMINPAR_TPU_HBM_BYTES): preflight prices the actual max "
+        "padded shard from the sharding plan, and a DeviceOOM on any "
+        "rank walks EVERY rank down the cross-rank agreed recovery "
+        "ladder together (tight pads -> host-spilled shard hierarchy "
+        "-> host-only; docs/robustness.md, dist resilience contract)",
     )
     p.add_argument(
         "--lp-rating", default=None,

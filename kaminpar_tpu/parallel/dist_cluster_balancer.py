@@ -50,10 +50,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-try:  # jax >= 0.6
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+# version-portable shard_map (mesh.shard_map_compat): the
+# replication-check flag is spelled check_vma / check_rep depending on
+# the installed jax — the compat shim keeps every dist kernel usable on
+# both instead of dying with a TypeError at the first collective
+from .mesh import shard_map_compat as _shard_map
 
 from ..ops.balancer import relative_gain_key
 from ..ops.segments import (

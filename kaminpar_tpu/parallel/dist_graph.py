@@ -25,8 +25,9 @@ Layout invariants (device d of D, n_loc = n_pad / D, m_loc = m_tot / D):
 
 from __future__ import annotations
 
+import hashlib
 from dataclasses import dataclass
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +108,72 @@ class DistGraph:
         return self.send_idx.shape[1]
 
 
+def shard_sizes(
+    xadj: np.ndarray, D: int, n_pad: Optional[int] = None,
+) -> Tuple[int, int, List[int]]:
+    """The sizing half of the 1D contiguous-range sharding plan:
+    ``(n_loc, m_loc, per-shard true edge counts)`` for a CSR with row
+    offsets ``xadj`` over ``D`` devices.  ``m_loc`` is the ACTUAL max
+    padded shard — the padded bucket of the heaviest device's edge
+    slice, not ``ceil(m / D)``: skewed edge distributions (RMAT hubs
+    landing in one node range) make the uniform estimate undercount the
+    rank that matters.  Shared by :func:`_assemble_dist_graph`, the
+    dist driver's ``memory.preflight`` pricing, and the shard
+    fingerprints, so the three can never disagree about the layout."""
+    xadj = np.asarray(xadj, dtype=np.int64)
+    n = len(xadj) - 1
+    if n_pad is None:
+        n_pad = round_up(pad_size(n + 1), D)
+    else:
+        n_pad = round_up(n_pad, D)
+    n_loc = n_pad // D
+    counts: List[int] = []
+    for d in range(D):
+        v0, v1 = min(d * n_loc, n), min((d + 1) * n_loc, n)
+        counts.append(int(xadj[v1] - xadj[v0]))
+    m_loc = pad_size(max(max(counts, default=1), 1))
+    return n_loc, m_loc, counts
+
+
+def shard_fingerprints(graph, D: int) -> List[str]:
+    """Per-rank shard fingerprints of the 1D sharding plan: one short
+    hash per device over (fleet size, shard index, owned node range,
+    shard edge count, pad sizes, boundary row offsets).  Recorded in
+    every dist checkpoint barrier's manifest meta; a resume under a
+    DIFFERENT device count (or a repartitioned input) produces a
+    different vector — the dist driver detects that and degrades to a
+    logged clean restart instead of restoring shard state that no
+    longer lines up (docs/robustness.md, dist resilience contract).
+    Works on plain and compressed host graphs (both carry ``xadj``);
+    O(D) hashes over O(1) samples each, never a full-graph pass."""
+    xadj = np.asarray(graph.xadj, dtype=np.int64)
+    n = len(xadj) - 1
+    n_loc, m_loc, counts = shard_sizes(xadj, D)
+    fps: List[str] = []
+    for d in range(D):
+        v0, v1 = min(d * n_loc, n), min((d + 1) * n_loc, n)
+        h = hashlib.sha256()
+        h.update(
+            f"D={D};d={d};v0={v0};v1={v1};edges={counts[d]};"
+            f"n_loc={n_loc};m_loc={m_loc};".encode()
+        )
+        h.update(xadj[v0: min(v1 + 1, v0 + 257)].tobytes())
+        fps.append(h.hexdigest()[:16])
+    return fps
+
+
+def dist_graph_bytes(dg: DistGraph) -> int:
+    """Total device bytes of a DistGraph's arrays (spill accounting)."""
+    total = 0
+    for name in ("src", "dst", "edge_w", "node_w", "dst_local",
+                 "ghost_gid", "send_idx", "recv_map"):
+        arr = getattr(dg, name)
+        total += int(np.dtype(arr.dtype).itemsize) * int(
+            np.prod(arr.shape)
+        )
+    return total
+
+
 def dist_graph_from_host(
     graph: HostGraph,
     mesh: Mesh,
@@ -181,15 +248,10 @@ def _assemble_dist_graph(
         n_pad = round_up(n_pad, D)
     if n_pad < n + 1:
         raise ValueError("n_pad too small")
-    n_loc = n_pad // D
     pad_node = n_pad - 1
 
     degrees = xadj[1:] - xadj[:-1]
-    m_loc = 1
-    for d in range(D):
-        v0, v1 = min(d * n_loc, n), min((d + 1) * n_loc, n)
-        m_loc = max(m_loc, int(xadj[v1] - xadj[v0]))
-    m_loc = pad_size(m_loc)
+    n_loc, m_loc, _shard_edges = shard_sizes(xadj, D, n_pad=n_pad)
     # pad-waste attribution for the sharded layout: every device pads
     # its node range to n_loc and its edge slice to the max shard's
     # bucket, so padded slots are D * per-shard slots against the m

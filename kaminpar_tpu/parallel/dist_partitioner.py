@@ -88,6 +88,14 @@ class dKaMinPar:
         self._fine_dg: Optional[DistGraph] = None
         # set by _replicated_phase when mesh-subgroup replication fires
         self._replication_info: Optional[dict] = None
+        # the live coarsening hierarchy (_DistLevel list) — held on the
+        # instance so the memory governor's spiller hook can drop cold
+        # per-level DistGraphs at the barriers (rung >= 2)
+        self._levels: Optional[List["_DistLevel"]] = None
+        # per-rank shard fingerprints of the input's 1D sharding plan
+        # (dist_graph.shard_fingerprints), stamped into every dist
+        # checkpoint barrier's manifest meta
+        self._shard_fps: List[str] = []
 
     def set_graph(self, graph) -> "dKaMinPar":
         """Accepts a HostGraph or a CompressedHostGraph.  A compressed
@@ -190,6 +198,9 @@ class dKaMinPar:
 
         mgr = None
         res_ctx = self.ctx.shm.resilience
+        from ..resilience import agreement as agreement_mod
+        from ..resilience import memory as memory_mod
+
         if owns_stream:
             # same arm-and-maybe-resume policy as the shm facade
             # (checkpoint.create_manager / deadline.begin_run keep the
@@ -201,26 +212,57 @@ class dKaMinPar:
             mgr = ckpt_mod.create_manager(res_ctx, graph, self.ctx)
             if mgr is not None:
                 ckpt_mod.activate(mgr)
-            # memory governor (resilience/memory.py): the dist driver
-            # has no recovery ladder — distributed rung semantics would
-            # need a cross-rank agreed rung — but the pre-upload budget
-            # check still refuses an upload the declared budget cannot
-            # hold with a structured DeviceOOM instead of letting the
-            # allocator die mid-shard (documented limit,
-            # docs/robustness.md)
-            from ..resilience import memory as memory_mod
+            # the per-rank shard fingerprints of the 1D sharding plan:
+            # stamped into every dist barrier's manifest meta, and the
+            # key that detects a resume under a DIFFERENT device count
+            # below (docs/robustness.md, dist resilience contract)
+            from .dist_graph import shard_fingerprints, shard_sizes
 
-            memory_mod.begin_run(graph, self.ctx)
-            # the budget (KAMINPAR_TPU_HBM_BYTES / --memory-budget) is
-            # PER-DEVICE and dist_graph shards the node/edge arrays
-            # across the mesh, so price the per-rank shard, not the
-            # whole graph — otherwise any multi-chip run whose total
-            # footprint exceeds one device's budget is refused even
-            # though it fits after sharding
             devices = max(1, int(self.mesh.devices.size))
-            memory_mod.preflight(
-                -(-graph.n // devices), -(-graph.m // devices), k,
-                where="dist",
+            self._shard_fps = shard_fingerprints(graph, devices)
+            if mgr is not None:
+                pending = mgr.pending_resume()
+                if pending is not None and pending.get("scheme") == "dist":
+                    recorded = pending.get("meta", {}).get("shards")
+                    if (
+                        recorded is not None
+                        and list(recorded) != list(self._shard_fps)
+                    ):
+                        # shard state (cmaps, per-level layouts) from a
+                        # different sharding plan cannot be restored
+                        # without risking a wrong answer: logged clean
+                        # restart, never a silent mis-resume
+                        mgr.drop_resume(
+                            "dist shard fingerprints changed (checkpoint "
+                            f"has {len(list(recorded))} shard(s), current "
+                            f"mesh has {len(self._shard_fps)}) — device "
+                            "count or input sharding differs"
+                        )
+            # memory governor (resilience/memory.py): the budget
+            # (KAMINPAR_TPU_HBM_BYTES / --memory-budget) is PER-DEVICE
+            # and dist_graph shards the node/edge arrays across the
+            # mesh, so price the ACTUAL max padded shard from the
+            # sharding plan — ceil(n/D)/ceil(m/D) undercounts the
+            # heaviest rank of a skewed edge distribution, and pricing
+            # the whole graph refuses multi-chip runs that fit after
+            # sharding
+            n_loc, m_loc, _ = shard_sizes(
+                np.asarray(graph.xadj, dtype=np.int64), devices
+            )
+            memory_mod.begin_run(
+                graph, self.ctx, price_shape=(n_loc, m_loc)
+            )
+            memory_mod.register_spiller(self)
+            memory_mod.preflight(n_loc, m_loc, k, where="dist")
+            # divergence sentinels (resilience/agreement.py): every dist
+            # barrier audits [stage, rung, run fingerprint] across the
+            # fleet — silent rank divergence becomes a structured
+            # RankDivergence with a per-rank dump
+            agreement_mod.arm(
+                "dist",
+                ckpt_mod.graph_fingerprint(graph),
+                ckpt_mod.ctx_fingerprint(self.ctx),
+                self._shard_fps,
             )
 
         prior_level = output_level()
@@ -230,16 +272,42 @@ class dKaMinPar:
             )
             with timer.scoped_timer("dist-partitioning"):
                 # a run preempted after its final barrier resumes
-                # instantly from the `result` snapshot; mid-pipeline dist
-                # stages are recorded for the audit trail but re-enter at
-                # the start (docs/robustness.md documents the limit)
+                # instantly from the `result` snapshot; mid-pipeline
+                # dist stages re-enter at the recorded barrier via the
+                # dist-scheme resume inside _partition_recorded (full-
+                # hierarchy dist resume, docs/robustness.md).  The core
+                # runs under the cross-rank agreed OOM recovery ladder:
+                # a DeviceOOM on any rank unwinds every rank to the
+                # same rung (tight pads -> host-spilled shard
+                # hierarchy -> host-only) instead of deadlocking the
+                # survivors inside shard_map collectives.
                 resumed = (
                     mgr.take_result_resume() if mgr is not None else None
                 )
                 if resumed is not None and resumed.shape == (graph.n,):
                     partition = resumed
                 else:
-                    partition = self._partition(graph, k)
+                    partition = memory_mod.run_dist_ladder(
+                        lambda: self._partition(graph, k),
+                        graph, self.ctx, self,
+                    )
+
+            # strict-balance output gate (resilience/gate.py): the dist
+            # result now passes the same end-of-pipeline validation +
+            # greedy repair as the shm facade's (compressed inputs are
+            # chunk-stream recomputed, never decoded whole)
+            from ..resilience import gate as output_gate
+
+            if output_gate.gate_enabled() and res_ctx.output_gate:
+                # already host-side: the pipeline returns numpy
+                partition = np.asarray(partition, dtype=np.int32)
+                with timer.scoped_timer("output-gate"):
+                    partition, gate_verdict = output_gate.check_and_repair(
+                        graph, partition, ctx.partition,
+                        repair=res_ctx.repair,
+                    )
+                if owns_stream:
+                    telemetry.annotate(output_gate=gate_verdict)
 
             if self._is_compressed(graph) and self._fine_dg is not None:
                 # still-compressed input: cut from the finest-level
@@ -331,6 +399,19 @@ class dKaMinPar:
                 mem_summary = memory_mod.summary()
                 if mem_summary.get("enabled"):
                     telemetry.annotate(memory_budget=mem_summary)
+                # dist resilience audit trail (schema v8): sentinel
+                # counters + the shard-fingerprint vector + the agreed
+                # ladder rung + what (if anything) was resumed
+                dist_sect = agreement_mod.section()
+                if dist_sect.get("enabled"):
+                    dist_sect["shard_fingerprints"] = list(self._shard_fps)
+                    dist_sect["ladder"] = {
+                        "agreed": True,
+                        "rung": int(mem_summary.get("rung", 0) or 0),
+                    }
+                    if mgr is not None and mgr.resumed_from() is not None:
+                        dist_sect["resumed_from"] = mgr.resumed_from()
+                    telemetry.annotate(dist_resilience=dist_sect)
                 ckpt_mod.deactivate()
             log(
                 f"RESULT cut={cut} imbalance={imbalance:.6f} "
@@ -338,6 +419,9 @@ class dKaMinPar:
             )
         finally:
             set_output_level(prior_level)
+            if owns_stream:
+                agreement_mod.disarm()
+            self._levels = None
         return partition
 
     # -- multilevel driver ------------------------------------------------
@@ -369,6 +453,7 @@ class dKaMinPar:
     def _partition_recorded(
         self, graph: HostGraph, k: int, qh
     ) -> np.ndarray:
+        from ..resilience import checkpoint as ckpt
         from ..telemetry import quality as quality_mod
 
         ctx = self.ctx
@@ -381,74 +466,147 @@ class dKaMinPar:
 
         deep = self.ctx.mode == PartitioningMode.DEEP
 
-        # coarsening (deep_multilevel.cc:75-118 analog)
-        levels: List[Tuple[DistGraph, np.ndarray, HostGraph]] = []
+        # --- full-hierarchy dist resume: rebuild the recorded level
+        # stack (coarse host CSRs + cmaps by reference; the sharded
+        # DistGraphs are deterministic caches rebuilt on demand) and
+        # re-enter at the recorded dist barrier — no completed level
+        # re-runs (docs/robustness.md, dist resilience contract)
+        resume = ckpt.take_resume("dist")
+        r_stage: Optional[str] = None
+        r_level: Optional[int] = None
+        levels: List[_DistLevel] = []
         current = graph
-        threshold = max(2 * c_ctx.contraction_limit, k)
-        with timer.scoped_timer("dist-coarsening"):
-            while current.n > threshold:
-                if deep and self._replication_factor(current.n) > 1:
-                    # the graph is too small to keep every device busy:
-                    # hand over to the mesh-subgroup replication phase
-                    # (deep_multilevel.cc:79-153 analog) below
-                    break
-                if self._is_compressed(current):
-                    # still-compressed fine level: stream shards from the
-                    # compressed rows (bitwise-identical result)
-                    dg = dist_graph_from_compressed(current, self.mesh)
-                    self._fine_dg = dg
-                else:
-                    dg = dist_graph_from_host(current, self.mesh)
-                mcw = max(
-                    1,
-                    c_ctx.max_cluster_weight(
-                        current.n, total_node_weight, ctx.partition
-                    ),
-                )
-                lvl_seed = (ctx.seed * 7919 + len(levels) * 31337) & 0x7FFFFFFF
-                from .mesh import comm_phase
-
-                with comm_phase(f"coarsening-L{len(levels)}"):
-                    labels = clusterer(dg, min(mcw, WMAX), jnp.int32(lvl_seed))
-                # singleton post-passes (two-hop + isolated packing) —
-                # the reference runs them wherever LP clusters
-                # (label_propagation.h:872-1191); without them low-degree
-                # graphs under-coarsen on the mesh
-                from .dist_lp import dist_singleton_postpasses
-
-                fine = current  # may be compressed; _plain caches decode
-                labels = dist_singleton_postpasses(
-                    current, np.asarray(labels), min(mcw, WMAX),
-                    materialize=lambda: self._plain(fine),
-                )
-                contracted = self._contract_level(current, dg, labels)
-                if contracted is None:  # converged
-                    break
-                coarse, cmap = contracted
-                levels.append((dg, cmap, current))
+        partition: Optional[np.ndarray] = None
+        spans = None
+        current_k: Optional[int] = None
+        num_levels_meta: Optional[int] = None
+        if resume is not None:
+            r_stage = resume["stage"]
+            r_level = resume.get("level")
+            meta = resume.get("meta", {})
+            levels, current = self._restore_dist_levels(
+                graph, resume["arrays"]
+            )
+            state = resume["arrays"].get("state")
+            if (
+                r_stage in ("dist-initial", "dist-uncoarsen")
+                and state is not None
+                and "partition" in state
+                and "spans" in state  # pre-v12 dist states lack spans:
+                # fall through to the level-only (or clean) restart
+            ):
+                partition = np.asarray(state["partition"], dtype=np.int32)
+                spans = [
+                    (int(f), int(c))
+                    for f, c in np.asarray(state["spans"]).tolist()
+                ]
+                current_k = int(meta.get("current_k", len(spans)))
+                num_levels_meta = meta.get("num_levels")
+            else:
+                # only hierarchy levels were recorded: re-enter the
+                # coarsening loop where it left off
+                r_stage = "dist-coarsen"
+            # replay the cluster maps into the quality recorder so the
+            # final attribution composes over the FULL hierarchy
+            for i, lvl in enumerate(levels):
                 quality_mod.note_cmap(
-                    level=len(levels), cmap=cmap, fine_n=current.n
+                    level=i + 1, cmap=lvl.cmap, fine_n=lvl.fine_host.n
                 )
-                if quality_mod.enabled():
-                    # coarsening-quality stats, host-side; compressed
-                    # fine levels skip the edge-weight sum (no decode)
-                    quality_mod.note_contraction_host(
-                        level=len(levels), coarse_host=coarse, cmap=cmap,
-                        fine_n=current.n, max_cluster_weight=mcw,
-                        total_node_weight=int(total_node_weight),
-                        fine_edge_weight=(
-                            None if self._is_compressed(current)
-                            else int(current.edge_weight_array().sum())
+            from .. import telemetry
+
+            telemetry.event(
+                "resume", scheme="dist", stage=r_stage, level=r_level,
+                levels_restored=len(levels),
+            )
+            log(
+                f"resumed dist pipeline at {r_stage}"
+                f"{'' if r_level is None else ':' + str(r_level)} "
+                f"({len(levels)} hierarchy level(s) restored)"
+            )
+        self._levels = levels
+
+        # coarsening (deep_multilevel.cc:75-118 analog); skipped
+        # entirely when the resume restored a partition already
+        skip_to_uncoarsen = partition is not None
+        threshold = max(2 * c_ctx.contraction_limit, k)
+        if not skip_to_uncoarsen:
+            with timer.scoped_timer("dist-coarsening"):
+                while current.n > threshold:
+                    if deep and self._replication_factor(current.n) > 1:
+                        # the graph is too small to keep every device
+                        # busy: hand over to the mesh-subgroup
+                        # replication phase (deep_multilevel.cc:79-153
+                        # analog) below
+                        break
+                    if self._is_compressed(current):
+                        # still-compressed fine level: stream shards from
+                        # the compressed rows (bitwise-identical result)
+                        dg = dist_graph_from_compressed(current, self.mesh)
+                        self._fine_dg = dg
+                    else:
+                        dg = dist_graph_from_host(current, self.mesh)
+                    mcw = max(
+                        1,
+                        c_ctx.max_cluster_weight(
+                            current.n, total_node_weight, ctx.partition
                         ),
                     )
-                current = coarse
-                from ..resilience import checkpoint as ckpt
+                    lvl_seed = (
+                        ctx.seed * 7919 + len(levels) * 31337
+                    ) & 0x7FFFFFFF
+                    from .mesh import comm_phase
 
-                if not ckpt.barrier(
-                    "dist-coarsen", level=len(levels), scheme="dist",
-                    agree=True,  # next level clusters collectively
-                ):
-                    break  # deadline wind-down: stop deepening
+                    with comm_phase(f"coarsening-L{len(levels)}"):
+                        labels = clusterer(
+                            dg, min(mcw, WMAX), jnp.int32(lvl_seed)
+                        )
+                    # singleton post-passes (two-hop + isolated packing)
+                    # — the reference runs them wherever LP clusters
+                    # (label_propagation.h:872-1191); without them
+                    # low-degree graphs under-coarsen on the mesh
+                    from .dist_lp import dist_singleton_postpasses
+
+                    fine = current  # may be compressed; _plain caches
+                    labels = dist_singleton_postpasses(
+                        current, np.asarray(labels), min(mcw, WMAX),
+                        materialize=lambda: self._plain(fine),
+                    )
+                    contracted = self._contract_level(current, dg, labels)
+                    if contracted is None:  # converged
+                        break
+                    coarse, cmap = contracted
+                    fine_n = int(current.n)
+                    levels.append(_DistLevel(current, cmap, dg, self.mesh))
+                    quality_mod.note_cmap(
+                        level=len(levels), cmap=cmap, fine_n=fine_n
+                    )
+                    if quality_mod.enabled():
+                        # coarsening-quality stats, host-side; compressed
+                        # fine levels skip the edge-weight sum (no decode)
+                        quality_mod.note_contraction_host(
+                            level=len(levels), coarse_host=coarse,
+                            cmap=cmap, fine_n=fine_n,
+                            max_cluster_weight=mcw,
+                            total_node_weight=int(total_node_weight),
+                            fine_edge_weight=(
+                                None if self._is_compressed(current)
+                                else int(current.edge_weight_array().sum())
+                            ),
+                        )
+                    current = coarse
+                    lvl_no = len(levels)
+                    if not ckpt.barrier(
+                        "dist-coarsen", level=lvl_no, scheme="dist",
+                        # the level snapshot: coarse host CSR + cmap —
+                        # deferred (disabled runs build nothing), prior
+                        # levels carried forward by reference
+                        payload=lambda c=coarse, cm=cmap, fn=fine_n,
+                        no=lvl_no: _dist_level_payload(no, c, cm, fn),
+                        keep=[f"dist-level-{j}" for j in range(1, lvl_no)],
+                        meta=self._dist_meta(num_levels=lvl_no),
+                        agree=True,  # next level clusters collectively
+                    ):
+                        break  # deadline wind-down: stop deepening
 
         # mesh-subgroup replication (deep_multilevel.cc:79-153 +
         # replicator.cc analog): the graph is too small for the whole
@@ -458,7 +616,8 @@ class dKaMinPar:
         # continues into the main uncoarsening below
         replicated = False
         if (
-            deep
+            not skip_to_uncoarsen
+            and deep
             and current.n > threshold
             and self._replication_factor(current.n) > 1
         ):
@@ -475,7 +634,9 @@ class dKaMinPar:
         # on the mesh during uncoarsening; KWAY partitions at full k.
         # With no dist levels there is nothing to double over — the shm
         # IP result IS the final partition, so it must run at full k.
-        if replicated:
+        if skip_to_uncoarsen:
+            ip_k = int(current_k)  # the resumed partition's k
+        elif replicated:
             pass
         elif deep and levels:
             from ..partitioning.deep import compute_k_for_n
@@ -483,7 +644,8 @@ class dKaMinPar:
             ip_k = max(2, min(k, compute_k_for_n(current.n, self.ctx.shm)))
         else:
             ip_k = k
-        spans = self._initial_spans(ip_k, k)
+        if spans is None:
+            spans = self._initial_spans(ip_k, k)
 
         # initial partitioning: shm pipeline on the coarsest graph.  The
         # reference replicates the coarsest graph onto every PE, runs shm
@@ -493,11 +655,11 @@ class dKaMinPar:
         # the mesh-subgroup replication phase ran, each replica already
         # carried its own IP and the best partition was selected there;
         # otherwise one host plays all PEs with independent seeded runs.
-        if not replicated:
+        best_cut = None
+        if not replicated and not skip_to_uncoarsen:
             with timer.scoped_timer("dist-initial-partitioning"):
                 num_replicas = max(1, min(self.mesh.devices.size, 4))
                 partition = None
-                best_cut = None
                 for r in range(num_replicas):
                     cand = self._initial_partition(
                         self._plain(current), ip_k, k, spans,
@@ -506,9 +668,18 @@ class dKaMinPar:
                     cut = self._host_cut(self._plain(current), cand)
                     if best_cut is None or cut < best_cut:
                         partition, best_cut = cand, cut
-        from ..resilience import checkpoint as ckpt
-
-        ckpt.barrier("dist-initial", level=len(levels), scheme="dist")
+        if not skip_to_uncoarsen:
+            part_ip, spans_ip = partition, spans
+            ckpt.barrier(
+                "dist-initial", level=len(levels), scheme="dist",
+                payload=lambda: _dist_state_payload(part_ip, spans_ip),
+                keep=[
+                    f"dist-level-{j}" for j in range(1, len(levels) + 1)
+                ],
+                meta=self._dist_meta(
+                    num_levels=len(levels), current_k=int(ip_k),
+                ),
+            )
         # quality: the coarsest level's cut — dist runs no coarsest-level
         # refinement, so projected == refined there (both recorded so
         # the level still gets an attribution row)
@@ -530,13 +701,24 @@ class dKaMinPar:
         # partition on the mesh while the level's size supports more
         # blocks (the extend_partition lineage, helper.cc:220)
         current_k = ip_k
-        num_levels = len(levels)
+        # num_levels is the FULL hierarchy depth — after a resume whose
+        # keep-list already pruned consumed levels, len(levels) < depth,
+        # and the per-level seeds below must match the uninterrupted
+        # run's (cut-identical resume)
+        num_levels = (
+            int(num_levels_meta) if num_levels_meta else len(levels)
+        )
+        start_level = (
+            int(r_level) if r_stage == "dist-uncoarsen" and r_level
+            is not None else len(levels)
+        )
         with timer.scoped_timer("dist-uncoarsening"):
-            for level_idx, (dg, cmap, fine_host) in enumerate(
-                reversed(levels)
-            ):
-                partition = partition[cmap]  # project up
-                level = num_levels - 1 - level_idx
+            for level in range(start_level - 1, -1, -1):
+                lvl = levels[level]
+                dg = lvl.dg()  # rebuilt on demand when spilled/resumed
+                fine_host = lvl.fine_host
+                partition = partition[lvl.cmap]  # project up
+                level_idx = num_levels - 1 - level
                 cut = self._quality_cut(dg, fine_host.n, partition)
                 if cut is not None:
                     quality_mod.note_projected(level, cut=cut, k=current_k)
@@ -565,21 +747,37 @@ class dKaMinPar:
                         level, cut=cut, k=current_k,
                         spans=spans, input_k=k,
                     )
-                part_now, k_now = partition, current_k
+                part_now, spans_now, k_now = partition, spans, current_k
                 ckpt.barrier(
                     "dist-uncoarsen", level=level, scheme="dist",
-                    payload=lambda: _ckpt_partition_payload(part_now),
-                    meta={"current_k": int(k_now)},
+                    payload=lambda: _dist_state_payload(part_now, spans_now),
+                    # levels 0..level-1 are still pending; their fine
+                    # CSRs/cmaps live in snapshots 1..level
+                    keep=[f"dist-level-{j}" for j in range(1, level + 1)],
+                    meta=self._dist_meta(
+                        num_levels=num_levels, current_k=int(k_now),
+                    ),
                 )
-        # final extensions to k (finest level)
-        if deep and (levels or replicated) and current_k < k:
+        # final extensions to k (finest level).  `skip_to_uncoarsen`
+        # joins the condition: a resume at dist-uncoarsen:0 with
+        # current_k < k has already PRUNED every level snapshot (the
+        # keep list at the finest barrier is empty), so `levels` is
+        # empty — but the restored partition lives on the input graph
+        # and must extend on the mesh exactly like the uninterrupted
+        # run would; the shm fallback below would discard it
+        if (
+            deep
+            and (levels or replicated or skip_to_uncoarsen)
+            and current_k < k
+        ):
             if levels:
-                dg, _, fine_host = levels[0]
+                lvl0 = levels[0]
+                dg, fine_host = lvl0.dg(), lvl0.fine_host
             else:
-                # replication fired at the input level: no dist levels
-                # exist, but the split-level graph (= the input) still
-                # extends on the mesh — the shm fallback below would
-                # discard the replicated phase's partition
+                # replication fired at the input level (or a finest-
+                # barrier resume restored an all-levels-pruned state):
+                # no dist levels exist, but the finest-level graph
+                # (= the input) still extends on the mesh
                 fine_host = self._plain(current)
                 dg = dist_graph_from_host(fine_host, self.mesh)
             while current_k < k:
@@ -993,6 +1191,132 @@ class dKaMinPar:
         ew = graph.edge_weight_array()
         return int(ew[partition[src] != partition[graph.adjncy]].sum() // 2)
 
+    # -- dist resilience (resilience/{checkpoint,memory,agreement}.py) --
+
+    def _dist_meta(self, num_levels: int,
+                   current_k: Optional[int] = None) -> dict:
+        """Barrier manifest meta: the per-rank shard-fingerprint vector
+        (device-count-change detection on resume), the FULL hierarchy
+        depth (per-level seeds must survive keep-list pruning), and the
+        current k."""
+        meta = {
+            "shards": list(self._shard_fps),
+            "num_levels": int(num_levels),
+        }
+        if current_k is not None:
+            meta["current_k"] = int(current_k)
+        return meta
+
+    def _restore_dist_levels(self, graph, arrays):
+        """Rebuild the dist hierarchy from ``dist-level-<i>`` snapshots:
+        chain the coarse host CSRs (snapshot i holds contraction i's
+        coarse graph + cmap; the fine side of level 0 is the input
+        graph, carried by reference through the graph fingerprint).
+        The sharded DistGraphs are NOT serialized — dist_graph_from_host
+        is deterministic, so each level's is rebuilt on demand, exactly
+        like the rung-2 spill path.  Returns (levels, coarsest)."""
+        names = sorted(
+            (nm for nm in arrays if nm.startswith("dist-level-")),
+            key=lambda s: int(s.rsplit("-", 1)[1]),
+        )
+        levels: List[_DistLevel] = []
+        fine = graph
+        for nm in names:
+            a = arrays[nm]
+            coarse = HostGraph(
+                xadj=np.asarray(a["xadj"], dtype=np.int64),
+                adjncy=np.asarray(a["adjncy"], dtype=np.int32),
+                node_weights=np.asarray(a["node_w"]),
+                edge_weights=(
+                    np.asarray(a["edge_w"]) if a["edge_w"].size else None
+                ),
+            )
+            levels.append(
+                _DistLevel(
+                    fine, np.asarray(a["cmap"], dtype=np.int32), None,
+                    self.mesh,
+                )
+            )
+            fine = coarse
+        return levels, fine
+
+    def spill_cold_levels(self) -> int:
+        """Memory-governor spiller hook (resilience/memory.py rung >= 2
+        and the barrier pressure path): drop EVERY per-level sharded
+        DistGraph — during coarsening the next level builds its own
+        (the loop's local still references the hot one), and
+        uncoarsening rebuilds each level's on demand from its host CSR
+        (deterministic builder => cut-identical).  Also releases the
+        retained finest-level sharded graph of a compressed input (the
+        result cut then degrades to the host path).  Returns the device
+        bytes released."""
+        from .dist_graph import dist_graph_bytes
+
+        freed = 0
+        for lvl in self._levels or []:
+            freed += lvl.spill()
+        if self._fine_dg is not None:
+            freed += dist_graph_bytes(self._fine_dg)
+            self._fine_dg = None
+        if freed:
+            from .. import telemetry
+            from ..resilience import memory as memory_mod
+
+            memory_mod.note_spill(freed)
+            telemetry.event(
+                "memory-spill", bytes=freed, kind="dist-levels",
+            )
+        return freed
+
+
+class _DistLevel:
+    """One dist coarsening level: the fine-side host graph (by
+    reference; plain or compressed), the fine->coarse cluster map, and
+    the sharded DistGraph over the fine graph.  The DistGraph is a
+    deterministic CACHE (dist_graph_from_host / _from_compressed always
+    rebuild the identical arrays), so the rung-2 spill and the
+    full-hierarchy resume both drop it and rebuild on demand —
+    cut-identical by construction."""
+
+    __slots__ = ("fine_host", "cmap", "_dg", "_mesh")
+
+    def __init__(self, fine_host, cmap, dg, mesh):
+        self.fine_host = fine_host
+        self.cmap = np.asarray(cmap, dtype=np.int32)
+        self._dg = dg
+        self._mesh = mesh
+
+    def dg(self) -> DistGraph:
+        if self._dg is None:
+            from ..graphs.compressed import CompressedHostGraph
+            from .dist_graph import dist_graph_bytes
+
+            if isinstance(self.fine_host, CompressedHostGraph):
+                self._dg = dist_graph_from_compressed(
+                    self.fine_host, self._mesh
+                )
+            else:
+                self._dg = dist_graph_from_host(self.fine_host, self._mesh)
+            nbytes = dist_graph_bytes(self._dg)
+            from .. import telemetry
+            from ..resilience import memory as memory_mod
+
+            memory_mod.note_reload(nbytes)
+            telemetry.event(
+                "memory-reload", bytes=nbytes, kind="dist-level",
+            )
+        return self._dg
+
+    def spill(self) -> int:
+        """Drop the sharded arrays (0 when already spilled)."""
+        if self._dg is None:
+            return 0
+        from .dist_graph import dist_graph_bytes
+
+        nbytes = dist_graph_bytes(self._dg)
+        self._dg = None
+        return nbytes
+
 
 def dist_edge_cut_of(graph: DistGraph, labels) -> int:
     """Convenience wrapper mirroring dist::metrics::edge_cut."""
@@ -1003,3 +1327,39 @@ def _ckpt_partition_payload(partition) -> dict:
     """Checkpoint barrier payload: the current (already host-side)
     partition — deferred by the barrier, so disabled runs build nothing."""
     return {"state": {"partition": np.asarray(partition, dtype=np.int32)}}
+
+
+def _dist_level_payload(level_no: int, coarse: HostGraph, cmap, fine_n: int,
+                        ) -> dict:
+    """One dist hierarchy level as a named snapshot (contraction
+    ``level_no``'s coarse host CSR + fine->coarse cmap) — the dist twin
+    of partitioning/coarsener.newest_level_snapshot.  Deferred by the
+    barrier, so disabled runs build nothing; levels are serialized once
+    and carried forward by reference (``keep``)."""
+    return {
+        f"dist-level-{int(level_no)}": {
+            "xadj": np.asarray(coarse.xadj, dtype=np.int64),
+            "adjncy": np.asarray(coarse.adjncy, dtype=np.int32),
+            "node_w": np.asarray(coarse.node_weight_array()),
+            "edge_w": np.asarray(coarse.edge_weight_array()),
+            "cmap": np.asarray(cmap, dtype=np.int32),
+            "dims": np.asarray(
+                [int(fine_n), int(coarse.n), int(coarse.m)], dtype=np.int64
+            ),
+        }
+    }
+
+
+def _dist_state_payload(partition, spans) -> dict:
+    """The dist driver's state snapshot: the current partition plus the
+    block spans (first final block, count) the current k was built
+    from — everything a dist-initial / dist-uncoarsen re-entry needs
+    beyond the hierarchy levels."""
+    return {
+        "state": {
+            "partition": np.asarray(partition, dtype=np.int32),
+            "spans": np.asarray(
+                [[int(f), int(c)] for f, c in spans], dtype=np.int64
+            ),
+        }
+    }

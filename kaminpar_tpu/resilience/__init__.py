@@ -36,6 +36,7 @@ from .errors import (  # noqa: F401
     DeviceOOM,
     NativeUnavailable,
     PlanBlowup,
+    RankDivergence,
     RefinerRefused,
     classify,
 )
@@ -58,14 +59,18 @@ from .policy import (  # noqa: F401
 from . import gate  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import deadline  # noqa: F401
+from . import agreement  # noqa: F401
 
 
 def reset() -> None:
     """Reset injection counters, circuit breakers, the active checkpoint
-    manager, and any armed deadline (test isolation)."""
+    manager, any armed deadline, and the dist agreement/sentinel state
+    (test isolation)."""
     from . import faults as _faults
 
     _faults.reset()
     reset_breakers()
     checkpoint.deactivate()
     deadline.clear()
+    agreement.disarm()
+    agreement.set_gather_override(None)

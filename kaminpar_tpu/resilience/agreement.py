@@ -1,0 +1,286 @@
+"""Cross-rank agreement primitives and the divergence sentinels.
+
+A multi-chip run is the *most* likely member of the fleet to be
+preempted or OOM-killed and — before this module — was the least able
+to recover: every cross-rank decision (wind down? retry at which memory
+rung? is everyone still at the same pipeline stage?) was either local
+(one sick rank deadlocks the survivors inside ``shard_map`` collectives)
+or missing entirely (silent rank divergence surfaced, if ever, as a
+hung collective or a wrong answer).  This module centralizes the one
+idiom every such decision shares — a small host-side allgather over
+per-rank scalars, max-reduced into a verdict every rank adopts — and
+builds two users on top of it:
+
+  * **agreement** — :func:`agree_max` is the allgather-max primitive
+    behind ``deadline.agreed_stop`` (wind-down verdicts) and
+    ``memory.agree_rung`` (the cross-rank agreed OOM-ladder rung): any
+    rank proposing a higher value raises every rank to it, so control
+    flow that gates collective work takes the same branch everywhere.
+  * **divergence sentinels** — :func:`audit`, piggybacked on the
+    checkpoint barrier hook by the dist driver: one small allgather of
+    ``[stage-hash, ladder-rung, run-fingerprint-hash]`` per barrier.
+    Ranks that disagree on any of the three have silently diverged
+    (missed a barrier, took a different ladder rung, or are running a
+    different graph/config), and the sentinel converts that into a
+    structured :class:`~kaminpar_tpu.resilience.errors.RankDivergence`
+    carrying a per-rank state dump — annotated into the run report
+    before the raise, so even the emergency report shows which rank
+    went where.
+
+Rank model: ``rank()``/``num_ranks()`` are ``jax.process_index()`` /
+``jax.process_count()`` (this repo's usual single-process virtual-device
+mesh is one rank).  Two override layers exist for tests and smokes:
+
+  * ``KAMINPAR_TPU_SIM_RANK`` / ``KAMINPAR_TPU_SIM_RANKS`` — pretend to
+    be rank K of N (rank-scoped fault addressing, ``site@rank=K``, uses
+    this to exercise "the fault fires on rank 1, not on rank 0" in a
+    single-process smoke);
+  * :func:`set_gather_override` — replace the collective itself, so a
+    test can present a *divergent* fleet to the sentinel or a
+    higher-rung peer to the ladder agreement without spawning
+    processes.
+
+Everything here is host-side numpy between launches; with one process
+and no overrides, every gather degenerates to the local row and the
+sentinel compares a vector with itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from . import runstate
+
+#: Simulation overrides (tests / single-process chaos smokes): pretend
+#: to be rank SIM_RANK of SIM_RANKS.  They scope fault addressing and
+#: the report's rank stamp; they do NOT spawn processes or change what
+#: the real collectives do.
+ENV_SIM_RANK = "KAMINPAR_TPU_SIM_RANK"
+ENV_SIM_RANKS = "KAMINPAR_TPU_SIM_RANKS"
+
+#: Test hook: replaces the cross-process allgather.  Signature
+#: ``f(local_row: np.ndarray[int64]) -> np.ndarray[num_ranks, len]``;
+#: install with :func:`set_gather_override`, clear with None.
+_gather_override: Optional[Callable[[np.ndarray], np.ndarray]] = None
+
+
+def set_gather_override(
+    fn: Optional[Callable[[np.ndarray], np.ndarray]]
+) -> None:
+    """Install (or with None clear) the allgather test hook — lets a
+    single-process test present a divergent or N-rank fleet to the
+    sentinel/agreement layer."""
+    global _gather_override
+    _gather_override = fn
+
+
+def rank() -> int:
+    """This process's rank: the SIM override when set, else
+    ``jax.process_index()`` (0 without a live backend)."""
+    raw = os.environ.get(ENV_SIM_RANK, "")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    try:
+        from ..utils.platform import process_index
+
+        return process_index()
+    except Exception:
+        return 0
+
+
+def num_ranks() -> int:
+    """Fleet size: the SIM override when set, else
+    ``jax.process_count()`` (1 without a live backend)."""
+    raw = os.environ.get(ENV_SIM_RANKS, "")
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    try:
+        from ..utils.platform import process_count
+
+        return process_count()
+    except Exception:
+        return 1
+
+
+def gather_i64(row) -> np.ndarray:
+    """Allgather one small int64 row: returns ``[ranks, len(row)]``.
+
+    The single shared collective of the agreement layer: the test
+    override first, the real ``multihost_utils.process_allgather`` on a
+    multi-process fleet, and the local row alone (shape ``[1, len]``)
+    on the usual one-process mesh — never a device launch."""
+    local = np.asarray(row, dtype=np.int64).reshape(-1)
+    if _gather_override is not None:
+        out = np.asarray(_gather_override(local), dtype=np.int64)
+        return out.reshape(-1, local.shape[0])
+    from ..utils.platform import process_count
+
+    nproc = process_count()
+    if nproc <= 1:
+        return local[None, :]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(local)
+    ).reshape(nproc, -1)
+
+
+def agree_max(value: int) -> Tuple[int, int]:
+    """The allgather-max agreement: every rank contributes ``value`` and
+    adopts the fleet maximum.  Returns ``(agreed, triggering_rank)`` —
+    the rank whose contribution WAS the maximum (lowest such rank), so
+    degradations can name who pulled the fleet down.  Single rank: the
+    identity."""
+    rows = gather_i64([int(value)])
+    vec = rows[:, 0]
+    trig = int(np.argmax(vec))
+    return int(vec.max()), trig
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinels
+# ---------------------------------------------------------------------------
+
+
+class AuditState:
+    """One dist run's sentinel state (held on the thread-local RunState,
+    armed only by the stream-owning dist driver)."""
+
+    __slots__ = ("scheme", "fp_hash", "audits", "stage", "divergence")
+
+    def __init__(self, scheme: str, fp_hash: int) -> None:
+        self.scheme = scheme
+        self.fp_hash = fp_hash
+        self.audits = 0
+        self.stage = ""
+        self.divergence: Optional[dict] = None
+
+
+def _hash63(text: str) -> int:
+    """Stable non-negative 63-bit hash (int64-safe for the gather)."""
+    digest = hashlib.sha256(text.encode()).digest()
+    return int.from_bytes(digest[:8], "big") & 0x7FFFFFFFFFFFFFFF
+
+
+def arm(scheme: str, graph_fp: str, ctx_fp: str,
+        shard_fps: List[str]) -> AuditState:
+    """Arm the divergence sentinels for the calling thread's run (dist
+    facade entry): every subsequent checkpoint barrier audits the fleet
+    until :func:`disarm`.  The three fingerprints fold into ONE hash —
+    ranks running a different graph, config, or sharding plan disagree
+    on it at the first barrier."""
+    st = AuditState(
+        scheme, _hash63(f"{graph_fp}|{ctx_fp}|{'|'.join(shard_fps)}")
+    )
+    runstate.current().dist = st
+    return st
+
+
+def disarm() -> None:
+    runstate.current().dist = None
+
+
+def state() -> Optional[AuditState]:
+    return getattr(runstate.current(), "dist", None)
+
+
+def maybe_audit(stage_id: str) -> None:
+    """The barrier piggyback: a no-op (one attribute read) unless the
+    dist driver armed the sentinels; armed, one small allgather of
+    ``[stage-hash, rung, fingerprint-hash]`` and an exact comparison.
+    Divergence raises :class:`RankDivergence` with the per-rank dump —
+    annotated into the run report FIRST, so the dump survives into the
+    emergency report of the run the raise unwinds."""
+    st = state()
+    if st is None:
+        return
+    from .errors import RankDivergence
+    from .faults import maybe_inject
+
+    # chaos site: an injected rank-divergence exercises the abort path
+    # without needing a genuinely skewed fleet
+    maybe_inject("rank-divergence")
+    from . import memory as memory_mod
+
+    mem = memory_mod.state()
+    rung = int(mem.rung) if mem is not None else 0
+    local = [_hash63(stage_id), rung, st.fp_hash]
+    rows = gather_i64(local)
+    st.stage = stage_id
+    if bool((rows == rows[0]).all()):
+        st.audits += 1
+        return
+    dump = [
+        {
+            "rank": r,
+            "stage_hash": int(rows[r, 0]),
+            "rung": int(rows[r, 1]),
+            "fingerprint_hash": int(rows[r, 2]),
+            # only the local rank knows its stage STRING; peers are
+            # identified by hash (enough to see who skewed where)
+            **({"stage": stage_id} if r == rank() else {}),
+        }
+        for r in range(rows.shape[0])
+    ]
+    fields = []
+    if not bool((rows[:, 0] == rows[0, 0]).all()):
+        fields.append("stage")
+    if not bool((rows[:, 1] == rows[0, 1]).all()):
+        fields.append("rung")
+    if not bool((rows[:, 2] == rows[0, 2]).all()):
+        fields.append("fingerprint")
+    st.divergence = {
+        "barrier": stage_id,
+        "fields": fields,
+        "ranks": dump,
+    }
+    from .. import telemetry
+    from ..utils.logger import log_warning
+
+    telemetry.event(
+        "rank-divergence", barrier=stage_id, fields=fields,
+        ranks=len(dump),
+    )
+    # stamp the dump NOW: the raise below unwinds past the facade's
+    # success-path annotations, and the per-rank dump is exactly what a
+    # post-crash report must carry
+    telemetry.annotate(dist_resilience=section())
+    log_warning(
+        f"rank divergence at barrier {stage_id}: ranks disagree on "
+        f"{'/'.join(fields)} — aborting with the per-rank dump"
+    )
+    raise RankDivergence(
+        f"ranks diverged at barrier {stage_id} on {'/'.join(fields)}: "
+        f"{dump}",
+        ranks=dump,
+        site="rank-divergence",
+    )
+
+
+def section() -> dict:
+    """The run report's ``dist_resilience`` sentinel half (the dist
+    driver merges in resume/ladder details).  ``{'enabled': False}``
+    when no dist run armed the sentinels on this thread."""
+    st = state()
+    if st is None:
+        return {"enabled": False}
+    d = {
+        "enabled": True,
+        "ranks": num_ranks(),
+        "rank": rank(),
+        "audits": int(st.audits),
+        "last_stage": st.stage,
+    }
+    if st.divergence is not None:
+        d["divergence"] = st.divergence
+    return d

@@ -171,6 +171,15 @@ def barrier(
         # nested (suspended) runs neither track stages nor checkpoint —
         # but they DO honor the wind-down verdict below
         deadline.note_stage(stage_id)
+        # divergence sentinel (resilience/agreement.py), armed only by
+        # the stream-owning dist driver: one small allgather of
+        # [stage-hash, rung, run-fingerprint-hash] per barrier, BEFORE
+        # the checkpoint offer — a diverged fleet must abort with the
+        # per-rank dump, not persist a skewed manifest.  One attribute
+        # read for shm runs.
+        from . import agreement
+
+        agreement.maybe_audit(stage_id)
         # device-memory watermark: the perf observatory samples the
         # resident-bytes figure at exactly these multilevel barriers
         # (host side, between launches; one bool check when disabled)
@@ -602,6 +611,33 @@ class CheckpointManager:
             "generation": int(man.get("generation", 0)),
             "snapshot_entries": dict(man.get("snapshots", {})),
         }
+
+    def pending_resume(self) -> Optional[dict]:
+        """The loaded-but-unconsumed resume state (None once taken) —
+        lets a driver VALIDATE driver-specific preconditions (the dist
+        shard-fingerprint vector) before any scheme dispatch consumes
+        it."""
+        if self._resume is None or self._resume_taken:
+            return None
+        return self._resume
+
+    def drop_resume(self, reason: str) -> None:
+        """Discard the pending resume state: a driver-level mismatch
+        (e.g. a resume under a different device count, detected via the
+        shard fingerprints) degrades to a logged clean restart — the
+        CheckpointMismatch policy, applied after load-time validation
+        passed.  Never a crash, never a wrong answer."""
+        if self._resume is None:
+            return
+        from .. import telemetry
+        from ..utils.logger import log_warning
+
+        log_warning(f"--resume: {reason}; starting a clean run")
+        telemetry.event(
+            "checkpoint", action="clean-restart", error=reason[:300],
+        )
+        self._resume = None
+        self._resume_taken = False
 
     def take_resume(self, scheme: str) -> Optional[dict]:
         if (

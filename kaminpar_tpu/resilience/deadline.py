@@ -101,19 +101,12 @@ def agreed_stop() -> bool:
     """
     local = should_stop()
     try:
-        from ..utils.platform import process_count
+        # one shared agreement primitive (resilience/agreement.py):
+        # allgather-max over the per-rank verdicts — the same idiom the
+        # memory ladder's agreed rung uses, and the same test hook
+        from .agreement import agree_max
 
-        if process_count() <= 1:
-            return local
-        import numpy as np
-        from jax.experimental import multihost_utils
-
-        flags = np.asarray(
-            multihost_utils.process_allgather(
-                np.asarray([1 if local else 0], dtype=np.int32)
-            )
-        )
-        agreed = bool(flags.max())
+        agreed = bool(agree_max(1 if local else 0)[0])
     except Exception:
         return local
     if agreed and not local:

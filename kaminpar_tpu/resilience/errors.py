@@ -129,6 +129,30 @@ class CacheDegraded(DegradationError):
     breaker_relevant = False
 
 
+class RankDivergence(DegradationError):
+    """The cross-rank divergence sentinel fired: at a dist pipeline
+    barrier the ranks disagreed on the stage id, the memory-ladder rung,
+    or the run fingerprint (graph/ctx/sharding plan) — one rank silently
+    skipped a barrier, took a different recovery path, or is running a
+    different problem.  There is no safe local fallback (continuing
+    would deadlock a collective or return a wrong answer), so this is a
+    structured ABORT carrying ``ranks``, the per-rank state dump the
+    sentinel gathered (also annotated into the run report's
+    ``dist_resilience`` section before the raise).  Crash-shaped: it
+    advances the circuit breaker."""
+
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        ranks=None,
+        site: Optional[str] = None,
+        injected: bool = False,
+    ) -> None:
+        super().__init__(message, site=site, injected=injected)
+        self.ranks = list(ranks or [])
+
+
 class DeviceOOM(DegradationError):
     """The accelerator (or host, for MemoryError) ran out of memory in an
     optional fast path.  Fallback: the path's smaller-footprint twin

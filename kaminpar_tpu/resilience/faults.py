@@ -9,9 +9,14 @@ truth for the chaos suite, the run-report fault-plan echo, and the docs.
 
 Injection plans come from the environment::
 
-    KAMINPAR_TPU_FAULTS=site[:spec][,site[:spec]...]
+    KAMINPAR_TPU_FAULTS=site[@rank=K][:spec][,site[@rank=K][:spec]...]
 
-where ``site`` is a registered name or ``all``, and ``spec`` is
+where ``site`` is a registered name or ``all``, ``@rank=K`` scopes the
+rule to process rank K only (``device-oom@rank=1:nth=1`` faults exactly
+one rank of a multi-process fleet — the chaos address for "one sick
+rank"; on the usual single-process mesh the local rank is 0, and
+``KAMINPAR_TPU_SIM_RANK`` lets a smoke impersonate another rank — see
+resilience/agreement.py), and ``spec`` is
 
   * omitted or ``always`` — every call at the site fails,
   * ``nth=K``            — exactly the K-th call at the site fails
@@ -42,6 +47,7 @@ from .errors import (
     DeviceOOM,
     NativeUnavailable,
     PlanBlowup,
+    RankDivergence,
     RefinerRefused,
 )
 
@@ -134,10 +140,18 @@ _register(SiteSpec(
 _register(SiteSpec(
     "device-oom", DeviceOOM,
     "memory-governor recovery ladder: retry at the next rung "
-    "(tight pads -> spilled hierarchy -> semi-external -> host-only)",
+    "(tight pads -> spilled hierarchy -> semi-external -> host-only; "
+    "dist runs agree the rung across ranks first)",
     "allocator-shaped OOM at device upload / contraction / refinement "
     "(resilience/memory.py ladder; ladder-retryable OOMs never latch "
     "the serving per-class breaker — only rung exhaustion does)",
+))
+_register(SiteSpec(
+    "rank-divergence", RankDivergence,
+    "none — structured abort with the per-rank state dump (divergence "
+    "has no safe local fallback)",
+    "cross-rank divergence sentinel at the dist pipeline barriers "
+    "(resilience/agreement.py audit)",
 ))
 
 
@@ -146,6 +160,7 @@ class _FaultRule:
     site: str  # registered name or "all"
     prob: Optional[float] = None  # None => deterministic (always / nth)
     nth: Optional[int] = None  # 1-based exact call index
+    rank: Optional[int] = None  # None => every rank; K => rank K only
 
 
 @dataclass
@@ -172,6 +187,26 @@ def parse_plan(raw: str) -> List[_FaultRule]:
             continue
         site, _, spec = part.partition(":")
         site = site.strip()
+        # rank scoping: `site@rank=K` restricts the rule to process
+        # rank K (the single-sick-rank chaos address)
+        rank: Optional[int] = None
+        if "@" in site:
+            site, _, rank_spec = site.partition("@")
+            site = site.strip()
+            rank_spec = rank_spec.strip()
+            if not rank_spec.startswith("rank="):
+                raise FaultPlanError(
+                    f"bad rank scope {rank_spec!r} in {part!r} "
+                    "(want site@rank=K)"
+                )
+            try:
+                rank = int(rank_spec[5:])
+            except ValueError:
+                raise FaultPlanError(
+                    f"bad rank number in {part!r} (want site@rank=K)"
+                )
+            if rank < 0:
+                raise FaultPlanError(f"rank must be >= 0 in {part!r}")
         if site != "all" and site not in SITES:
             raise FaultPlanError(
                 f"unknown fault site {site!r} (registered: "
@@ -179,7 +214,7 @@ def parse_plan(raw: str) -> List[_FaultRule]:
             )
         spec = spec.strip()
         if not spec or spec == "always":
-            rules.append(_FaultRule(site))
+            rules.append(_FaultRule(site, rank=rank))
         elif spec.startswith("nth="):
             try:
                 nth = int(spec[4:])
@@ -187,7 +222,7 @@ def parse_plan(raw: str) -> List[_FaultRule]:
                 raise FaultPlanError(f"bad nth spec {spec!r} for {site!r}")
             if nth < 1:
                 raise FaultPlanError(f"nth must be >= 1 in {part!r}")
-            rules.append(_FaultRule(site, nth=nth))
+            rules.append(_FaultRule(site, nth=nth, rank=rank))
         else:
             try:
                 prob = float(spec)
@@ -198,7 +233,7 @@ def parse_plan(raw: str) -> List[_FaultRule]:
                 )
             if not 0.0 < prob <= 1.0:
                 raise FaultPlanError(f"probability out of (0, 1] in {part!r}")
-            rules.append(_FaultRule(site, prob=prob))
+            rules.append(_FaultRule(site, prob=prob, rank=rank))
     return rules
 
 
@@ -235,9 +270,17 @@ def maybe_inject(site: str, **attrs) -> None:
     count = _counters.get(site, 0) + 1
     _counters[site] = count
     fire = False
+    local_rank: Optional[int] = None
     for rule in plan.rules:
         if rule.site != "all" and rule.site != site:
             continue
+        if rule.rank is not None:
+            if local_rank is None:
+                from .agreement import rank as _rank
+
+                local_rank = _rank()
+            if rule.rank != local_rank:
+                continue  # scoped to a different rank: rule inert here
         if rule.nth is not None:
             fire = count == rule.nth
         elif rule.prob is not None:
@@ -248,7 +291,12 @@ def maybe_inject(site: str, **attrs) -> None:
             break
     if not fire:
         return
-    _injected.append({"site": site, "call": count})
+    entry = {"site": site, "call": count}
+    if rule.rank is not None:
+        # a rank-scoped rule fired: record WHERE (unscoped entries keep
+        # their historical two-key shape)
+        entry["rank"] = int(rule.rank)
+    _injected.append(entry)
     raise spec.exc(
         f"injected fault at site '{site}' (call #{count}, "
         f"{ENV_VAR}={plan.raw})",

@@ -339,12 +339,21 @@ def _ensure_state() -> GovernorState:
     return st
 
 
-def begin_run(graph: Any, ctx: Any) -> Optional[GovernorState]:
+def begin_run(graph: Any, ctx: Any,
+              price_shape: Optional[Tuple[int, int]] = None
+              ) -> Optional[GovernorState]:
     """Arm the governor for one stream-owning run (facade entry): price
     the run, pick the starting rung (the forced test rung, else the
     lowest rung whose estimate fits the declared budget), and emit the
     `memory-budget` telemetry event when a budget is in force.  Returns
-    None (and stays dormant) under the kill switch."""
+    None (and stays dormant) under the kill switch.
+
+    ``price_shape=(n, m)`` overrides the PRICED shape: the dist driver
+    passes its sharding plan's actual max padded shard (the budget is
+    per-device and the node/edge arrays shard across the mesh — pricing
+    the whole graph would refuse or over-rung a multi-chip run that
+    fits after sharding, and pricing ``ceil/devices`` would undercount
+    the heaviest rank of a skewed edge distribution)."""
     if not governor_enabled():
         run = runstate.current()
         run.memory = None
@@ -352,7 +361,10 @@ def begin_run(graph: Any, ctx: Any) -> Optional[GovernorState]:
     st = GovernorState()
     runstate.current().memory = st
     st.budget = budget_bytes(ctx)
-    n, m = int(graph.n), int(graph.m)
+    if price_shape is not None:
+        n, m = int(price_shape[0]), int(price_shape[1])
+    else:
+        n, m = int(graph.n), int(graph.m)
     k = int(getattr(ctx.partition, "k", 2) or 2)
     st.graph_shape = (n, m, k)
     st.bucket = "/".join(str(x) for x in padded_bucket(n, m, k))
@@ -536,14 +548,14 @@ def preflight(n: int, m: int, k: int, where: str = "") -> None:
 
 
 def _emit_rung_event(st: GovernorState, error: str, detail: str,
-                     injected: bool = False) -> None:
+                     injected: bool = False,
+                     triggering_rank: Optional[int] = None) -> None:
     from .. import telemetry
     from ..utils.logger import log_warning
     from .faults import SITES
 
     spec = SITES.get("device-oom")
-    telemetry.event(
-        "degraded",
+    attrs = dict(
         site="device-oom",
         error=error,
         detail=detail[:300],
@@ -554,9 +566,18 @@ def _emit_rung_event(st: GovernorState, error: str, detail: str,
         rung=st.rung,
         rung_name=RUNG_NAMES.get(st.rung, str(st.rung)),
     )
+    if triggering_rank is not None:
+        # agreed dist transitions name the rank whose proposal pulled
+        # the fleet to this rung (shm transitions omit the key)
+        attrs["triggering_rank"] = int(triggering_rank)
+    telemetry.event("degraded", **attrs)
     log_warning(
         f"memory governor: {error} ({detail[:120]}); retrying at rung "
         f"{st.rung} ({RUNG_NAMES.get(st.rung)})"
+        + (
+            "" if triggering_rank is None
+            else f" [agreed; triggered by rank {triggering_rank}]"
+        )
     )
 
 
@@ -657,6 +678,135 @@ def _attempt_at_rung(rung: int, attempt: Callable[[], np.ndarray],
         with caching.pad_policy_scope("tight"):
             return semi_external_partition(graph, ctx, facade)
     return host_only_partition(graph, ctx)
+
+
+# ---------------------------------------------------------------------------
+# the distributed (cross-rank agreed) ladder
+# ---------------------------------------------------------------------------
+
+#: The dist driver's rung order: semi-external is skipped (host-chunked
+#: coarsening has no sharded-contraction analog — a dist run that
+#: cannot even hold the spilled shard hierarchy goes straight to the
+#: host-only path, which needs no device at all).
+DIST_RUNG_ORDER = (
+    RUNG_NORMAL, RUNG_TIGHT_PADS, RUNG_SPILL_HIERARCHY, RUNG_HOST_ONLY,
+)
+
+
+def _next_dist_rung(rung: int) -> int:
+    for r in DIST_RUNG_ORDER:
+        if r > rung:
+            return r
+    return RUNG_HOST_ONLY
+
+
+def agree_rung(proposed: int) -> Tuple[int, int]:
+    """The cross-rank rung agreement: allgather-max over the per-rank
+    proposals (the ``deadline.agreed_stop`` idiom, shared through
+    resilience/agreement.py) so a DeviceOOM on ANY rank unwinds every
+    rank to the same rung instead of deadlocking the survivors inside
+    ``shard_map`` collectives.  Returns ``(agreed, triggering_rank)`` —
+    the rank whose proposal WAS the max; agreement failure (sick
+    control link) degrades to the local proposal."""
+    from .agreement import agree_max, rank
+
+    try:
+        return agree_max(int(proposed))
+    except Exception:
+        return int(proposed), rank()
+
+
+def run_dist_ladder(attempt: Callable[[], np.ndarray], graph: Any,
+                    ctx: Any, solver: Any) -> np.ndarray:
+    """The dist facade's OOM recovery ladder (the :func:`run_ladder`
+    twin with cross-rank agreed rung transitions).
+
+    Rungs: 0 normal -> 1 tight pads -> 2 tight pads + host-spilled
+    shard hierarchy (the dist driver registers itself as the spiller:
+    per-level DistGraphs are dropped at the barriers and rebuilt
+    deterministically on demand during uncoarsening — cut-identical by
+    construction) -> 4 host-only recursive bisection.  On a classified
+    DeviceOOM the failing rank PROPOSES the next rung and every rank
+    adopts the allgather-max (:func:`agree_rung`); the ``degraded``
+    event carries the triggering rank.  Rung exhaustion re-raises with
+    ``rungs_exhausted=True``, exactly like the shm ladder.
+
+    Multi-process caveat: the agreement gather is only symmetric when
+    EVERY rank's attempt raised — which is how allocator failure
+    surfaces under jax's distributed runtime (a collective whose peer
+    died aborts on the survivors, so each process's attempt() raises
+    and each enters this except path in the same ladder round).  A rank
+    that fails WITHOUT surfacing fleet-wide is outside this protocol's
+    reach; the divergence sentinel at the next barrier (agreed rung is
+    one of its audited fields) is the backstop that converts that into
+    a structured RankDivergence instead of a silent hang."""
+    if not governor_enabled():
+        return attempt()
+    from ..utils import timer
+
+    st = state()
+    rung = st.rung if st is not None else RUNG_NORMAL
+    if rung == RUNG_SEMI_EXTERNAL:
+        # the forced-rung test hook (or a budget-driven start rung) may
+        # name the shm-only rung: the dist order maps it to host-only
+        rung = RUNG_HOST_ONLY
+    while True:
+        if st is not None:
+            st.rung = rung
+        depth = len(timer.GLOBAL_TIMER._stack)
+        try:
+            return _attempt_dist_at_rung(rung, attempt, graph, ctx, solver)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:
+            err = classify(exc, site="device-oom")
+            if not isinstance(err, DeviceOOM):
+                raise
+            if st is None:
+                st = _ensure_state()
+                st.rung = rung
+            if rung >= RUNG_HOST_ONLY:
+                st.exhausted = True
+                err.rungs_exhausted = True
+                from .. import telemetry
+                from ..utils.logger import log_warning
+
+                telemetry.annotate(memory_budget=summary())
+                log_warning(
+                    "memory governor: dist recovery ladder EXHAUSTED "
+                    f"(host-only rung failed: {err})"
+                )
+                raise err from exc
+            proposed = _next_dist_rung(rung)
+            agreed, trig = agree_rung(proposed)
+            # never retry BELOW the local proposal (a lagging peer's
+            # verdict must not re-run the rung that just OOMed here)
+            rung = max(proposed, int(agreed))
+            st.rung = rung
+            st.engaged = True
+            _recover(st, depth, err)
+            _emit_rung_event(
+                st, error=type(err).__name__, detail=str(err),
+                injected=err.injected, triggering_rank=trig,
+            )
+
+
+def _attempt_dist_at_rung(rung: int, attempt: Callable[[], np.ndarray],
+                          graph: Any, ctx: Any, solver: Any) -> np.ndarray:
+    from .. import caching
+
+    if rung == RUNG_NORMAL:
+        return attempt()
+    if rung in (RUNG_TIGHT_PADS, RUNG_SPILL_HIERARCHY):
+        # rung 2's shard spilling needs no wrapper here: on_barrier
+        # consults the run's rung and asks the registered spiller (the
+        # dist driver) to drop cold per-level DistGraphs
+        with caching.pad_policy_scope("tight"):
+            return attempt()
+    # host-only takes the SHM context tree (DistContext nests it;
+    # ctx.partition already delegates there, but recursive bisection
+    # also reads the shm initial-partitioning knobs)
+    return host_only_partition(graph, getattr(ctx, "shm", ctx))
 
 
 # ---------------------------------------------------------------------------

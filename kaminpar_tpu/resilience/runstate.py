@@ -48,7 +48,7 @@ class RunState:
     __slots__ = (
         "budget_s", "grace_s", "t0", "deadline", "stop", "reason",
         "stage", "stage_at_stop", "announced", "manager", "suspend",
-        "memory",
+        "memory", "dist",
     )
 
     def __init__(self) -> None:
@@ -69,6 +69,10 @@ class RunState:
         # facade's begin_run, None while dormant — the barrier pressure
         # hook reads this slot and returns in two attribute lookups
         self.memory = None  # Optional[GovernorState]
+        # divergence-sentinel half (resilience/agreement.py): armed
+        # only by the stream-owning dist driver, None for shm runs —
+        # the barrier audit piggyback reads this slot and returns
+        self.dist = None  # Optional[agreement.AuditState]
 
 
 _tls = threading.local()

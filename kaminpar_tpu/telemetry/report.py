@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 7
+SCHEMA_VERSION = 8
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -171,6 +171,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # (collective, gathered before the report) folds into the quality
     # section below
     quality_ranks = info.pop("quality_ranks", None)
+    # schema v8: the dist resilience audit trail (divergence-sentinel
+    # counters + per-rank dump, shard fingerprints, the agreed ladder
+    # rung, what was resumed) — annotated by the dist driver; shm runs
+    # carry the well-formed disabled default
+    dist_resilience = info.pop("dist_resilience", {"enabled": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -295,6 +300,12 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # level-0 lower bound), coarsening-quality stats, and
         # refinement-efficacy verdicts (telemetry/quality.py)
         "quality": _quality_section(quality_ranks),
+        # schema v8: the dist resilience audit trail — cross-rank
+        # divergence-sentinel counters (+ the per-rank dump when one
+        # fired), the input's shard-fingerprint vector, the agreed
+        # memory-ladder rung, and the dist resume record
+        # (resilience/agreement.py, docs/robustness.md)
+        "dist_resilience": dist_resilience,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

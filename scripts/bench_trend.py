@@ -70,6 +70,46 @@ QUALITY_COVERAGE_KEYS = ("coarsening_locked_frac",
 #: stamps `platform` exactly so gates can tell).
 ACCEL_PLATFORMS = ("tpu", "axon")
 
+#: Dist-resilience coverage keys the MULTICHIP dryrun tail must carry
+#: from r06 on (round 12, __graft_entry__.dryrun_multichip): the
+#: kill-and-resume cut-identity probe and the agreed-OOM-ladder probe.
+#: Same presence contract as the 10M block — absence means the dryrun
+#: silently lost the coverage, which is the r05 regression class.
+MULTICHIP_COVERAGE_KEYS = ("dist_resumable=", "dist_ladder=")
+MULTICHIP_COVERAGE_SINCE = 6
+
+
+def load_multichip_rounds(repo: str) -> List[Tuple[str, dict]]:
+    paths = sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json")))
+    return [(p, json.load(open(p))) for p in paths]
+
+
+def check_multichip_round(path: str, entry: Any) -> List[str]:
+    """MULTICHIP_rNN structural + coverage validation: a successful
+    (ok, not skipped) round from r06 on must carry the dist-resilience
+    keys in its tail."""
+    errors: List[str] = []
+    name = os.path.basename(path)
+    if not isinstance(entry, dict):
+        return [f"{name}: not a JSON object"]
+    rno = _round_number(name)
+    if (
+        rno is None
+        or rno < MULTICHIP_COVERAGE_SINCE
+        or not entry.get("ok")
+        or entry.get("skipped")
+    ):
+        return errors
+    tail = entry.get("tail") or ""
+    for key in MULTICHIP_COVERAGE_KEYS:
+        if key not in tail:
+            errors.append(
+                f"{name}: MULTICHIP coverage key {key!r} missing from "
+                "the dryrun tail (r05 regression class — "
+                "dryrun_multichip must emit it every round)"
+            )
+    return errors
+
 
 def _round_number(name: str) -> Optional[int]:
     """BENCH_r07.json -> 7 (None for non-conforming names)."""
@@ -298,6 +338,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0 if not args.check else 1
 
     errors: List[str] = []
+    # MULTICHIP dist-resilience coverage (rounds >= r06): presence
+    # gated on successful rounds; earlier rounds predate the contract
+    try:
+        for path, entry in load_multichip_rounds(args.dir):
+            errors.extend(check_multichip_round(path, entry))
+    except (OSError, json.JSONDecodeError) as e:
+        errors.append(f"MULTICHIP rounds unreadable: {e}")
     for path, entry in rounds:
         errors.extend(check_round(path, entry))
         # 10M-coverage contract for rounds newer than r05 (see

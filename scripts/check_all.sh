@@ -10,8 +10,11 @@
 # cache hits, and a SIGTERM mid-batch drain — all verdicts in one
 # schema-valid report), a memory-governor smoke (artificially small
 # budget -> ladder engages, forced rung-2 spill/reload, a serving
-# insufficient-memory rejection), and the ROADMAP.md tier-1 pytest
-# command.  Exits nonzero on the first failing stage.
+# insufficient-memory rejection), a dist resilience smoke (SIGTERM a
+# mesh run mid-pipeline -> resume is CUT-IDENTICAL; a rank-scoped
+# device-oom walks the cross-rank agreed ladder; a rank-1-scoped fault
+# stays inert on rank 0), and the ROADMAP.md tier-1 pytest command.
+# Exits nonzero on the first failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
 #         --fast skips the tier-1 pytest stage (lint + schema + chaos
@@ -22,13 +25,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/8] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/9] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/8] run-report schema (producer selftest, v1-v6 fixtures + v7 producer) =="
+echo "== [2/9] run-report schema (producer selftest, v1-v7 fixtures + v8 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/8] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/9] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -96,7 +99,7 @@ print(f"quality smoke OK: {len(rows)} attribution row(s), "
       "BENCH quality keys present")
 EOF
 
-echo "== [4/8] telemetry.diff self-test + BENCH trend/kernel gate =="
+echo "== [4/9] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -120,7 +123,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/8] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/9] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -160,7 +163,7 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
-echo "== [6/8] serving smoke (mixed batch + faults + SIGTERM drain) =="
+echo "== [6/9] serving smoke (mixed batch + faults + SIGTERM drain) =="
 SERVE_DIR=/tmp/_kmp_serve_smoke
 rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
 python - <<'EOF3' || exit 1
@@ -257,7 +260,7 @@ print(f"drain OK: counts={c} ({len(drained)} drained)")
 EOF3
 
 
-echo "== [7/8] memory-governor smoke (tiny budget + forced spill + serving) =="
+echo "== [7/9] memory-governor smoke (tiny budget + forced spill + serving) =="
 MEM_DIR=/tmp/_kmp_mem_smoke
 rm -rf "$MEM_DIR"; mkdir -p "$MEM_DIR"
 # an artificially small budget: 25% of the rung-0 estimate for the shape
@@ -328,12 +331,130 @@ assert by_id["oversized"]["reason"] == "insufficient-memory", by_id
 print("serving insufficient-memory OK")
 PYEOF
 
+echo "== [8/9] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
+DIST_DIR=/tmp/_kmp_dist_smoke
+rm -rf "$DIST_DIR"; mkdir -p "$DIST_DIR"
+DIST_XLA="--xla_force_host_platform_device_count=8"
+DGRAPH="gen:rgg2d;n=65536;avg_degree=8;seed=1"
+# reference (uninterrupted) run: the cut-identity anchor
+XLA_FLAGS="$DIST_XLA" python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --report-json "$DIST_DIR/ref.json" -q || exit 1
+# preempt: SIGTERM as soon as the first dist barrier checkpoint lands
+XLA_FLAGS="$DIST_XLA" python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --checkpoint-dir "$DIST_DIR/ckpt" \
+    --report-json "$DIST_DIR/pre.json" -q &
+dist_pid=$!
+for _ in $(seq 1 240); do
+    [ -f "$DIST_DIR/ckpt/manifest.json" ] && break
+    sleep 0.5
+done
+kill -TERM "$dist_pid" 2>/dev/null
+wait "$dist_pid" \
+    || { echo "ERROR: SIGTERM'd dist run exited nonzero" >&2; exit 1; }
+python scripts/check_report_schema.py "$DIST_DIR/pre.json" || exit 1
+python - <<'EOF8' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_dist_smoke/pre.json"))
+assert r["anytime"]["anytime"] is True, r["anytime"]
+assert r["anytime"]["reason"] == "sigterm", r["anytime"]
+ck = r["checkpoint"]
+assert ck["enabled"] and ck["writes"] > 0, ck
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], gate
+dr = r["dist_resilience"]
+assert dr["enabled"] and dr["audits"] >= 1, dr
+assert len(dr["shard_fingerprints"]) == 4, dr
+print(f"dist preempt OK: anytime at {r['anytime'].get('stage')}, "
+      f"{ck['writes']} checkpoint write(s), {dr['audits']} audit(s)")
+EOF8
+# resume after the graceful wind-down: the preempted run ran its
+# mandatory tail and checkpointed its (anytime) result — resume must
+# return EXACTLY that partition (cut-identical to the preempted run's
+# own result, resumed_from the final `result` snapshot)
+XLA_FLAGS="$DIST_XLA" python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --checkpoint-dir "$DIST_DIR/ckpt" --resume \
+    --report-json "$DIST_DIR/res.json" -q || exit 1
+python scripts/check_report_schema.py "$DIST_DIR/res.json" || exit 1
+python - <<'EOF8' || exit 1
+import json
+pre = json.load(open("/tmp/_kmp_dist_smoke/pre.json"))
+res = json.load(open("/tmp/_kmp_dist_smoke/res.json"))
+assert res["checkpoint"].get("resumed_from"), res["checkpoint"]
+assert res["output_gate"]["valid"], res["output_gate"]
+assert res["result"]["cut"] == pre["result"]["cut"], (
+    "resume did not restore the preempted run's result: "
+    f"preempted {pre['result']['cut']} vs resumed {res['result']['cut']}")
+print(f"dist resume OK: resumed from {res['checkpoint']['resumed_from']}, "
+      f"cut={res['result']['cut']} (identical to the preempted result)")
+EOF8
+# hard kill MID-PIPELINE (the SimulatedPreemption test hook — no
+# mandatory tail, like a real SIGKILL): the resume re-enters at the
+# recorded dist barrier and must be CUT-IDENTICAL to the uninterrupted
+# reference (full-hierarchy dist resume)
+rm -rf "$DIST_DIR/ckpt"
+if KAMINPAR_TPU_STOP_AT='dist-uncoarsen:1!' XLA_FLAGS="$DIST_XLA" \
+    python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --checkpoint-dir "$DIST_DIR/ckpt" -q 2> /dev/null; then
+    echo "ERROR: simulated hard kill did not kill the run" >&2; exit 1
+fi
+[ -f "$DIST_DIR/ckpt/manifest.json" ] \
+    || { echo "ERROR: hard-killed run left no manifest" >&2; exit 1; }
+XLA_FLAGS="$DIST_XLA" python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --checkpoint-dir "$DIST_DIR/ckpt" --resume \
+    --report-json "$DIST_DIR/hard.json" -q || exit 1
+python - <<'EOF8' || exit 1
+import json
+ref = json.load(open("/tmp/_kmp_dist_smoke/ref.json"))
+hard = json.load(open("/tmp/_kmp_dist_smoke/hard.json"))
+assert hard["checkpoint"].get("resumed_from") == "dist-uncoarsen:1", (
+    hard["checkpoint"])
+assert hard["output_gate"]["valid"], hard["output_gate"]
+assert hard["result"]["cut"] == ref["result"]["cut"], (
+    "mid-pipeline dist resume is not cut-identical: "
+    f"ref {ref['result']['cut']} vs resumed {hard['result']['cut']}")
+print(f"dist hard-kill resume OK: re-entered at dist-uncoarsen:1, "
+      f"cut={hard['result']['cut']} (identical to the reference)")
+EOF8
+# rank-scoped chaos: a single-rank DeviceOOM walks the run down the
+# cross-rank agreed ladder (rung >= 1) and still ends gate-valid...
+KAMINPAR_TPU_FAULTS=device-oom@rank=0:nth=1 XLA_FLAGS="$DIST_XLA" \
+    python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --report-json "$DIST_DIR/chaos0.json" || exit 1
+python - <<'EOF8' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_dist_smoke/chaos0.json"))
+deg = [d["attrs"] for d in r["degraded"]
+       if d["attrs"]["site"] == "device-oom"]
+assert deg, r["degraded"]
+last = deg[-1]
+assert last["rung"] >= 1 and last["injected"], last
+assert last.get("triggering_rank") == 0, last
+mb = r["memory_budget"]
+assert mb["enabled"] and mb["rung"] >= 1 and not mb["exhausted"], mb
+assert r["output_gate"]["valid"], r["output_gate"]
+assert r["dist_resilience"]["ladder"]["rung"] >= 1, r["dist_resilience"]
+print(f"rank-scoped chaos OK: rung={mb['rung']} "
+      f"triggered by rank {last.get('triggering_rank')}")
+EOF8
+# ...and the SAME fault scoped to rank 1 is inert on this rank-0 fleet
+KAMINPAR_TPU_FAULTS=device-oom@rank=1:nth=1 XLA_FLAGS="$DIST_XLA" \
+    python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
+    --report-json "$DIST_DIR/chaos1.json" -q || exit 1
+python - <<'EOF8' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_dist_smoke/chaos1.json"))
+assert r["degraded"] == [], r["degraded"]
+assert r["memory_budget"] == {"enabled": False} or \
+    r["memory_budget"].get("rung", 0) == 0, r["memory_budget"]
+print("rank-scope inert OK: rank=1 plan fired nothing on rank 0")
+EOF8
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [8/8] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [9/9] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [8/8] tier-1 pytest (ROADMAP.md) =="
+echo "== [9/9] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

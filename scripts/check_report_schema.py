@@ -89,8 +89,9 @@ def version_checks(report: Any) -> List[str]:
     the `progress` and `compile` sections, v3+ additionally the
     `checkpoint` and `anytime` sections, v4+ additionally the `serving`
     section, v5+ additionally the `perf` section, v6+ additionally the
-    `memory_budget` section, v7+ additionally the `quality` section;
-    older reports remain valid without them during the transition."""
+    `memory_budget` section, v7+ additionally the `quality` section,
+    v8+ additionally the `dist_resilience` section; older reports
+    remain valid without them during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -104,6 +105,7 @@ def version_checks(report: Any) -> List[str]:
         (5, ("perf",)),
         (6, ("memory_budget",)),
         (7, ("quality",)),
+        (8, ("dist_resilience",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -185,6 +187,15 @@ def _minimal_v6_report() -> dict:
     r = _minimal_v5_report()
     r["schema_version"] = 6
     r["memory_budget"] = {"enabled": False}
+    return r
+
+
+def _minimal_v7_report() -> dict:
+    """A minimal schema_version-7 report (quality present, no
+    dist_resilience section) — the seventh transition fixture."""
+    r = _minimal_v6_report()
+    r["schema_version"] = 7
+    r["quality"] = {"enabled": False}
     return r
 
 
@@ -296,7 +307,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v7) and validate it plus the embedded v1-v6 transition "
+        "v8) and validate it plus the embedded v1-v7 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -320,18 +331,19 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v7 (progress/compile +
-        # checkpoint/anytime + serving + perf + memory_budget + quality)
-        if report.get("schema_version") != 7:
+        # live producer must emit v8 (progress/compile +
+        # checkpoint/anytime + serving + perf + memory_budget +
+        # quality + dist_resilience)
+        if report.get("schema_version") != 8:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 7",
+                f"expected 8",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
-                    "memory_budget", "quality"):
+                    "memory_budget", "quality", "dist_resilience"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -363,11 +375,12 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v6 layouts must STILL validate
+        # transition coverage: the v1-v7 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
             ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
+            ("v7", _minimal_v7_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

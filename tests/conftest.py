@@ -29,6 +29,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # the tier-1 command (ROADMAP.md) runs `-m 'not slow'`: heavy tests
+    # past the 870 s budget opt out with this marker and still run in a
+    # plain `pytest tests/`
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests excluded from the tier-1 time budget",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     from kaminpar_tpu.utils import rng

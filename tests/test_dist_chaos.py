@@ -1,0 +1,88 @@
+"""Dist chaos past the toys (VERDICT item 8's >= 2^17-edge bar).
+
+The small dist-resilience suite exercises the protocol on grids; this
+module injects a fault into a dist run on a generator graph big enough
+to shard organically (n=16384, avg degree 16 -> m >= 2^17 directed edge
+slots, well past the single-shard regime) and demands the full
+recovery story at once: the agreed OOM ladder absorbs the injected
+allocator failure, the result is complete and gate-valid, and the
+comm-table accounting recorded real per-phase collective payloads while
+it happened (recovery exercised WITH the mesh collectives live, not on
+a degenerate one-device layout).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import resilience, telemetry
+from kaminpar_tpu.graphs.factories import make_rgg2d
+from kaminpar_tpu.parallel import dKaMinPar, make_mesh
+from kaminpar_tpu.parallel.dist_context import (
+    create_dist_context_by_preset_name,
+)
+from kaminpar_tpu.resilience import memory as memory_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(resilience.FAULTS_ENV_VAR, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def test_dist_chaos_recovery_on_organically_sharded_graph(monkeypatch):
+    from kaminpar_tpu.parallel.mesh import comm_records, reset_comm_log
+
+    g = make_rgg2d(16384, avg_degree=16, seed=5)
+    assert int(g.m) >= (1 << 17), "graph under the past-the-toys bar"
+
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "device-oom:nth=1")
+    reset_comm_log()
+    ctx = create_dist_context_by_preset_name("default")
+    solver = dKaMinPar(ctx, mesh=make_mesh(4)).set_graph(g)
+    part = solver.compute_partition(k=8, epsilon=0.03, seed=1)
+
+    # recovery: the injected OOM walked the agreed ladder to rung 1
+    deg = [
+        e.attrs for e in telemetry.events("degraded")
+        if e.attrs["site"] == "device-oom"
+    ]
+    assert deg and deg[-1]["rung"] == 1 and deg[-1]["injected"]
+    assert deg[-1]["triggering_rank"] == 0
+    st = memory_mod.state()
+    assert st is not None and st.rung == 1
+
+    # the result is complete and gate-valid
+    assert part.shape == (g.n,)
+    gates = telemetry.events("output-gate")
+    assert gates and gates[-1].attrs["valid"]
+    bw = np.zeros(8, dtype=np.int64)
+    np.add.at(bw, part, np.asarray(g.node_weight_array()))
+    assert bw.min() > 0  # all 8 blocks populated
+
+    # the mesh collectives were LIVE during recovery: per-phase comm
+    # rows with non-zero per-device payload bytes were traced
+    records = comm_records()
+    assert records, "no comm-table rows recorded"
+    payload = [
+        r for r in records if r.get("payload_bytes_per_device", 0) > 0
+    ]
+    assert payload, records
+    phases = {r.get("phase") for r in records}
+    assert any("coarsening" in (p or "") for p in phases) or any(
+        "refinement" in (p or "") for p in phases
+    ), phases
+
+    # and the dist resilience section audits the run
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    sect = build_run_report()["dist_resilience"]
+    assert sect["enabled"] and sect["audits"] >= 1
+    assert sect["ladder"]["rung"] == 1

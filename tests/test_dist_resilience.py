@@ -1,0 +1,514 @@
+"""Distributed resilience suite (docs/robustness.md, dist contract).
+
+The acceptance checks of ISSUE 12: (a) full-hierarchy dist resume —
+a run hard-killed at EVERY dist barrier kind and resumed must produce a
+partition IDENTICAL to the uninterrupted run's (the dist pipeline is
+rerun-deterministic, so cut-identical is array-identical here), and a
+resume under a different device count must degrade to a logged clean
+restart, never a wrong answer; (b) the cross-rank agreed OOM ladder —
+a DeviceOOM injected on one rank walks every rank down the ladder
+together (allgather-max agreement, unit-tested against a simulated
+divergent fleet) and still ends gate-valid; (c) rank-scoped chaos +
+divergence sentinels — `site@rank=K` fault addressing fires on rank K
+only, and a simulated stage/rung skew at a barrier raises a structured
+RankDivergence with the per-rank dump.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import resilience, telemetry
+from kaminpar_tpu.graphs.factories import make_grid_graph, make_star
+from kaminpar_tpu.parallel import dKaMinPar, make_mesh
+from kaminpar_tpu.parallel.dist_context import (
+    create_dist_context_by_preset_name,
+)
+from kaminpar_tpu.resilience import agreement, faults
+from kaminpar_tpu.resilience import checkpoint as ckpt_mod
+from kaminpar_tpu.resilience import memory as memory_mod
+from kaminpar_tpu.resilience.checkpoint import SimulatedPreemption
+from kaminpar_tpu.resilience.errors import DeviceOOM, RankDivergence
+
+GRID = 32  # 1024 nodes, 3 dist levels under the test contraction limit
+K = 4
+CONTRACTION_LIMIT = 30
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (ckpt_mod.STOP_AT_ENV, resilience.FAULTS_ENV_VAR,
+                agreement.ENV_SIM_RANK, agreement.ENV_SIM_RANKS,
+                memory_mod.ENV_FORCE_RUNG, memory_mod.ENV_BUDGET):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _run(ckpt=None, resume=False, stop_at=None, n_devices=4, seed=1,
+         gather=None):
+    """One dist deep pipeline run with >= 2 coarsening levels.
+    ``gather`` installs an allgather override AFTER the internal reset
+    (resilience.reset clears any installed override)."""
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    if gather is not None:
+        agreement.set_gather_override(gather)
+    if stop_at is not None:
+        os.environ[ckpt_mod.STOP_AT_ENV] = stop_at
+    else:
+        os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+    ctx = create_dist_context_by_preset_name("default")
+    ctx.shm.coarsening.contraction_limit = CONTRACTION_LIMIT
+    # keep the subgroup-replication phase out of the way: these tests
+    # exercise the main dist coarsen/initial/uncoarsen barrier lineage
+    ctx.replication_min_nodes_per_device = 0
+    if ckpt is not None:
+        ctx.shm.resilience.checkpoint_dir = str(ckpt)
+        ctx.shm.resilience.resume = resume
+    g = make_grid_graph(GRID, GRID)
+    solver = dKaMinPar(ctx, mesh=make_mesh(n_devices)).set_graph(g)
+    try:
+        part = solver.compute_partition(k=K, epsilon=0.03, seed=seed)
+    finally:
+        # the SimulatedPreemption raise path must not leak the hook
+        # into later tests (monkeypatch.delenv would RESTORE it on
+        # teardown, leaking it past this module)
+        os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+    return solver, g, part
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """The uninterrupted run's partition (shared across the module)."""
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        _, _, part = _run()
+        return np.asarray(part)
+    finally:
+        resilience.reset()
+        telemetry.disable()
+        telemetry.reset()
+
+
+def _gate_valid() -> bool:
+    gates = telemetry.events("output-gate")
+    assert gates, "no output-gate event"
+    return bool(gates[-1].attrs["valid"])
+
+
+# ---------------------------------------------------------------------------
+# (a) full-hierarchy dist resume
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "stop_at",
+    ["dist-coarsen:1!", "dist-initial!", "dist-uncoarsen:1!",
+     "dist-uncoarsen:0!"],
+)
+def test_dist_kill_and_resume_is_cut_identical(tmp_path, baseline, stop_at):
+    """Hard-kill at each dist barrier KIND (coarsen / initial /
+    uncoarsen, plus the finest uncoarsen level), resume, and demand the
+    IDENTICAL partition — the dist pipeline is rerun-deterministic, so
+    any divergence means the resume re-entered wrong."""
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt=d, stop_at=stop_at)
+    _, _, part = _run(ckpt=d, resume=True)
+    resumes = telemetry.events("resume")
+    assert resumes, "resumed run recorded no resume event"
+    stage = resumes[-1].attrs["stage"]
+    assert stage == stop_at.rstrip("!").split(":")[0], stage
+    assert _gate_valid()
+    np.testing.assert_array_equal(np.asarray(part), baseline)
+
+
+def test_dist_resume_finest_barrier_with_pending_extension(tmp_path):
+    """Kill at dist-uncoarsen:0 while current_k < k (shallow hierarchy
+    relative to k): the finest barrier's keep list has pruned EVERY
+    level snapshot, so the resume restores a level-less state — it must
+    still extend the RESTORED partition on the mesh (cut-identical to
+    the uninterrupted run), never discard it into the shm fallback."""
+
+    def _ext_run(ckpt=None, resume=False, stop_at=None):
+        resilience.reset()
+        telemetry.reset()
+        telemetry.enable()
+        if stop_at is not None:
+            os.environ[ckpt_mod.STOP_AT_ENV] = stop_at
+        ctx = create_dist_context_by_preset_name("default")
+        # one dist level and compute_k_for_n(n) = 4 < k = 16: the
+        # level-0 barrier records current_k=4 with k-extension pending
+        ctx.shm.coarsening.contraction_limit = 300
+        ctx.replication_min_nodes_per_device = 0
+        if ckpt is not None:
+            ctx.shm.resilience.checkpoint_dir = str(ckpt)
+            ctx.shm.resilience.resume = resume
+        g = make_grid_graph(GRID, GRID)
+        solver = dKaMinPar(ctx, mesh=make_mesh(4)).set_graph(g)
+        try:
+            return solver.compute_partition(k=16, epsilon=0.03, seed=1)
+        finally:
+            os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+
+    base = np.asarray(_ext_run())
+    assert len(np.unique(base)) == 16  # the extension really ran
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _ext_run(ckpt=d, stop_at="dist-uncoarsen:0!")
+    import json
+
+    man = json.load(open(d / "manifest.json"))
+    assert man["meta"]["current_k"] < 16  # extension was still pending
+    part = np.asarray(_ext_run(ckpt=d, resume=True))
+    assert telemetry.events("resume"), "restored nothing"
+    np.testing.assert_array_equal(part, base)
+
+
+def test_dist_resume_skips_completed_levels(tmp_path):
+    """A resume at dist-initial must NOT re-run coarsening: no
+    dist-coarsen barrier checkpoints are offered again (level snapshots
+    are carried by reference, not rewritten)."""
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt=d, stop_at="dist-initial!")
+    _, _, _ = _run(ckpt=d, resume=True)
+    ckpt_events = [
+        e.attrs for e in telemetry.events("checkpoint")
+        if e.attrs.get("stage") == "dist-coarsen"
+    ]
+    assert ckpt_events == [], ckpt_events
+    resumed = telemetry.events("resume")[-1].attrs
+    assert resumed["levels_restored"] >= 2
+
+
+def test_dist_resume_under_different_device_count_restarts_clean(
+    tmp_path, baseline
+):
+    """The per-rank shard-fingerprint vector detects a device-count
+    change: the resume degrades to a LOGGED clean restart (never a
+    wrong answer) and the run completes gate-valid."""
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt=d, stop_at="dist-coarsen:1!", n_devices=4)
+    _, g, part = _run(ckpt=d, resume=True, n_devices=2)
+    restarts = [
+        e.attrs for e in telemetry.events("checkpoint")
+        if e.attrs.get("action") == "clean-restart"
+    ]
+    assert restarts and "shard fingerprints" in restarts[-1]["error"]
+    assert not telemetry.events("resume")  # nothing was resumed
+    assert _gate_valid()
+    assert part.shape == (g.n,)
+
+
+def test_dist_checkpoint_meta_carries_shard_vector(tmp_path):
+    """Every dist barrier's manifest meta records the per-rank shard
+    fingerprints + the full hierarchy depth (the keep-list prunes
+    consumed levels, but per-level seeds must survive)."""
+    import json
+
+    d = tmp_path / "ckpt"
+    with pytest.raises(SimulatedPreemption):
+        _run(ckpt=d, stop_at="dist-uncoarsen:1!")
+    man = json.load(open(d / "manifest.json"))
+    assert man["scheme"] == "dist"
+    assert man["stage"] == "dist-uncoarsen"
+    meta = man["meta"]
+    assert len(meta["shards"]) == 4  # one fingerprint per device
+    assert meta["num_levels"] >= 2
+    assert meta["current_k"] >= 2
+    # hierarchy levels are serialized once, by reference
+    snaps = set(man["snapshots"])
+    assert any(s.startswith("dist-level-") for s in snaps)
+    assert "state" in snaps
+
+
+# ---------------------------------------------------------------------------
+# (b) cross-rank agreed OOM ladder
+# ---------------------------------------------------------------------------
+
+
+def test_agree_max_adopts_fleet_maximum():
+    """allgather-max agreement against a simulated divergent fleet:
+    the local rank proposes 1, the (simulated) peer proposes 2 — both
+    adopt 2, and the peer is named the triggering rank."""
+    agreement.set_gather_override(
+        lambda row: np.stack([row, row + 1])
+    )
+    try:
+        agreed, trig = agreement.agree_max(1)
+        assert (agreed, trig) == (2, 1)
+        agreed, trig = memory_mod.agree_rung(1)
+        assert (agreed, trig) == (2, 1)
+    finally:
+        agreement.set_gather_override(None)
+
+
+def test_one_rank_oom_walks_all_ranks_down_the_ladder(baseline):
+    """`device-oom@rank=0:nth=1`: the single injected OOM engages the
+    agreed ladder (rung 1, tight pads), the degraded event names the
+    triggering rank, and the run ends gate-valid — with a cut identical
+    to baseline is NOT required (tight pads re-bucket), but the result
+    must be complete and valid."""
+    os.environ[resilience.FAULTS_ENV_VAR] = "device-oom@rank=0:nth=1"
+    try:
+        _, g, part = _run()
+    finally:
+        os.environ.pop(resilience.FAULTS_ENV_VAR, None)
+    deg = [
+        e.attrs for e in telemetry.events("degraded")
+        if e.attrs["site"] == "device-oom"
+    ]
+    assert deg and deg[-1]["rung"] == 1
+    assert deg[-1]["triggering_rank"] == 0
+    assert deg[-1]["injected"] is True
+    st = memory_mod.state()
+    assert st is not None and st.rung == 1 and st.engaged
+    assert _gate_valid()
+    assert part.shape == (g.n,)
+
+
+def test_peer_rung_proposal_raises_local_rung():
+    """A (simulated) peer proposing a higher rung pulls the local rank
+    up past its own proposal — the agreement half of 'all ranks land on
+    the same rung'."""
+    calls = {"n": 0}
+
+    def peer_two_rungs_up(row):
+        calls["n"] += 1
+        return np.stack([row, row + 2])
+
+    agreement.set_gather_override(peer_two_rungs_up)
+    try:
+        agreed, trig = memory_mod.agree_rung(1)
+    finally:
+        agreement.set_gather_override(None)
+    assert calls["n"] == 1
+    assert agreed == 3 and trig == 1
+
+
+def test_dist_forced_rung2_spills_and_reloads_cut_identical(baseline):
+    """KAMINPAR_TPU_MEM_RUNG=2: the host-spilled shard hierarchy —
+    per-level DistGraphs dropped at the barriers and rebuilt on demand
+    during uncoarsening.  memory-spill AND memory-reload events must be
+    present, and because the rebuild is deterministic the partition is
+    IDENTICAL to the normal run's under the same pad policy... which
+    rung 2 changes (tight pads), so the assertion here is validity +
+    spill/reload accounting, with the cut-identity of spill/reload
+    itself covered by the resume suite (same rebuild path)."""
+    os.environ[memory_mod.ENV_FORCE_RUNG] = "2"
+    try:
+        _, g, part = _run()
+    finally:
+        os.environ.pop(memory_mod.ENV_FORCE_RUNG, None)
+    spills = telemetry.events("memory-spill")
+    reloads = telemetry.events("memory-reload")
+    assert spills, "rung-2 dist run spilled nothing"
+    assert reloads, "rung-2 dist run reloaded nothing"
+    st = memory_mod.state()
+    assert st is not None and st.spills >= 1 and st.reloads >= 1
+    assert _gate_valid()
+    assert part.shape == (g.n,)
+
+
+def test_dist_ladder_host_only_rung(baseline):
+    """The dist ladder's last rung (host-only recursive bisection) is
+    reachable and gate-valid — the forced shm-only rung 3 maps onto it
+    (DIST_RUNG_ORDER skips semi-external)."""
+    os.environ[memory_mod.ENV_FORCE_RUNG] = "3"
+    try:
+        _, g, part = _run()
+    finally:
+        os.environ.pop(memory_mod.ENV_FORCE_RUNG, None)
+    assert telemetry.events("host-only-partition")
+    assert _gate_valid()
+    assert part.shape == (g.n,)
+
+
+# ---------------------------------------------------------------------------
+# (c) rank-scoped chaos addressing
+# ---------------------------------------------------------------------------
+
+
+def test_parse_plan_rank_scoped():
+    rules = faults.parse_plan(
+        "device-oom@rank=1:nth=2,refiner:0.5,all@rank=0"
+    )
+    assert rules[0].site == "device-oom"
+    assert rules[0].rank == 1 and rules[0].nth == 2
+    assert rules[1].rank is None
+    assert rules[2].site == "all" and rules[2].rank == 0
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["device-oom@rk=1:nth=1", "device-oom@rank=x", "device-oom@rank=-1",
+     "nosite@rank=0"],
+)
+def test_parse_plan_rank_scoped_rejects(bad):
+    with pytest.raises(faults.FaultPlanError):
+        faults.parse_plan(bad)
+
+
+def test_rank_scoped_injection_fires_on_matching_rank_only(monkeypatch):
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "refiner@rank=1:nth=1")
+    # this process is rank 0: the rule is inert
+    faults.maybe_inject("refiner")
+    assert faults.injected_log() == []
+    # impersonate rank 1 (the SIM override): the next matching call
+    # fires — the per-site counter kept advancing, so re-arm nth
+    faults.reset()
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "refiner@rank=1:nth=1")
+    monkeypatch.setenv(agreement.ENV_SIM_RANK, "1")
+    with pytest.raises(DeviceOOM) as ei:
+        faults.maybe_inject("refiner")
+    assert ei.value.injected
+    assert faults.injected_log() == [
+        {"site": "refiner", "call": 1, "rank": 1}
+    ]
+
+
+def test_rank_scoped_fault_inert_on_dist_run(baseline):
+    """A dist pipeline run with `device-oom@rank=1:nth=1` on a rank-0
+    process must inject NOTHING — no degraded events, ladder never
+    engages, partition identical to baseline."""
+    os.environ[resilience.FAULTS_ENV_VAR] = "device-oom@rank=1:nth=1"
+    try:
+        _, _, part = _run()
+    finally:
+        os.environ.pop(resilience.FAULTS_ENV_VAR, None)
+    assert telemetry.events("degraded") == []
+    st = memory_mod.state()
+    assert st is None or st.rung == 0
+    np.testing.assert_array_equal(np.asarray(part), baseline)
+
+
+# ---------------------------------------------------------------------------
+# (c) divergence sentinels
+# ---------------------------------------------------------------------------
+
+
+def test_divergence_sentinel_fires_on_stage_skew():
+    """A simulated fleet where rank 1 reports a different stage hash at
+    the first dist barrier: the sentinel converts the silent skew into
+    a structured RankDivergence with the per-rank dump."""
+    try:
+        with pytest.raises(RankDivergence) as ei:
+            _run(gather=lambda row: np.stack(
+                [row, row + np.array([1, 0, 0])]
+            ))
+    finally:
+        agreement.set_gather_override(None)
+    err = ei.value
+    assert len(err.ranks) == 2
+    assert err.site == "rank-divergence"
+    events = telemetry.events("rank-divergence")
+    assert events and events[-1].attrs["fields"] == ["stage"]
+    # the per-rank dump was annotated into the report state BEFORE the
+    # raise, so even an emergency report carries it
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    report = build_run_report()
+    sect = report["dist_resilience"]
+    assert sect["enabled"] and sect["divergence"]["fields"] == ["stage"]
+    assert len(sect["divergence"]["ranks"]) == 2
+
+
+def test_divergence_sentinel_fires_on_rung_skew():
+    try:
+        with pytest.raises(RankDivergence):
+            _run(gather=lambda row: np.stack(
+                [row, row + np.array([0, 2, 0])]
+            ))
+    finally:
+        agreement.set_gather_override(None)
+    assert telemetry.events("rank-divergence")[-1].attrs["fields"] == [
+        "rung"
+    ]
+
+
+def test_divergence_sentinel_injected_site():
+    """The registered `rank-divergence` chaos site exercises the abort
+    path without a skewed fleet."""
+    os.environ[resilience.FAULTS_ENV_VAR] = "rank-divergence:nth=1"
+    try:
+        with pytest.raises(RankDivergence) as ei:
+            _run()
+    finally:
+        os.environ.pop(resilience.FAULTS_ENV_VAR, None)
+    assert ei.value.injected
+
+
+def test_sentinel_audits_counted_in_report(baseline):
+    """A clean dist run audits every barrier and reports the count in
+    the dist_resilience section (single rank: trivially agreeing)."""
+    solver, _, _ = _run()
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    report = build_run_report()
+    sect = report["dist_resilience"]
+    assert sect["enabled"]
+    assert sect["audits"] >= 4  # >= 2 coarsen + initial + uncoarsens
+    assert sect["ranks"] == 1 and sect["rank"] == 0
+    assert len(sect["shard_fingerprints"]) == 4
+    assert sect["ladder"] == {"agreed": True, "rung": 0}
+
+
+# ---------------------------------------------------------------------------
+# sharding-plan pricing (the preflight satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_sizes_price_the_heaviest_shard():
+    """A star graph concentrates the hub's edges in shard 0: the
+    sharding plan's m_loc must cover the ACTUAL heaviest shard, which
+    the uniform ceil(m/D) estimate undercounts."""
+    from kaminpar_tpu.parallel.dist_graph import shard_sizes
+
+    g = make_star(1 << 10)  # hub + 1024 leaves, hub row holds half of m
+    xadj = np.asarray(g.xadj, dtype=np.int64)
+    D = 4
+    n_loc, m_loc, counts = shard_sizes(xadj, D)
+    assert sum(counts) == int(g.m)
+    assert max(counts) > -(-int(g.m) // D)  # skew: heaviest > uniform
+    assert m_loc >= max(counts)
+
+
+def test_shard_fingerprints_detect_device_count_and_graph():
+    from kaminpar_tpu.parallel.dist_graph import shard_fingerprints
+
+    g = make_grid_graph(16, 16)
+    fp4 = shard_fingerprints(g, 4)
+    assert len(fp4) == 4 and len(set(fp4)) > 1
+    assert shard_fingerprints(g, 4) == fp4  # deterministic
+    assert len(shard_fingerprints(g, 2)) == 2
+    g2 = make_grid_graph(16, 17)
+    assert shard_fingerprints(g2, 4) != fp4
+
+
+def test_preflight_refuses_on_shard_estimate(monkeypatch):
+    """preflight prices the given (per-shard) shape against the budget
+    and refuses with a ladder-retryable DeviceOOM before any upload."""
+    from kaminpar_tpu.resilience.runstate import current
+
+    st = memory_mod.GovernorState()
+    st.budget = 1  # nothing fits one byte
+    current().memory = st
+    try:
+        with pytest.raises(DeviceOOM) as ei:
+            memory_mod.preflight(1 << 16, 1 << 20, 8, where="dist")
+        assert not ei.value.rungs_exhausted
+        assert "preflight@dist" in str(ei.value)
+    finally:
+        current().memory = None

@@ -322,6 +322,9 @@ def test_dist_presets_and_factories():
         assert ctx.shm is not None
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dist_random_initial_partitioning():
     """RANDOM dist IP variant (kaminpar-dist/factories.cc:72-88): the
     coarsest graph gets uniform random blocks; balancers + refiners must
@@ -385,6 +388,9 @@ def test_comm_accounting_table():
     assert "no collectives" in comm_table()
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dkaminpar_strong_preset_end_to_end():
     from kaminpar_tpu.parallel import dKaMinPar
 
@@ -499,6 +505,9 @@ def test_torus_mesh_runs_dist_pipeline():
     assert 0 < int(cut) <= host.m
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dist_quality_tracks_shm():
     """The distributed driver's cut stays within 2x of the shm pipeline
     on the same graph (dist refinement is chunked/bulk-synchronous, so
@@ -533,11 +542,9 @@ def test_halo_exchange_delivers_ghost_labels(n_devices):
     from jax.sharding import PartitionSpec as P
 
     from kaminpar_tpu.parallel.mesh import halo_exchange
-
-    try:
-        from jax import shard_map as shard_map_fn
-    except ImportError:
-        from jax.experimental.shard_map import shard_map as shard_map_fn
+    # the version-portable shim (check_vma vs check_rep) the dist
+    # kernels route through
+    from kaminpar_tpu.parallel.mesh import shard_map_compat as shard_map_fn
 
     host = make_rmat(1 << 10, 8_000, seed=17)
     mesh = make_mesh(n_devices)
@@ -571,6 +578,9 @@ def test_halo_exchange_delivers_ghost_labels(n_devices):
         )
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dist_deep_mode_quality_2_vs_8_devices():
     """DEEP-mode dist driver (k-doubling uncoarsening with block spans,
     per-block extension + mesh refinement — deep_multilevel.cc analog):
@@ -781,6 +791,9 @@ def test_dist_graph_from_compressed_weighted_edges():
     _dist_graph_fields_equal(a, b)
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dkaminpar_partitions_compressed_via_shard_streaming(monkeypatch):
     """dKaMinPar keeps a compressed input compressed: the finest-level
     ingestion must go through dist_graph_from_compressed (the graph is
@@ -809,6 +822,9 @@ def test_dkaminpar_partitions_compressed_via_shard_streaming(monkeypatch):
     assert bw.max() <= cap
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dkaminpar_compressed_kway_sharded_never_materializes(monkeypatch):
     """In the terapart regime (kway mode + sharded contraction + no
     singleton post-pass firing) the plain fine CSR must never exist:
@@ -838,6 +854,9 @@ def test_dkaminpar_compressed_kway_sharded_never_materializes(monkeypatch):
     assert set(np.unique(part)) <= set(range(4))
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dkaminpar_copy_graph_clears_compressed_state():
     """Regression: copy_graph after a compressed set_graph must not
     leave the stale compressed topology driving the finest level."""
@@ -948,6 +967,9 @@ def test_sharded_contraction_powerlaw_skew(monkeypatch):
         assert h == d, f"row {u} differs"
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_mesh_subgroup_replication_fires_and_stays_feasible():
     """Mesh-subgroup replication (deep_multilevel.cc:79-153 +
     replicator.cc analog): once the graph drops below
@@ -1013,6 +1035,9 @@ def test_replication_union_helpers():
     assert choose_replication_factor(1_000, 1, 2048) == 1
 
 
+@pytest.mark.slow  # alive since the shard_map compat shim (round 12) but past the
+# tier-1 870 s budget on the CPU fallback; dist tier-1 coverage lives in
+# tests/test_dist_resilience.py / test_dist_chaos.py
 def test_dist_deep_k64_quality_vs_shm():
     """dist deep at k=64 must land within 10% of the shm pipeline on the
     same graph (the extend-on-mesh + replication lineage carries real

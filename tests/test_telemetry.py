@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 7
+    assert loaded["schema_version"] == 8
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -654,13 +654,20 @@ def test_schema_accepts_v1_through_v7(tmp_path):
     # v7 additionally requires the quality section
     v7_missing = dict(v6, schema_version=7)
     assert any("quality" in e for e in checker.version_checks(v7_missing))
-    v7 = dict(v7_missing, quality={"enabled": False})
+    v7 = checker._minimal_v7_report()
     assert checker.validate_instance(v7, schema) == []
     assert checker.version_checks(v7) == []
-    # v8 is not a known version
-    v8 = dict(v1, schema_version=8)
+    # v8 additionally requires the dist_resilience section
+    v8_missing = dict(v7, schema_version=8)
+    assert any("dist_resilience" in e
+               for e in checker.version_checks(v8_missing))
+    v8 = dict(v8_missing, dist_resilience={"enabled": False})
+    assert checker.validate_instance(v8, schema) == []
+    assert checker.version_checks(v8) == []
+    # v9 is not a known version
+    v9 = dict(v1, schema_version=9)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v8, schema))
+               for e in checker.validate_instance(v9, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
