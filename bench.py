@@ -33,6 +33,13 @@ import os
 #: asserts this contract without running the full bench).
 QUALITY_KEYS = ("coarsening_locked_frac", "refinement_left_frac")
 
+#: Out-of-core streaming keys (round 13, kaminpar_tpu/external/): the
+#: wall of a forced-budget `--scheme external` run of the medium bench
+#: graph and its upload/compute overlap fraction — same never-vanish
+#: contract (null = the measurement failed or was skipped, ABSENCE =
+#: silent coverage loss, gated by bench_trend from r06 on).
+EXTERNAL_KEYS = ("external_seconds", "stream_overlap")
+
 
 def quality_keys(report) -> dict:
     """The BENCH line's quality-attribution keys from an embedded run
@@ -40,6 +47,58 @@ def quality_keys(report) -> dict:
     the report carries no attribution."""
     totals = ((report or {}).get("quality") or {}).get("totals") or {}
     return {key: totals.get(key) for key in QUALITY_KEYS}
+
+
+def external_keys(seconds=None, overlap=None) -> dict:
+    """The BENCH line's out-of-core streaming keys; every key present,
+    null when the external measurement was skipped or failed."""
+    return {"external_seconds": seconds, "stream_overlap": overlap}
+
+
+def _measure_external():
+    """One `--scheme external` partition of the medium bench graph under
+    a forced budget at 25% of its in-core estimate: (wall seconds,
+    overlap fraction from the run's `external` report section).  The
+    scale half of the north star gets a trend line next to the in-core
+    kernels."""
+    import time
+
+    import numpy as np
+
+    from kaminpar_tpu import telemetry
+    from kaminpar_tpu.context import PartitioningMode
+    from kaminpar_tpu.graphs.factories import generate
+    from kaminpar_tpu.kaminpar import KaMinPar, context_from_preset
+    from kaminpar_tpu.resilience.memory import estimate_run_bytes
+
+    graph = generate(f"rmat;n={MED_N};m={MED_M};seed={MED_SEED}")
+    ctx = context_from_preset("default")
+    ctx.partitioning.mode = PartitioningMode.EXTERNAL
+    ctx.resilience.memory_budget = float(
+        int(estimate_run_bytes(graph.n, graph.m, BENCH_K) * 0.25)
+    )
+    solver = KaMinPar(ctx)
+    solver.set_graph(graph)
+    # the external section rides on the telemetry stream; this
+    # measurement runs AFTER the main loop disabled telemetry, so it
+    # must arm its own stream or overlap would be permanently null —
+    # the r05 silent-coverage-loss class, just for the new keys
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        t0 = time.perf_counter()
+        part = solver.compute_partition(
+            k=BENCH_K, epsilon=BENCH_EPS, seed=1
+        )
+        wall = time.perf_counter() - t0
+        assert len(part) == graph.n and len(np.unique(part)) <= BENCH_K
+        section = telemetry.run_info().get("external") or {}
+        overlap = section.get("overlap_frac")
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+        telemetry.reset()
+    return round(wall, 2), overlap
 
 
 MED_N = 1 << 16
@@ -451,6 +510,20 @@ def _bench_line() -> dict:
     # refinement — ALWAYS present (null = no attribution recorded), so
     # the trajectory can never silently lose the quality signal
     line.update(quality_keys(best_report))
+    # out-of-core streaming coverage (round 13): a forced-budget
+    # external run of the medium graph — always-present keys (null =
+    # skipped/failed), so the scale path can never silently drop out
+    # of the trajectory like the r05 10M block did
+    ext_seconds = ext_overlap = None
+    if os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1":
+        try:
+            ext_seconds, ext_overlap = _measure_external()
+        except Exception as e:
+            import sys
+
+            print(f"bench: external measurement failed: {e}",
+                  file=sys.stderr)
+    line.update(external_keys(ext_seconds, ext_overlap))
     if best_report is not None:
         # rating-engine choices of the best run (ops/rating.py
         # selection, from the embedded report's `rating` section):

@@ -237,6 +237,27 @@ def build_parser() -> argparse.ArgumentParser:
         choices=[m.value for m in PartitioningMode],
         help="partitioning scheme override",
     )
+    p.add_argument(
+        "--scheme", dest="mode",
+        choices=[m.value for m in PartitioningMode],
+        help="alias of --mode; `--scheme external` runs the out-of-core "
+        "streaming partitioner (kaminpar_tpu/external/): the fine graph "
+        "stays host/disk-resident in chunks (gen: specs are regenerated "
+        "chunk-by-chunk and never materialized), LP + contraction "
+        "stream padded edge blocks through the device, and only coarse "
+        "levels are ever device-resident (docs/performance.md)",
+    )
+    p.add_argument(
+        "--external-chunk-edges", type=int, default=None, metavar="M",
+        help="external scheme: target edges per streamed chunk (default "
+        "2^22; shrunk automatically to fit --memory-budget)",
+    )
+    p.add_argument(
+        "--external-spill-dir", default=None, metavar="DIR",
+        help="external scheme: spill decoded fine-level chunks to DIR "
+        "once and re-read them per pass (fine graphs bigger than host "
+        "RAM stream from disk)",
+    )
     # common algorithm overrides (kaminpar_arguments.cc coarsening/refinement)
     p.add_argument("--lp-iterations", type=int, default=None)
     p.add_argument(
@@ -314,6 +335,10 @@ def make_context(args: argparse.Namespace) -> Context:
         ctx.resilience.budget_grace = args.budget_grace
     if args.memory_budget is not None:
         ctx.resilience.memory_budget = args.memory_budget
+    if args.external_chunk_edges is not None:
+        ctx.external.chunk_edges = args.external_chunk_edges
+    if args.external_spill_dir is not None:
+        ctx.external.spill_dir = args.external_spill_dir
     if args.seed is not None:  # -C config may set the seed; flag wins
         ctx.seed = args.seed
     return ctx
@@ -392,23 +417,46 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_batch_cli(args, ctx)
 
     t_io = time.perf_counter()
+    external_mode = ctx.partitioning.mode == PartitioningMode.EXTERNAL
     if args.graph.startswith("gen:"):
         # synthetic input, KaGen option-string style (the dKaMinPar CLI's
         # -G generator surface, kaminpar-io/dist_skagen.h):
         #   gen:rmat;n=65536;m=1000000;seed=1
-        from .graphs.factories import generate
+        graph = None
+        if external_mode:
+            # the external scheme streams generator specs: skagen chunk
+            # regeneration means the synthetic fine graph is NEVER
+            # materialized (generators with no streaming form fall back
+            # to the in-RAM build below and stream from host CSR)
+            from .external.chunkstore import StreamedSpecGraph
 
-        graph = generate(args.graph)
+            try:
+                graph = StreamedSpecGraph(
+                    args.graph, target_edges=ctx.external.chunk_edges
+                )
+            except ValueError:
+                graph = None
+        if graph is None:
+            from .graphs.factories import generate
+
+            graph = generate(args.graph)
     else:
-        graph = io_mod.load_graph(args.graph, fmt=args.format)
+        graph = io_mod.load_graph(
+            args.graph, fmt=args.format,
+            # disk-backed fine graphs stream without a full-file RAM
+            # spike: the external scheme asks for the lazy/mmap load of
+            # compressed containers (io/compressed_binary.py)
+            lazy=external_mode,
+        )
     perm = None
     if args.node_ordering == "degree-buckets":
+        from .external.chunkstore import StreamedSpecGraph
         from .graphs.compressed import CompressedHostGraph
 
-        if isinstance(graph, CompressedHostGraph):
+        if isinstance(graph, (CompressedHostGraph, StreamedSpecGraph)):
             print(
                 "error: --node-ordering is not supported for compressed "
-                "containers",
+                "containers or streamed generator specs",
                 file=sys.stderr,
             )
             return 1

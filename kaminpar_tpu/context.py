@@ -22,12 +22,16 @@ import numpy as np
 
 
 class PartitioningMode(str, enum.Enum):
-    """include/kaminpar-shm/kaminpar.h:94-98."""
+    """include/kaminpar-shm/kaminpar.h:94-98 (+ the out-of-core
+    streaming scheme, kaminpar_tpu/external/ — no reference analog; the
+    semi-external literature's arXiv 1404.4887 scheme mapped onto the
+    device pipeline)."""
 
     DEEP = "deep"
     RB = "rb"
     KWAY = "kway"
     VCYCLE = "vcycle"
+    EXTERNAL = "external"
 
 
 class ClusteringAlgorithm(str, enum.Enum):
@@ -446,6 +450,35 @@ class ResilienceContext:
 
 
 @dataclass
+class ExternalContext:
+    """Out-of-core streaming scheme (``--scheme external``,
+    kaminpar_tpu/external/, docs/performance.md): the fine graph stays
+    in host RAM (compressed chunks / plain CSR / a skagen generator
+    spec that regenerates chunks on demand) or on disk, and LP rating +
+    contraction stream over fixed-shape padded edge-block chunks on the
+    device — only coarse levels are ever device-resident."""
+
+    #: Target edges per streamed chunk.  Every chunk of a level shares
+    #: ONE padded edge-block bucket (the max chunk, padded), so the
+    #: whole stream drives one compiled executable per phase.
+    chunk_edges: int = 1 << 22
+    #: Streaming LP rounds per level (bulk-synchronous: moves are rated
+    #: against the round-start labels and applied once per round, which
+    #: is what makes the result chunk-count invariant).
+    lp_rounds: int = 3
+    #: Stream at least this many levels before the in-core handoff even
+    #: when no memory budget is declared (with a budget, streaming
+    #: continues until the coarse level's estimate fits it).
+    min_stream_levels: int = 1
+    #: Hard cap on streamed levels (stall safety).
+    max_stream_levels: int = 32
+    #: Disk spill tier: when set, decoded/generated chunks are written
+    #: here once and re-read per pass — fine graphs bigger than host
+    #: RAM stream from disk instead of being re-decoded/regenerated.
+    spill_dir: str = ""
+
+
+@dataclass
 class DebugContext:
     """kaminpar.h:484-496."""
 
@@ -503,6 +536,7 @@ class Context:
         default_factory=GraphCompressionContext
     )
     resilience: ResilienceContext = field(default_factory=ResilienceContext)
+    external: ExternalContext = field(default_factory=ExternalContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
 

@@ -129,6 +129,14 @@ def build_parser() -> argparse.ArgumentParser:
         "this flag exists so the two CLIs stay argument-compatible and "
         "fails with a pointer instead of 'unrecognized argument'",
     )
+    p.add_argument(
+        "--scheme", default=None, choices=["external"],
+        help="the out-of-core streaming scheme is served by the shm CLI "
+        "(python -m kaminpar_tpu --scheme external); the dist driver "
+        "shards ONE graph across the mesh instead of streaming it — "
+        "this flag exists so the two CLIs stay argument-compatible and "
+        "fails with a pointer instead of 'unrecognized argument'",
+    )
     from . import telemetry
 
     telemetry.add_cli_args(p)
@@ -137,6 +145,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.scheme is not None:
+        print(
+            "error: --scheme external runs on the shm pipeline — use "
+            "`python -m kaminpar_tpu GRAPH -k K --scheme external` "
+            "(docs/performance.md, out-of-core streaming)",
+            file=sys.stderr,
+        )
+        return 2
     if args.serve_batch is not None:
         print(
             "error: serve/batch mode runs on the shm pipeline — use "

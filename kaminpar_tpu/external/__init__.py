@@ -1,0 +1,29 @@
+"""Out-of-core streaming partitioner (``--scheme external``).
+
+ROADMAP item 4 made real: the fine graph lives in host RAM (compressed
+chunks, plain CSR, or a skagen generator spec regenerated chunk by
+chunk) or on disk, and LP rating + contraction run ON DEVICE over
+fixed-shape padded edge-block chunks — only coarse levels are ever
+device-resident.  The semi-external scheme of arXiv 1404.4887 mapped
+onto the padded-bucket device pipeline; Tera-Scale MGP (arXiv
+2410.19119) is the evidence the multilevel scheme survives this
+externalization without giving up quality.
+
+Three modules:
+
+  * :mod:`~kaminpar_tpu.external.chunkstore` — the node-range chunk
+    plan and sources (HostGraph / CompressedHostGraph / generator
+    spec), one shared padded edge-block bucket for the whole stream,
+    and the disk spill tier;
+  * :mod:`~kaminpar_tpu.external.stream_coarsen` — the device-streamed
+    bulk-synchronous LP rounds (label + cluster-weight vectors are the
+    only fine-graph-sized device state) and the chunked contraction
+    that accumulates the coarse CSR host-side;
+  * :mod:`~kaminpar_tpu.external.driver` — the ``--scheme external``
+    driver: streamed levels with checkpoint barriers, the in-core
+    handoff to the deep pipeline, and the schema-v9 ``external`` report
+    section.
+"""
+
+from .chunkstore import ChunkStore, StreamedSpecGraph  # noqa: F401
+from .driver import ExternalPartitioner, external_partition  # noqa: F401

@@ -1,0 +1,488 @@
+"""Node-range chunk plan + sources for the out-of-core stream.
+
+The streaming contract every consumer (stream_coarsen, the external
+driver, the gate's streamed recompute) relies on:
+
+  * chunks are **contiguous node ranges**, so every node's full
+    neighborhood lives in exactly one chunk — per-node ratings computed
+    from one chunk are *exact*, never partial;
+  * every chunk of a level is padded into **one shared edge-block
+    bucket** (the largest chunk, padded through ``caching.pad_size``
+    under the active pad policy), so the whole stream reuses ONE
+    compiled executable per phase instead of minting a bucket per
+    chunk;
+  * sources are **re-iterable**: compressed graphs re-decode
+    (``decode_range``), plain CSRs re-slice, skagen generator specs
+    re-generate (chunk determinism means the synthetic fine graph is
+    never materialized at all), and the optional disk **spill tier**
+    writes each chunk once and re-reads it per pass — fine graphs
+    bigger than host RAM stream from disk.
+
+Host pulls (decode, np.asarray of device results) are deliberately
+factored into the helpers here so driver code can call them from inside
+its timer spans without tripping tpulint R1 (the same hook shape as
+telemetry/quality.py — pinned by tests/lint_fixtures/r1_stream_*.py).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+#: Granularity of the shared edge-block bucket (slots).  Small enough
+#: that the tight pad policy keeps chunk buffers lean under the memory
+#: ladder, large enough that the bucket count stays O(1) per stream.
+EDGE_BUCKET_GRANULARITY = 4096
+
+
+def chunk_ranges(n: int, num_chunks: int) -> List[Tuple[int, int]]:
+    """Contiguous node ranges [v0, v1) — the same divmod split as
+    io/skagen.StreamedGraph.chunk_range, shared here so generator-backed
+    stores and CSR-backed stores chunk identically."""
+    num_chunks = max(1, min(int(num_chunks), max(int(n), 1)))
+    base, rem = divmod(int(n), num_chunks)
+    out = []
+    v0 = 0
+    for c in range(num_chunks):
+        v1 = v0 + base + (1 if c < rem else 0)
+        out.append((v0, v1))
+        v0 = v1
+    return out
+
+
+@dataclass
+class ChunkBlock:
+    """One padded edge-block chunk, host-side, ready for device upload.
+
+    ``src_local`` is the row id RELATIVE to ``v0`` (in [0, span)); pad
+    slots carry ``src_local == span`` (the phantom row the kernels route
+    to an overflow segment), ``dst == 0`` and ``w == 0`` so they
+    contribute nothing to ratings or contractions."""
+
+    v0: int
+    v1: int
+    src_local: np.ndarray  # i32[e_pad]
+    dst: np.ndarray  # i32[e_pad], global neighbor ids
+    w: np.ndarray  # WEIGHT[e_pad]
+    m_real: int
+
+
+class _HostCSRSource:
+    """Rows from a plain HostGraph (already RAM-resident; the stream
+    still buys executable reuse and a bounded device footprint)."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.xadj = np.asarray(graph.xadj, dtype=np.int64)
+
+    def rows(self, v0: int, v1: int):
+        lo, hi = int(self.xadj[v0]), int(self.xadj[v1])
+        adj = np.asarray(self.graph.adjncy[lo:hi])
+        ew = self.graph.edge_weights
+        return adj, (None if ew is None else np.asarray(ew[lo:hi]))
+
+
+class _CompressedSource:
+    """Rows decoded on demand from a CompressedHostGraph
+    (graphs/compressed.decode_range: peak host memory is one chunk)."""
+
+    def __init__(self, cgraph):
+        self.graph = cgraph
+        self.xadj = np.asarray(cgraph.xadj, dtype=np.int64)
+
+    def rows(self, v0: int, v1: int):
+        _, adj, ew = self.graph.decode_range(v0, v1)
+        return np.asarray(adj), (None if ew is None else np.asarray(ew))
+
+
+class _GeneratorSource:
+    """Rows regenerated from a skagen StreamedGraph whose chunk grid the
+    plan ADOPTS 1:1 — ``rows`` only ever asks for a grid range, so each
+    call regenerates exactly one deterministic generator chunk and the
+    flat fine graph never exists anywhere."""
+
+    def __init__(self, sg, xadj: np.ndarray):
+        self.sg = sg
+        self.xadj = np.asarray(xadj, dtype=np.int64)
+        self._ranges = {sg.chunk_range(c): c for c in range(sg.num_chunks)}
+
+    def rows(self, v0: int, v1: int):
+        c = self._ranges.get((v0, v1))
+        if c is None:
+            raise ValueError(
+                f"generator source only serves its own grid ranges, "
+                f"not [{v0}, {v1})"
+            )
+        ch = self.sg.chunk(c)
+        w = np.asarray(ch.adjwgt, dtype=np.int64)
+        return np.asarray(ch.adjncy), (None if (w == 1).all() else w)
+
+
+class ChunkStore:
+    """The chunk plan + padded-block reader over one fine graph.
+
+    Built by :func:`build_store`.  ``num_chunks`` is sized so the
+    average chunk carries ~``target_edges`` edges; ``e_pad`` (the shared
+    bucket) pads the LARGEST chunk, so skewed node ranges cost padding,
+    never a second executable.  Counters (``decoded_bytes``,
+    ``uploaded_bytes``, ``spilled_bytes``) feed the ``stream`` telemetry
+    events and the report's ``external`` section."""
+
+    def __init__(self, source, n: int, m: int,
+                 ranges: List[Tuple[int, int]], spill_dir: str = ""):
+        from .. import caching
+
+        self.source = source
+        self.n = int(n)
+        self.m = int(m)
+        self.ranges = ranges
+        self.num_chunks = len(ranges)
+        self.span = max((v1 - v0) for v0, v1 in ranges) if ranges else 1
+        xadj = source.xadj
+        max_edges = max(
+            (int(xadj[v1] - xadj[v0]) for v0, v1 in ranges), default=1
+        )
+        self.e_pad = caching.pad_size(
+            max(max_edges, 1), EDGE_BUCKET_GRANULARITY
+        )
+        self.spill_dir = spill_dir
+        self.decoded_bytes = 0
+        self.uploaded_bytes = 0
+        self.spilled_bytes = 0
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._validate_spill_dir()
+
+    def _spill_key(self) -> str:
+        """Identity of the (graph, chunk plan) the spill dir's files are
+        valid for: sizes, plan geometry, and a degree-prefix sample —
+        a chunk file from a different graph, chunk target, or
+        budget-shrunk plan must never be re-read as this one's rows."""
+        import hashlib
+
+        xadj = np.asarray(self.source.xadj, dtype=np.int64)
+        h = hashlib.sha256()
+        h.update(
+            f"n={self.n};m={self.m};chunks={self.num_chunks};"
+            f"span={self.span};e_pad={self.e_pad};".encode()
+        )
+        h.update(xadj[:2048].tobytes())
+        h.update(xadj[-2048:].tobytes())
+        return h.hexdigest()[:24]
+
+    def _validate_spill_dir(self) -> None:
+        """The spill dir is a CACHE keyed by :meth:`_spill_key`: a key
+        mismatch (different graph / chunk plan reusing the dir) drops
+        every stale chunk file instead of silently serving another
+        run's rows."""
+        meta_path = os.path.join(self.spill_dir, "spill.json")
+        key = self._spill_key()
+        try:
+            import json
+
+            with open(meta_path) as f:
+                if json.load(f).get("key") == key:
+                    return
+        except (OSError, ValueError):
+            pass
+        for fn in os.listdir(self.spill_dir):
+            if fn.startswith("chunk-") and fn.endswith(".npz"):
+                try:
+                    os.unlink(os.path.join(self.spill_dir, fn))
+                except OSError:
+                    pass
+        import json
+
+        tmp = meta_path + f".{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"key": key, "n": self.n, "m": self.m,
+                       "chunks": self.num_chunks}, f)
+        os.replace(tmp, meta_path)
+
+    # -- host side -------------------------------------------------------
+
+    def chunk_edges(self, c: int) -> int:
+        v0, v1 = self.ranges[c]
+        xadj = self.source.xadj
+        return int(xadj[v1] - xadj[v0])
+
+    def _rows(self, c: int):
+        """(adjncy, edge_w|None) of chunk c, through the spill tier when
+        one is configured: first touch writes the decoded chunk to disk,
+        later passes re-read it instead of re-decoding/regenerating."""
+        v0, v1 = self.ranges[c]
+        if self.spill_dir:
+            path = os.path.join(self.spill_dir, f"chunk-{c}.npz")
+            if os.path.exists(path):
+                with np.load(path) as z:
+                    adj = z["adjncy"]
+                    ew = z["edge_w"] if "edge_w" in z else None
+                self.decoded_bytes += int(adj.nbytes) + (
+                    0 if ew is None else int(ew.nbytes)
+                )
+                return adj, ew
+            adj, ew = self.source.rows(v0, v1)
+            arrays = {"adjncy": adj}
+            if ew is not None:
+                arrays["edge_w"] = ew
+            # np.savez appends .npz to bare names — keep the suffix on
+            # the temp file so the atomic replace finds what was written
+            tmp = path + f".{os.getpid()}.tmp.npz"
+            np.savez(tmp, **arrays)
+            os.replace(tmp, path)
+            self.spilled_bytes += int(adj.nbytes) + (
+                0 if ew is None else int(ew.nbytes)
+            )
+        else:
+            adj, ew = self.source.rows(v0, v1)
+        self.decoded_bytes += int(adj.nbytes) + (
+            0 if ew is None else int(ew.nbytes)
+        )
+        return adj, ew
+
+    def chunk_host(self, c: int) -> ChunkBlock:
+        """Chunk c decoded + padded into the shared bucket (numpy)."""
+        from ..dtypes import WEIGHT_DTYPE
+
+        v0, v1 = self.ranges[c]
+        adj, ew = self._rows(c)
+        xadj = self.source.xadj
+        deg = np.diff(xadj[v0 : v1 + 1])
+        m_real = int(len(adj))
+        src_local = np.full(self.e_pad, self.span, dtype=np.int32)
+        src_local[:m_real] = np.repeat(
+            np.arange(v1 - v0, dtype=np.int32), deg
+        )
+        dst = np.zeros(self.e_pad, dtype=np.int32)
+        dst[:m_real] = np.asarray(adj, dtype=np.int32)
+        w = np.zeros(self.e_pad, dtype=np.dtype(WEIGHT_DTYPE))
+        if ew is None:
+            w[:m_real] = 1
+        else:
+            w[:m_real] = np.asarray(ew).astype(np.dtype(WEIGHT_DTYPE))
+        return ChunkBlock(v0, v1, src_local, dst, w, m_real)
+
+    # -- device side -----------------------------------------------------
+
+    def upload(self, c: int):
+        """Decode + upload chunk c; returns device arrays
+        ``(src_local, dst, w, v0_dev, m_real_dev)``.  Dispatch is async:
+        the caller chains device work onto these without a host sync, so
+        the NEXT chunk's decode overlaps this chunk's compute."""
+        import jax
+        import jax.numpy as jnp
+
+        block = self.chunk_host(c)
+        self.uploaded_bytes += (
+            int(block.src_local.nbytes) + int(block.dst.nbytes)
+            + int(block.w.nbytes)
+        )
+        return (
+            jax.device_put(block.src_local),
+            jax.device_put(block.dst),
+            jax.device_put(block.w),
+            jnp.int32(block.v0),
+            jnp.int32(block.m_real),
+        )
+
+    def chunk_buffer_bytes(self) -> int:
+        """Device bytes one uploaded chunk occupies (the stream's whole
+        edge footprint: fine edges are never resident beyond this)."""
+        from ..dtypes import WEIGHT_DTYPE
+
+        return int(self.e_pad * (4 + 4 + np.dtype(WEIGHT_DTYPE).itemsize))
+
+
+def build_store(graph, target_edges: int, spill_dir: str = "") -> ChunkStore:
+    """The chunk plan for one fine graph: ``ceil(m / target_edges)``
+    contiguous node ranges over a Host CSR, a compressed container, or a
+    generator-spec wrapper (which brings its own grid)."""
+    from ..graphs.compressed import CompressedHostGraph
+    from ..graphs.host import HostGraph
+
+    n, m = int(graph.n), int(graph.m)
+    num_chunks = max(1, -(-m // max(int(target_edges), 1)))
+    if isinstance(graph, StreamedSpecGraph):
+        sg = graph.grid(num_chunks)
+        src = _GeneratorSource(sg, graph.xadj)
+        ranges = [sg.chunk_range(c) for c in range(sg.num_chunks)]
+        return ChunkStore(src, n, m, ranges, spill_dir=spill_dir)
+    if isinstance(graph, CompressedHostGraph):
+        src = _CompressedSource(graph)
+    elif isinstance(graph, HostGraph):
+        src = _HostCSRSource(graph)
+    else:
+        raise TypeError(
+            f"no chunk source for {type(graph).__name__} "
+            "(HostGraph, CompressedHostGraph, or StreamedSpecGraph)"
+        )
+    return ChunkStore(src, n, m, chunk_ranges(n, num_chunks),
+                      spill_dir=spill_dir)
+
+
+# ---------------------------------------------------------------------------
+# generator-spec fine graphs (never materialized)
+# ---------------------------------------------------------------------------
+
+
+class StreamedSpecGraph:
+    """A skagen generator spec wearing the HostGraph surface the facade
+    needs (n / m / xadj / weights / degrees) WITHOUT ever holding the
+    adjacency: one deterministic generation pass at construction records
+    the O(n) degree prefix, and every later consumer (the chunk store,
+    the gate's streamed recompute) regenerates chunks on demand —
+    skagen's chunk determinism guarantees every pass sees the same
+    graph."""
+
+    def __init__(self, spec: str, target_edges: int = 1 << 22):
+        from ..graphs.factories import parse_gen_spec
+        from ..io import skagen
+
+        self.spec = spec
+        # size the stats-pass grid from the SPEC's own edge estimate so
+        # its peak memory honors the target budget too (a fixed small
+        # grid would materialize O(m / grid) edges per probe chunk —
+        # unbounded on the tera-scale inputs this wrapper exists for)
+        try:
+            _, kw = parse_gen_spec(spec)
+            m_est = int(kw.get("m") or (
+                float(kw.get("n", 1)) * float(kw.get("avg_degree", 8.0))
+            ))
+        except Exception:
+            m_est = 0
+        probe_chunks = max(8, -(-max(m_est, 1) // max(int(target_edges), 1)))
+        probe = skagen.streamed(spec, num_chunks=probe_chunks)
+        self.kind = probe.kind
+        self._n = probe.n
+        xadj = np.zeros(probe.n + 1, dtype=np.int64)
+        tew = 0
+        unit = True
+        for ch in probe.chunks():
+            deg = np.asarray(ch.xadj[1:]) - np.asarray(ch.xadj[:-1])
+            xadj[ch.v_begin + 1 : ch.v_end + 1] = deg
+            w = np.asarray(ch.adjwgt, dtype=np.int64)
+            tew += int(w.sum())
+            if unit and len(w) and not (w == 1).all():
+                unit = False
+        np.cumsum(xadj, out=xadj)
+        self.xadj = xadj
+        self._m = int(xadj[-1])
+        self._total_edge_weight = tew
+        self._unit_edge_weights = unit
+        self._probe = probe
+        self.node_weights = None
+        self.edge_weights = None  # per-chunk only; see iter_rows
+        self.target_edges = int(target_edges)
+
+    # -- HostGraph surface ----------------------------------------------
+
+    @property
+    def n(self) -> int:
+        return self._n
+
+    @property
+    def m(self) -> int:
+        return self._m
+
+    def degrees(self) -> np.ndarray:
+        return (self.xadj[1:] - self.xadj[:-1]).astype(np.int64)
+
+    def node_weight_array(self) -> np.ndarray:
+        return np.ones(self._n, dtype=np.int64)
+
+    @property
+    def total_node_weight(self) -> int:
+        return self._n
+
+    @property
+    def total_edge_weight(self) -> int:
+        return self._total_edge_weight
+
+    # -- streaming surface ----------------------------------------------
+
+    def grid(self, num_chunks: int):
+        """A StreamedGraph over the SAME spec/seed with the requested
+        chunk grid (chunk determinism: the assembled graph is identical
+        for any grid)."""
+        from ..io import skagen
+
+        return skagen.streamed(self.spec, num_chunks=num_chunks)
+
+    def iter_rows(self, target_edges: Optional[int] = None) -> Iterator[
+        Tuple[int, int, np.ndarray, Optional[np.ndarray]]
+    ]:
+        """Yield (v0, v1, adjncy, edge_w|None) node-range blocks — the
+        streamed-metrics surface (gate recompute, result metrics)."""
+        te = int(target_edges or self.target_edges)
+        sg = self.grid(max(1, -(-self._m // max(te, 1))))
+        for ch in sg.chunks():
+            w = np.asarray(ch.adjwgt, dtype=np.int64)
+            ew = None if (len(w) == 0 or (w == 1).all()) else w
+            yield ch.v_begin, ch.v_end, np.asarray(ch.adjncy), ew
+
+    def to_host_graph(self):
+        """Materialize the full CSR (the rare paths only: gate repair,
+        non-external schemes) — the one operation that costs the flat
+        edge list this wrapper otherwise never holds."""
+        from ..io import skagen
+
+        return skagen.hostgraph_from_stream(self._probe)
+
+
+def streamed_partition_metrics(graph: StreamedSpecGraph, partition,
+                               k: int) -> dict:
+    """host_partition_metrics over a generator-spec graph without
+    materializing it: the cut accumulates over regenerated chunks —
+    the StreamedSpecGraph twin of
+    graphs.compressed.compressed_partition_metrics (same definitions,
+    same RESULT-line semantics)."""
+    partition = np.asarray(partition)
+    cut = 0
+    for v0, v1, adj, ew in graph.iter_rows():
+        deg = (graph.xadj[v0 + 1 : v1 + 1] - graph.xadj[v0:v1])
+        src = np.repeat(np.arange(v0, v1, dtype=np.int64), deg)
+        mask = partition[src] != partition[adj]
+        cut += int(mask.sum() if ew is None else np.asarray(ew)[mask].sum())
+    nw = graph.node_weight_array()
+    bw = np.zeros(k, dtype=np.int64)
+    np.add.at(bw, partition, nw)
+    perfect = max(1, -(-int(nw.sum()) // max(k, 1)))
+    return {
+        "cut": cut // 2,
+        "block_weights": bw,
+        "imbalance": bw.max() / perfect - 1.0 if k else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# host-pull helpers for the streaming kernels (keep driver spans R1-clean)
+# ---------------------------------------------------------------------------
+
+
+def pull_moved(moved) -> int:
+    """One scalar readback at a round boundary (the stream's only
+    per-round host sync — this is where the async chunk pipeline
+    drains)."""
+    return int(moved)
+
+
+def pull_labels(labels, n: int) -> np.ndarray:
+    """The converged label vector, host-side (one n-sized pull per
+    streamed level, at the LP -> contraction boundary)."""
+    return np.asarray(labels[:n], dtype=np.int64)
+
+
+def pull_coarse_groups(cu_g, cv_g, w_g) -> Tuple[np.ndarray, np.ndarray,
+                                                 np.ndarray]:
+    """One chunk's deduplicated coarse edges, host-side, compacted to
+    the valid groups."""
+    cu = np.asarray(cu_g)
+    keep = cu >= 0
+    return (
+        cu[keep].astype(np.int64),
+        np.asarray(cv_g)[keep].astype(np.int64),
+        np.asarray(w_g)[keep].astype(np.int64),
+    )

@@ -1,0 +1,436 @@
+"""The ``--scheme external`` driver: streamed levels, checkpoint
+barriers, governor integration, and the in-core handoff.
+
+Flow (the out-of-core half of the deep pipeline):
+
+  1. size the chunk plan against the declared memory budget (the chunk
+     target shrinks until the stream state — one double-buffered edge
+     block + the O(n) label/weight vectors — fits; if even the floor
+     chunk cannot, a structured DeviceOOM sends the facade's ladder on);
+  2. stream-coarsen level by level (stream_coarsen.py) until the coarse
+     level's ``memory.estimate_run_bytes`` fits the budget (with no
+     budget: ``ctx.external.min_stream_levels`` levels, so the fine
+     level is never device-resident either way), crossing a
+     ``stream-coarsen`` checkpoint barrier after every contraction —
+     a kill mid-stream resumes at the completed level, cut-identical;
+  3. hand the coarse graph to the UNCHANGED deep pipeline (its own
+     barriers/resume/refinement apply; the streamed level snapshots are
+     *pinned* in the checkpoint manifest so a kill during the in-core
+     phase still restores the projection maps);
+  4. project the partition back through the host-side cluster maps.
+
+Every run annotates the schema-v9 ``external`` report section: chunk
+counts, decoded vs uploaded bytes, the upload/compute overlap fraction,
+and ``fine_device_resident_bytes`` (0 whenever >= 1 level streamed —
+the bytes a fine-level upload would have cost are reported next to it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import numpy as np
+
+from ..context import Context
+from ..utils import timer
+from ..utils.logger import log_progress
+
+#: Floor for the budget-driven chunk shrink: below this many edges per
+#: chunk the per-chunk launch overhead dominates any memory win.
+MIN_CHUNK_EDGES = 1 << 15
+
+
+class ExternalPartitioner:
+    """Out-of-core streaming partitioner (scheme ``external``)."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    # -- entry -----------------------------------------------------------
+
+    def partition(self, graph) -> np.ndarray:
+        from .. import telemetry
+        from ..resilience import checkpoint as ckpt
+        from ..resilience import memory as memory_mod
+        from ..resilience.errors import DeviceOOM
+
+        ctx = self.ctx
+        ext = ctx.external
+        k = int(ctx.partition.k)
+        n, m = int(graph.n), int(graph.m)
+        budget = memory_mod.budget_bytes(ctx)
+
+        # chunk sizing: shrink the target until the stream state fits
+        # (an explicitly configured smaller target is honored as-is;
+        # the floor only bounds the budget-driven shrink)
+        chunk_edges = max(1, int(ext.chunk_edges))
+        if budget:
+            while (
+                chunk_edges > MIN_CHUNK_EDGES
+                and memory_mod.estimate_stream_bytes(n, chunk_edges, k)
+                > budget
+            ):
+                chunk_edges //= 2
+            if memory_mod.estimate_stream_bytes(n, chunk_edges, k) > budget:
+                raise DeviceOOM(
+                    f"external preflight: floor stream state "
+                    f"{memory_mod.estimate_stream_bytes(n, chunk_edges, k)} "
+                    f"bytes exceeds the budget {budget} (n={n}, k={k})",
+                    site="device-oom",
+                )
+        target = (
+            int(budget * memory_mod.STREAM_TARGET_FRACTION)
+            if budget else None
+        )
+
+        cmaps, current, start_level = self._take_resume(graph)
+        if current is None:
+            current = graph
+
+        levels_meta: List[dict] = []
+        level = start_level
+        stop_requested = False
+        with timer.scoped_timer("external-stream"):
+            while True:
+                n_c, m_c = _sizes(current)
+                fits = (
+                    target is None
+                    or memory_mod.estimate_run_bytes(n_c, m_c, k) <= target
+                )
+                # even with a roomy budget the external scheme streams
+                # its minimum level count — the fine level is never
+                # device-resident unless the input is already tiny
+                satisfied = fits and (
+                    level >= max(0, int(ext.min_stream_levels))
+                )
+                if (
+                    satisfied
+                    or stop_requested
+                    or level >= int(ext.max_stream_levels)
+                    or n_c <= max(2 * ctx.coarsening.contraction_limit, 2)
+                ):
+                    break
+                # the coarsener's per-level cap formula, derived from
+                # the LEVEL's node count — deterministic from the level
+                # inputs, so a resumed run re-derives identical caps
+                cap = max(
+                    1,
+                    int(ctx.coarsening.max_cluster_weight(
+                        n_c, int(ctx.partition.total_node_weight),
+                        ctx.partition,
+                    )),
+                )
+                coarse, cmap, meta = self._stream_level(
+                    current, level, cap, chunk_edges
+                )
+                if coarse is None:
+                    break  # clustering stalled even under the relaxed cap
+                cmaps.append(cmap)
+                current = coarse
+                levels_meta.append(meta)
+                stop_requested = not ckpt.barrier(
+                    "stream-coarsen", level=level, scheme="external",
+                    payload=_level_payload(level, coarse, cmap),
+                    keep=[f"stream-level-{j}" for j in range(level)],
+                    meta={"stream_levels": level + 1},
+                )
+                _pin_level(level)
+                level += 1
+
+        handoff = self._handoff_graph(current)
+        h_n, h_m = _sizes(handoff)
+        telemetry.annotate(external=_section(
+            levels_meta, cmaps, graph, handoff_n=h_n, handoff_m=h_m,
+            streamed=len(cmaps), resumed=start_level, k=k,
+        ))
+        log_progress(
+            f"external: streamed {len(cmaps)} level(s) down to "
+            f"n={h_n} m={h_m}; handing off to the in-core deep pipeline"
+        )
+
+        # in-core handoff: the UNCHANGED device pipeline, with its own
+        # preflight, barriers, refinement, and (inside the facade) gate
+        part = self._incore_partition(handoff)
+        with timer.scoped_timer("external-projection"):
+            part = _project(part, cmaps)
+        return np.asarray(part, dtype=np.int32)[: graph.n]
+
+    def _incore_partition(self, handoff) -> np.ndarray:
+        """The in-core phase over the coarse graph.  The external
+        scheme's own handoff is the deep pipeline; when the MEMORY
+        LADDER rerouted rung 3 here from another scheme, that scheme's
+        driver runs instead (the semi_external_partition dispatch
+        contract it replaced)."""
+        from ..context import PartitioningMode
+
+        mode = self.ctx.partitioning.mode
+        if mode == PartitioningMode.KWAY:
+            from ..partitioning.kway import KWayMultilevelPartitioner
+
+            return KWayMultilevelPartitioner(self.ctx).partition(handoff)
+        if mode == PartitioningMode.RB:
+            from ..partitioning.rb_scheme import RBMultilevelPartitioner
+
+            return RBMultilevelPartitioner(self.ctx).partition(handoff)
+        if mode == PartitioningMode.VCYCLE:
+            from ..partitioning.vcycle import (
+                VcycleDeepMultilevelPartitioner,
+            )
+
+            return VcycleDeepMultilevelPartitioner(self.ctx).partition(
+                handoff
+            )
+        from ..partitioning.deep import DeepMultilevelPartitioner
+
+        return DeepMultilevelPartitioner(self.ctx).partition(handoff)
+
+    # -- one streamed level ---------------------------------------------
+
+    def _stream_level(self, graph, level: int, cap: int,
+                      chunk_edges: int) -> Tuple[Any, Any, dict]:
+        """Stream-coarsen one level: LP rounds + chunked contraction.
+        Returns (coarse HostGraph | None on stall, cmap, meta)."""
+        from .. import telemetry
+        from . import chunkstore, stream_coarsen
+
+        ext = self.ctx.external
+        spill = ext.spill_dir if level == 0 else ""
+        store = chunkstore.build_store(graph, chunk_edges, spill_dir=spill)
+        node_weights = getattr(graph, "node_weights", None)
+        seed = (int(self.ctx.seed) * 31 + level * 9973) & 0x7FFFFFFF
+
+        labels_host, lp_stats, cap_used = self._cluster_level(
+            store, node_weights, cap, seed
+        )
+        c_n = int(np.unique(labels_host).size)
+        stalled = c_n >= stream_coarsen.STALL_FRACTION * store.n
+        if stalled:
+            return None, None, {}
+        with timer.scoped_timer("stream-contract"):
+            coarse, cmap, ct_stats = stream_coarsen.stream_contract(
+                store, labels_host, node_weights
+            )
+        decode_s = lp_stats["decode_s"] + ct_stats["decode_s"]
+        drain_s = lp_stats["drain_s"] + ct_stats["drain_s"]
+        meta = {
+            "level": level,
+            "chunks": store.num_chunks,
+            "fine_n": store.n,
+            "fine_m": store.m,
+            "coarse_n": int(coarse.n),
+            "coarse_m": int(coarse.m),
+            "rounds": lp_stats["rounds"],
+            "moved": lp_stats["moved"],
+            "cap": cap_used,
+            "decoded_bytes": store.decoded_bytes,
+            "uploaded_bytes": store.uploaded_bytes,
+            "spilled_bytes": store.spilled_bytes,
+            "chunk_buffer_bytes": store.chunk_buffer_bytes(),
+            "decode_s": round(decode_s, 4),
+            "drain_s": round(drain_s, 4),
+            "overlap_frac": _overlap(decode_s, drain_s),
+        }
+        telemetry.event("stream", **meta)
+        log_progress(
+            f"external level {level}: n={coarse.n} m={coarse.m} "
+            f"({store.num_chunks} chunk(s), overlap "
+            f"{meta['overlap_frac']:.2f})"
+        )
+        return coarse, cmap, meta
+
+    def _cluster_level(self, store, node_weights, cap: int, seed: int):
+        """Streaming LP with the stall-relax retry (the coarsener's
+        forced-shrink idiom): a clustering that barely shrinks re-runs
+        once under a doubled cluster-weight cap.  Cap relaxation is
+        LOCAL to the level, so a resumed run re-derives the same caps."""
+        from . import chunkstore, stream_coarsen
+
+        rounds = int(self.ctx.external.lp_rounds)
+        cap_used = cap
+        for attempt in range(2):
+            labels, cluster_w, node_w = stream_coarsen.make_vectors(
+                store, node_weights
+            )
+            with timer.scoped_timer("stream-lp"):
+                labels, cluster_w, lp_stats = stream_coarsen.stream_lp(
+                    store, labels, cluster_w, node_w, cap_used, seed, rounds
+                )
+            labels_host = chunkstore.pull_labels(labels, store.n)
+            c_n = int(np.unique(labels_host).size)
+            if c_n < stream_coarsen.STALL_FRACTION * store.n or attempt:
+                break
+            cap_used = cap_used * 2
+        return labels_host, lp_stats, cap_used
+
+    # -- handoff / resume ------------------------------------------------
+
+    def _handoff_graph(self, current):
+        """The graph the in-core deep pipeline receives.  A generator
+        wrapper that never streamed (tiny input) materializes here —
+        the one case the fine level becomes device-resident, reported
+        as such in the `external` section."""
+        from .chunkstore import StreamedSpecGraph
+
+        if isinstance(current, StreamedSpecGraph):
+            return current.to_host_graph()
+        return current
+
+    def _take_resume(self, graph):
+        """Re-enter mid-stream: restore the completed streamed levels'
+        cluster maps + the newest coarse CSR from the checkpoint.
+
+        Two kill sites resolve differently: a kill at a
+        ``stream-coarsen`` barrier left scheme="external" — the resume
+        state is CONSUMED here and streaming continues at the next
+        level; a kill during the in-core phase left scheme="deep" — the
+        pinned stream-level snapshots are only PEEKED (pending_resume)
+        so the deep driver can still consume its own state and re-enter
+        its hierarchy."""
+        from .. import telemetry
+        from ..graphs.host import HostGraph
+        from ..resilience import checkpoint as ckpt
+
+        arrays = None
+        res = ckpt.take_resume("external")
+        if res is not None:
+            arrays = res.get("arrays")
+        else:
+            mgr = ckpt.active()
+            if mgr is not None and not ckpt.suspended():
+                pend = mgr.pending_resume()
+                if pend is not None:
+                    arrays = pend.get("arrays")
+        if not arrays:
+            return [], None, 0
+        names = sorted(
+            (nm for nm in arrays if nm.startswith("stream-level-")),
+            key=lambda s: int(s.rsplit("-", 1)[1]),
+        )
+        if not names:
+            return [], None, 0
+        cmaps = [
+            np.asarray(arrays[nm]["cmap"], dtype=np.int32) for nm in names
+        ]
+        last = arrays[names[-1]]
+        edge_w = last["edge_w"]
+        coarse = HostGraph(
+            xadj=np.asarray(last["xadj"], dtype=np.int64),
+            adjncy=np.asarray(last["adjncy"], dtype=np.int32),
+            node_weights=np.asarray(last["node_w"], dtype=np.int64),
+            edge_weights=(
+                np.asarray(edge_w, dtype=np.int64) if edge_w.size else None
+            ),
+        )
+        mgr = ckpt.active()
+        if mgr is not None:
+            mgr.pin(names)
+        telemetry.event(
+            "resume", scheme="external", stage="stream-coarsen",
+            level=len(names) - 1, levels_restored=len(names),
+        )
+        log_progress(
+            f"resumed external stream at level {len(names)} "
+            f"({len(names)} streamed level(s) restored)"
+        )
+        return cmaps, coarse, len(names)
+
+
+# ---------------------------------------------------------------------------
+# module-level helpers (host pulls live OUTSIDE the driver's timer spans —
+# the tpulint R1 hook shape, pinned by tests/lint_fixtures/r1_stream_*.py)
+# ---------------------------------------------------------------------------
+
+
+def _sizes(graph) -> Tuple[int, int]:
+    return int(graph.n), int(graph.m)
+
+
+def _project(part: np.ndarray, cmaps: List[np.ndarray]) -> np.ndarray:
+    part = np.asarray(part, dtype=np.int32)
+    for cmap in reversed(cmaps):
+        part = part[cmap]
+    return part
+
+
+def _overlap(decode_s: float, drain_s: float) -> float:
+    """Upload/compute overlap fraction: the share of host-side stream
+    work (chunk decode + upload dispatch) that ran while the device's
+    async queue was busy, i.e. NOT spent blocked draining the device.
+    1.0 = the host never waited; 0.0 = fully serialized."""
+    total = decode_s + drain_s
+    return round(decode_s / total, 4) if total > 0 else 0.0
+
+
+def _level_payload(level: int, coarse, cmap):
+    """Deferred checkpoint payload for one streamed level (built only
+    when a checkpoint manager is armed)."""
+    def build():
+        return {f"stream-level-{level}": {
+            "xadj": np.asarray(coarse.xadj, dtype=np.int64),
+            "adjncy": np.asarray(coarse.adjncy, dtype=np.int32),
+            "node_w": np.asarray(coarse.node_weight_array(), dtype=np.int64),
+            "edge_w": np.asarray(coarse.edge_weight_array(), dtype=np.int64),
+            "cmap": np.asarray(cmap, dtype=np.int32),
+            "dims": np.asarray(
+                [len(cmap), int(coarse.n), int(coarse.m)], dtype=np.int64
+            ),
+        }}
+    return build
+
+
+def _pin_level(level: int) -> None:
+    """Pin the just-written stream-level snapshot so the deep phase's
+    own barriers keep carrying it (the projection maps must survive a
+    kill at ANY later barrier)."""
+    from ..resilience import checkpoint as ckpt
+
+    mgr = ckpt.active()
+    if mgr is not None:
+        mgr.pin([f"stream-level-{level}"])
+
+
+def _section(levels_meta: List[dict], cmaps, graph, handoff_n: int,
+             handoff_m: int, streamed: int, resumed: int, k: int) -> dict:
+    """The run report's schema-v9 ``external`` section."""
+    from ..resilience import memory as memory_mod
+
+    n, m = _sizes(graph)
+    n_pad, m_pad, _ = memory_mod.padded_bucket(n, m, k)
+    fine_csr = memory_mod.device_csr_bytes(n_pad, m_pad)
+    decode_s = sum(lv.get("decode_s", 0.0) for lv in levels_meta)
+    drain_s = sum(lv.get("drain_s", 0.0) for lv in levels_meta)
+    return {
+        "enabled": True,
+        "levels": levels_meta,
+        "streamed_levels": streamed,
+        "resumed_levels": resumed,
+        "chunks_total": sum(lv.get("chunks", 0) for lv in levels_meta),
+        "decoded_bytes": sum(
+            lv.get("decoded_bytes", 0) for lv in levels_meta
+        ),
+        "uploaded_bytes": sum(
+            lv.get("uploaded_bytes", 0) for lv in levels_meta
+        ),
+        "spilled_bytes": sum(
+            lv.get("spilled_bytes", 0) for lv in levels_meta
+        ),
+        "overlap_frac": _overlap(decode_s, drain_s),
+        # 0 whenever >= 1 level streamed: the fine CSR never lands on
+        # the device (only chunk buffers + the O(n) vectors do); the
+        # in-core cost it avoided is reported next to it
+        "fine_device_resident_bytes": 0 if streamed > 0 else fine_csr,
+        "fine_csr_bytes": fine_csr,
+        "handoff": {"n": handoff_n, "m": handoff_m,
+                    "estimate_bytes": memory_mod.estimate_run_bytes(
+                        handoff_n, handoff_m, k)},
+    }
+
+
+def external_partition(graph, ctx, facade=None) -> np.ndarray:
+    """Functional entry for the memory ladder's rung-3 reroute
+    (resilience/memory.py): run the streaming subsystem over whatever
+    graph the ladder holds (host CSR, compressed, or spec wrapper).
+    ``facade`` is accepted for signature parity with the legacy
+    ``semi_external_partition`` it replaces as rung 3's primary."""
+    del facade
+    return ExternalPartitioner(ctx).partition(graph)

@@ -1,0 +1,270 @@
+"""Device-streamed coarsening: bulk-sync LP rounds + chunked contraction.
+
+The out-of-core inversion of ops/lp.py + ops/contraction.py: instead of
+the graph living on device and the kernels sweeping it whole, only the
+**label / cluster-weight / node-weight vectors** (O(n)) are
+device-resident and the edge list streams through one fixed-shape padded
+chunk buffer.  Per LP round:
+
+  1. for each chunk (async-dispatched, so chunk ``i+1``'s host decode
+     overlaps chunk ``i``'s device compute): gather the round-start
+     labels of the chunk's neighbors, aggregate per-(row, label)
+     connection weights (``ops.segments.aggregate_by_key`` — exact,
+     because node-range chunks hold complete rows), argmax per row with
+     hashed tie-breaking and a cluster-weight-cap feasibility mask, and
+     scatter the per-node *wanted* label into the round's wish vector;
+  2. one global apply: capacity-respecting prefix acceptance per target
+     cluster (``accept_prefix_by_capacity``, priority = node id) against
+     the ROUND-START weights, then the label/weight vectors update.
+
+Rating against round-start labels + one deterministic global apply is
+what makes the result **chunk-count invariant**: any chunking of the
+same graph produces bitwise-identical labels (pinned in
+tests/test_external.py), so operators can trade chunk size against
+overlap freely without forking results.
+
+Contraction streams the same chunks once more: per chunk the device
+maps endpoints through the (device-resident) cluster map, deduplicates
+with ``aggregate_by_key``, and the host accumulates the deduplicated
+coarse COO with periodic re-dedup — peak host memory is
+O(coarse m + chunk), the ``resilience/memory._host_contract`` idiom at
+device speed.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import caching
+from ..dtypes import WEIGHT_DTYPE
+from ..ops.segments import (
+    accept_prefix_by_capacity,
+    aggregate_by_key,
+    apply_move_weight_delta,
+    argmax_per_segment,
+)
+from . import chunkstore
+
+#: Clustering is considered stalled when the coarse node count stays
+#: above this fraction of the fine count (the semi-external rule).
+STALL_FRACTION = 0.95
+
+
+# ---------------------------------------------------------------------------
+# streaming LP
+# ---------------------------------------------------------------------------
+
+
+def make_vectors(store: chunkstore.ChunkStore, node_weights):
+    """The device-resident fine-level state: labels (identity), cluster
+    weights (node weights), node weights — padded so a chunk-span slice
+    starting at any real v0 never clamps (``n_vec >= n + span + 1``)."""
+    n = store.n
+    n_vec = caching.pad_size(n + store.span + 1, 256)
+    labels = jnp.arange(n_vec, dtype=jnp.int32)
+    nw = np.zeros(n_vec, dtype=np.dtype(WEIGHT_DTYPE))
+    if node_weights is None:
+        nw[:n] = 1
+    else:
+        nw[:n] = np.asarray(node_weights).astype(np.dtype(WEIGHT_DTYPE))
+    node_w = jax.device_put(nw)
+    cluster_w = node_w  # every node starts as its own cluster
+    return labels, cluster_w, node_w
+
+
+@partial(jax.jit, static_argnames=("span",))
+def _chunk_wanted(labels, cluster_w, node_w, wanted, cap,
+                  src_local, dst, w, v0, m_real, salt, span: int):
+    """One chunk's wish pass: per-row best feasible label vs the
+    round-start state, written into the round's wish vector.  Pure
+    device work — the driver chains these without a host sync."""
+    e_pad = src_local.shape[0]
+    valid = jnp.arange(e_pad, dtype=jnp.int32) < m_real
+    row = jnp.where(valid, src_local, span).astype(jnp.int32)
+    n_vec = labels.shape[0]
+    tl = jnp.where(valid, labels[jnp.clip(dst, 0, n_vec - 1)], -1)
+    w_m = jnp.where(valid, w, 0)
+    r_g, t_g, w_g = aggregate_by_key(row, tl, w_m)
+
+    nw_rows = lax.dynamic_slice(node_w, (v0,), (span,))
+    mover_w = nw_rows[jnp.clip(r_g, 0, span - 1)]
+    t_clip = jnp.clip(t_g, 0, n_vec - 1)
+    feasible = (t_g >= 0) & (cluster_w[t_clip] + mover_w <= cap)
+    best, _ = argmax_per_segment(
+        r_g, t_g, w_g, num_segments=span, tie_salt=salt, feasible=feasible
+    )
+    cur = lax.dynamic_slice(labels, (v0,), (span,))
+    want = jnp.where((best >= 0) & (best != cur), best, -1).astype(jnp.int32)
+    return lax.dynamic_update_slice(wanted, want, (v0,))
+
+
+@jax.jit
+def _apply_round(labels, cluster_w, node_w, wanted, cap):
+    """The round's global commit: per-target prefix acceptance against
+    the round-start headroom (node-id priority — deterministic and
+    chunk-count independent), then label/weight updates.  Conservative
+    on capacity: departures in the same round free no headroom, so the
+    cap is NEVER exceeded (the exactness the rung-3 host LP fix pins)."""
+    n_vec = labels.shape[0]
+    mover = wanted >= 0
+    headroom = jnp.maximum(cap - cluster_w, 0)
+    accept = accept_prefix_by_capacity(
+        jnp.where(mover, wanted, -1),
+        jnp.arange(n_vec, dtype=jnp.int32),
+        jnp.where(mover, node_w, 0),
+        headroom,
+    )
+    new_labels = jnp.where(accept, wanted, labels).astype(jnp.int32)
+    new_cw = apply_move_weight_delta(
+        cluster_w, labels, jnp.where(accept, wanted, labels), accept, node_w
+    )
+    moved = jnp.sum(accept.astype(jnp.int32))
+    return new_labels, new_cw, moved
+
+
+def stream_lp(store: chunkstore.ChunkStore, labels, cluster_w, node_w,
+              cap: int, seed: int, rounds: int):
+    """Run up to ``rounds`` streaming LP rounds; returns
+    ``(labels, cluster_w, stats)`` with the decode/drain timings the
+    overlap accounting needs: the drain (one scalar pull per round) is
+    the stream's only host sync, so chunk decodes that ran before it
+    overlapped the device's async dispatch queue by construction."""
+    cap_dev = jnp.asarray(
+        min(int(cap), int(np.iinfo(np.dtype(WEIGHT_DTYPE)).max)),
+        dtype=node_w.dtype,
+    )
+    stats = {"rounds": 0, "moved": 0, "decode_s": 0.0, "drain_s": 0.0}
+    for r in range(max(1, int(rounds))):
+        wanted = jnp.full(labels.shape[0], -1, dtype=jnp.int32)
+        salt = jnp.int32((seed * 7919 + r * 104729) & 0x7FFFFFFF)
+        for c in range(store.num_chunks):
+            t0 = time.perf_counter()
+            src_local, dst, w, v0, m_real = store.upload(c)
+            stats["decode_s"] += time.perf_counter() - t0
+            wanted = _chunk_wanted(
+                labels, cluster_w, node_w, wanted, cap_dev,
+                src_local, dst, w, v0, m_real, salt, store.span,
+            )
+        labels, cluster_w, moved = _apply_round(
+            labels, cluster_w, node_w, wanted, cap_dev
+        )
+        t0 = time.perf_counter()
+        moved_i = chunkstore.pull_moved(moved)
+        stats["drain_s"] += time.perf_counter() - t0
+        stats["rounds"] = r + 1
+        stats["moved"] += moved_i
+        if moved_i == 0:
+            break
+    return labels, cluster_w, stats
+
+
+# ---------------------------------------------------------------------------
+# chunked contraction (coarse CSR accumulates host-side)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _chunk_coarse(cmap_dev, src_local, dst, w, v0, m_real):
+    """Map one chunk's endpoints through the cluster map and
+    deduplicate inter-cluster edges on device; the host pulls only the
+    deduplicated groups."""
+    e_pad = src_local.shape[0]
+    n_vec = cmap_dev.shape[0]
+    valid = jnp.arange(e_pad, dtype=jnp.int32) < m_real
+    g_src = jnp.clip(v0 + src_local, 0, n_vec - 1)
+    cu = jnp.where(valid, cmap_dev[g_src], -1)
+    cv = jnp.where(valid, cmap_dev[jnp.clip(dst, 0, n_vec - 1)], -1)
+    keep = valid & (cu >= 0) & (cv >= 0) & (cu != cv)
+    cu = jnp.where(keep, cu, -1)
+    cv = jnp.where(keep, cv, 0)
+    w2 = jnp.where(keep, w, 0)
+    return aggregate_by_key(cu, cv, w2)
+
+
+def stream_contract(store: chunkstore.ChunkStore, labels_host: np.ndarray,
+                    node_weights) -> Tuple[object, np.ndarray, dict]:
+    """Contract the streamed fine graph under ``labels_host``.
+
+    Returns ``(coarse HostGraph, cmap, stats)``.  The coarse COO
+    accumulates host-side with periodic re-dedup, so the host high-water
+    stays ~O(coarse m + one chunk's groups).  Chunk c's groups are
+    absorbed only after chunk c+1 has been dispatched, so the host pull
+    overlaps the next chunk's device compute."""
+    from ..graphs.host import HostGraph
+
+    n = store.n
+    uniq, cmap = np.unique(labels_host[:n], return_inverse=True)
+    c_n = int(len(uniq))
+    cmap = cmap.astype(np.int64)
+    nw = (
+        np.ones(n, dtype=np.int64) if node_weights is None
+        else np.asarray(node_weights, dtype=np.int64)
+    )
+    cw = np.zeros(max(c_n, 1), dtype=np.int64)
+    np.add.at(cw, cmap, nw)
+
+    # n_vec-padded device cluster map (-1 on pad slots → dropped edges)
+    n_vec = caching.pad_size(n + store.span + 1, 256)
+    cmap_full = np.full(n_vec, -1, dtype=np.int32)
+    cmap_full[:n] = cmap.astype(np.int32)
+    cmap_dev = jax.device_put(cmap_full)
+
+    acc_key = np.empty(0, dtype=np.int64)
+    acc_w = np.empty(0, dtype=np.int64)
+
+    def dedup(keys, weights):
+        uk, inv = np.unique(keys, return_inverse=True)
+        uw = np.zeros(len(uk), dtype=np.int64)
+        np.add.at(uw, inv, weights)
+        return uk, uw
+
+    stats = {"decode_s": 0.0, "drain_s": 0.0}
+    pending = None
+    for c in range(store.num_chunks):
+        t0 = time.perf_counter()
+        src_local, dst, w, v0, m_real = store.upload(c)
+        stats["decode_s"] += time.perf_counter() - t0
+        groups = _chunk_coarse(cmap_dev, src_local, dst, w, v0, m_real)
+        if pending is not None:
+            acc_key, acc_w = _absorb(
+                pending, c_n, acc_key, acc_w, dedup, stats
+            )
+        pending = groups
+    if pending is not None:
+        acc_key, acc_w = _absorb(pending, c_n, acc_key, acc_w, dedup, stats)
+    acc_key, acc_w = dedup(acc_key, acc_w)
+
+    cu = (acc_key // max(c_n, 1)).astype(np.int64)
+    cv = (acc_key % max(c_n, 1)).astype(np.int32)
+    xadj = np.zeros(c_n + 1, dtype=np.int64)
+    np.add.at(xadj, cu + 1, 1)
+    np.cumsum(xadj, out=xadj)
+    coarse = HostGraph(
+        xadj=xadj,
+        adjncy=cv,
+        node_weights=cw[:c_n],
+        edge_weights=acc_w if (acc_w != 1).any() else None,
+    )
+    return coarse, cmap.astype(np.int32), stats
+
+
+def _absorb(groups, c_n, acc_key, acc_w, dedup, stats):
+    """Pull one chunk's deduplicated groups (a host sync — scheduled
+    after the NEXT chunk's dispatch so it overlaps device compute) and
+    fold them into the accumulator."""
+    t0 = time.perf_counter()
+    cu, cv, w = chunkstore.pull_coarse_groups(*groups)
+    stats["drain_s"] += time.perf_counter() - t0
+    key = cu * np.int64(max(c_n, 1)) + cv
+    acc_key = np.concatenate([acc_key, key])
+    acc_w = np.concatenate([acc_w, w])
+    if len(acc_key) > 4 * max(len(key), 1 << 20):
+        acc_key, acc_w = dedup(acc_key, acc_w)
+    return acc_key, acc_w

@@ -22,13 +22,16 @@ from .partition import (  # noqa: F401
 from ..graphs.host import HostGraph
 
 
-def load_graph(path: str, fmt: str = "auto", ordering: str = "natural"):
+def load_graph(path: str, fmt: str = "auto", ordering: str = "natural",
+               lazy: bool = False):
     """Load a graph by file format (kaminpar_io.h read_graph analog).
     fmt: 'metis', 'parhip', 'compressed', or 'auto' (sniff by extension
     then content).  'compressed' returns a CompressedHostGraph.
     ordering: 'natural' keeps file order; 'degree-buckets' rearranges
     nodes into exponentially-spaced degree buckets (NodeOrdering
-    analog; not applicable to compressed containers)."""
+    analog; not applicable to compressed containers).  ``lazy`` asks
+    the compressed loader to mmap the container chunk-granularly
+    (the external scheme's disk tier) instead of materializing it."""
     if ordering not in ("natural", "degree-buckets"):
         raise ValueError(f"unknown node ordering: {ordering}")
     if fmt == "auto":
@@ -50,7 +53,7 @@ def load_graph(path: str, fmt: str = "auto", ordering: str = "natural"):
     elif fmt == "parhip":
         graph = load_parhip(path)
     elif fmt == "compressed":
-        return load_compressed(path)
+        return load_compressed(path, lazy=lazy)
     else:
         raise ValueError(f"unknown graph format: {fmt}")
     if ordering == "degree-buckets":

@@ -55,11 +55,15 @@ class KaMinPar:
         With ctx.compression.enabled, plain graphs are stored compressed
         (the Graph facade's CSR/compressed dispatch analog,
         kaminpar-shm/datastructures/graph.h:24-62)."""
+        from .external.chunkstore import StreamedSpecGraph
         from .graphs.compressed import CompressedHostGraph, compress_host_graph
 
         from .utils.assertions import heavy_assertions_enabled
 
-        if isinstance(graph, CompressedHostGraph):
+        if isinstance(graph, (CompressedHostGraph, StreamedSpecGraph)):
+            # compressed containers and generator-spec wrappers pass
+            # through: their consumers stream (decode_range / chunk
+            # regeneration) instead of reading a flat CSR
             self._graph = graph
         else:
             # heavy assertion level always validates, mirroring the
@@ -221,8 +225,15 @@ class KaMinPar:
                 "partitioning"
             ):
                 # isolated-node preprocessing (kaminpar.cc:392-404)
+                from .external.chunkstore import StreamedSpecGraph
+
                 num_isolated = count_isolated_nodes(graph)
                 still_compressed = isinstance(graph, CompressedHostGraph)
+                # generator-spec wrappers keep isolated nodes in the
+                # stream: extraction would materialize the adjacency,
+                # and the external scheme's device phases (LP packing +
+                # balancers) place edge-less nodes anyway
+                streaming_src = isinstance(graph, StreamedSpecGraph)
                 resumed_result = (
                     mgr.take_result_resume() if mgr is not None else None
                 )
@@ -263,6 +274,7 @@ class KaMinPar:
                     num_isolated
                     and graph.n > num_isolated
                     and not still_compressed
+                    and not streaming_src
                 ):
                     core, perm, _ = remove_isolated_nodes(graph)
                     core_ctx = ctx  # weights already set up from the full graph
@@ -414,6 +426,10 @@ class KaMinPar:
             from .partitioning.vcycle import VcycleDeepMultilevelPartitioner
 
             return VcycleDeepMultilevelPartitioner(ctx).partition(graph)
+        elif mode == PartitioningMode.EXTERNAL:
+            from .external.driver import ExternalPartitioner
+
+            return ExternalPartitioner(ctx).partition(graph)
         raise ValueError(f"unknown partitioning mode: {mode}")
 
     def _validate_parameters(self) -> None:
@@ -490,7 +506,12 @@ class KaMinPar:
             or d.dump_graph_hierarchy
         ):
             return True
-        if self.ctx.partitioning.mode != PartitioningMode.DEEP:
+        if self.ctx.partitioning.mode not in (
+            PartitioningMode.DEEP,
+            # the external scheme is BUILT on never materializing: the
+            # chunk store decodes node ranges on demand
+            PartitioningMode.EXTERNAL,
+        ):
             return True
         # isolated nodes do NOT force a decode: the host-side isolated
         # extraction (kaminpar.cc:392-404) is skipped for compressed
@@ -514,6 +535,10 @@ class KaMinPar:
             and cached[1] is partition
         ):
             return cached[2]
+        from .external.chunkstore import (
+            StreamedSpecGraph,
+            streamed_partition_metrics,
+        )
         from .graphs.compressed import (
             CompressedHostGraph,
             compressed_partition_metrics,
@@ -523,6 +548,8 @@ class KaMinPar:
         p = self.ctx.partition
         if isinstance(graph, CompressedHostGraph):
             m = compressed_partition_metrics(graph, partition, p.k)
+        elif isinstance(graph, StreamedSpecGraph):
+            m = streamed_partition_metrics(graph, partition, p.k)
         else:
             m = host_partition_metrics(graph, partition, p.k)
         result = {

@@ -272,6 +272,14 @@ def graph_fingerprint(graph) -> str:
         xr, adj, _ = graph.decode_range(0, min(n, 2048))
         h.update(np.asarray(xr, dtype=np.int64).tobytes())
         h.update(np.asarray(adj, dtype=np.int64)[:4096].tobytes())
+    elif not hasattr(graph, "adjncy"):
+        # generator-spec wrapper (external/chunkstore.StreamedSpecGraph):
+        # the spec string + degree prefix IS the graph's identity — the
+        # adjacency is deterministic from them and never materialized
+        h.update(str(getattr(graph, "spec", "")).encode())
+        xadj = np.asarray(graph.xadj, dtype=np.int64)
+        h.update(xadj[:2048].tobytes())
+        h.update(xadj[-2048:].tobytes())
     else:
         xadj = np.asarray(graph.xadj, dtype=np.int64)
         h.update(xadj[:2048].tobytes())
@@ -330,6 +338,12 @@ class CheckpointManager:
         self.memory_only = False
         self.generation = 0
         self._snapshots: Dict[str, dict] = {}  # name -> manifest entry
+        # pinned snapshot names are carried forward by EVERY offer, on
+        # top of the offering driver's own keep list — the external
+        # scheme pins its streamed-level projection maps so the in-core
+        # deep phase's barriers (which know nothing about them) cannot
+        # prune them out of the manifest
+        self._pinned: set = set()
         self._resume: Optional[dict] = None
         self._resume_taken = False
         self.stats = {"writes": 0, "bytes": 0, "wall_s": 0.0}
@@ -386,7 +400,7 @@ class CheckpointManager:
         self.generation += 1
         gen = self.generation
         entries: Dict[str, dict] = {}
-        for name in keep:
+        for name in list(keep) + sorted(self._pinned):
             ent = self._snapshots.get(name)
             if ent is not None:
                 entries[name] = ent
@@ -611,6 +625,13 @@ class CheckpointManager:
             "generation": int(man.get("generation", 0)),
             "snapshot_entries": dict(man.get("snapshots", {})),
         }
+
+    def pin(self, names) -> None:
+        """Mark snapshots as carried forward by every future offer (on
+        top of each offer's own keep list).  Used by the external
+        scheme: streamed-level projection maps must survive the in-core
+        phase's barriers, whose keep lists don't know about them."""
+        self._pinned.update(names)
 
     def pending_resume(self) -> Optional[dict]:
         """The loaded-but-unconsumed resume state (None once taken) —

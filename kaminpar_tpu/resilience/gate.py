@@ -63,7 +63,22 @@ def recompute_metrics(graph, partition: np.ndarray, k: int) -> Tuple[int, np.nda
         np.asarray(graph.node_weight_array(), dtype=np.int64),
     )
     cut2 = 0  # both directions of every cut edge
-    if isinstance(graph, CompressedHostGraph):
+    if hasattr(graph, "iter_rows"):
+        # generator-spec wrapper (external/chunkstore.StreamedSpecGraph):
+        # regenerate node-range chunks — the gate never materializes
+        # the synthetic fine graph it validates
+        for v0, v1, adj, ew in graph.iter_rows():
+            deg = np.asarray(
+                graph.xadj[v0 + 1 : v1 + 1] - graph.xadj[v0:v1],
+                dtype=np.int64,
+            )
+            owner = np.repeat(np.arange(v0, v1, dtype=np.int64), deg)
+            crosses = partition[owner] != partition[np.asarray(adj)]
+            if ew is None:
+                cut2 += int(np.count_nonzero(crosses))
+            else:
+                cut2 += int(np.asarray(ew, dtype=np.int64)[crosses].sum())
+    elif isinstance(graph, CompressedHostGraph):
         for v0 in range(0, graph.n, CHUNK_NODES):
             v1 = min(graph.n, v0 + CHUNK_NODES)
             xr, adj, ew = graph.decode_range(v0, v1)
@@ -221,7 +236,12 @@ def _greedy_repair(graph, part: np.ndarray, caps: np.ndarray) -> np.ndarray:
     from ..graphs.compressed import CompressedHostGraph
     from ..ops import balancer as balancer_ops
 
-    host = graph.decode() if isinstance(graph, CompressedHostGraph) else graph
+    if isinstance(graph, CompressedHostGraph):
+        host = graph.decode()
+    elif hasattr(graph, "to_host_graph"):
+        host = graph.to_host_graph()  # spec wrapper: repair-only decode
+    else:
+        host = graph
     return balancer_ops.host_balance(
         np.asarray(host.node_weight_array(), dtype=np.int64),
         (

@@ -238,6 +238,34 @@ def estimate_run_bytes(n: int, m: int, k: int, ctx: Any = None) -> int:
     return estimate_rung_bytes(RUNG_NORMAL, n, m, k)
 
 
+def estimate_stream_bytes(n: int, chunk_edges: int, k: int) -> int:
+    """Peak device bytes of the OUT-OF-CORE stream phase
+    (external/stream_coarsen.py): two in-flight padded edge-block chunk
+    buffers (src_local + dst + weights — the double buffer the async
+    dispatch queue holds) plus the fine-level O(n) vectors (labels,
+    wanted, cluster weights, node weights, cluster map) and the k
+    tables.  This is the figure the external driver shrinks its chunk
+    target against, and the serving admission price of an
+    external-scheme request — NOT a full-graph estimate, which is
+    exactly what the scheme exists to avoid."""
+    from .. import caching
+
+    w = _weight_itemsize()
+    e_pad = caching.pad_size(max(int(chunk_edges), 1), 4096)
+    chunk = e_pad * (4 + 4 + w)
+    vectors = int(n) * (4 + 4 + 4 + 2 * w)
+    k_pad = caching.pad_k(max(int(k), 1))
+    return int(2 * chunk + vectors + K_TABLE_ARRAYS * k_pad * 8)
+
+
+def min_streamable_bytes(n: int, k: int) -> int:
+    """The smallest budget the external scheme can stream a graph under
+    (the floor chunk target) — the admission rule for `--scheme
+    external` requests: below this not even the O(n) vectors + one
+    floor chunk fit, so the request is structurally unserveable."""
+    return estimate_stream_bytes(n, 1 << 15, k)
+
+
 def min_serveable_bytes(n: int, m: int, k: int) -> int:
     """The smallest budget a request can be served DEVICE-RESIDENT under
     (the rung-2 spilled-hierarchy estimate) — the serving admission
@@ -676,8 +704,47 @@ def _attempt_at_rung(rung: int, attempt: Callable[[], np.ndarray],
             return attempt()
     if rung == RUNG_SEMI_EXTERNAL:
         with caching.pad_policy_scope("tight"):
-            return semi_external_partition(graph, ctx, facade)
+            return _semi_external_rung(graph, ctx, facade)
     return host_only_partition(graph, ctx)
+
+
+def _semi_external_rung(graph: Any, ctx: Any, facade: Any) -> np.ndarray:
+    """Rung 3's primary is the DEVICE-STREAMED external subsystem
+    (kaminpar_tpu/external/): LP rating + contraction over padded
+    edge-block chunks with only the O(n) vectors device-resident — the
+    ROADMAP item-4 path at device speed.  The host-only numpy LP loop
+    (:func:`semi_external_partition`) is demoted to its FALLBACK: a
+    non-OOM failure of the streamed subsystem (missing codec, a
+    malformed source) degrades to it with a ``degraded`` event; a
+    DeviceOOM propagates so the ladder moves on to host-only."""
+    from ..external.driver import external_partition
+
+    try:
+        return external_partition(graph, ctx, facade)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception as exc:
+        err = classify(exc, site="device-oom")
+        if isinstance(err, DeviceOOM):
+            raise  # the ladder's business: next rung is host-only
+        from .. import telemetry
+        from ..utils.logger import log_warning
+
+        telemetry.event(
+            "degraded",
+            site="semi-external-stream",
+            error=type(exc).__name__,
+            detail=str(exc)[:300],
+            fallback="host-chunked numpy LP (semi_external_partition)",
+            attempts=1,
+            breaker_open=False,
+            injected=False,
+        )
+        log_warning(
+            f"semi-external stream failed ({type(exc).__name__}: "
+            f"{str(exc)[:120]}); falling back to the host-chunked LP path"
+        )
+        return semi_external_partition(graph, ctx, facade)
 
 
 # ---------------------------------------------------------------------------
@@ -888,10 +955,27 @@ def _host_lp_cluster(graph: Any, max_cluster_weight: int,
             ok = (best_lab != cur) & (cl_w[best_lab] + nw <= cap)
             if not ok.any():
                 continue
-            rows_ok, labs_ok = best_row[ok], best_lab[ok]
-            # vectorized apply: concurrent moves within one chunk may
-            # overshoot the cap by a chunk's worth of joins — the cap is
-            # a coarsening-quality knob, not a correctness invariant
+            rows_ok, labs_ok, nw_ok = best_row[ok], best_lab[ok], nw[ok]
+            # exact cap enforcement (per-chunk prefix pass): order the
+            # chunk's joins by (target label, node id) and accept per
+            # target the maximal prefix whose CUMULATIVE weight fits the
+            # remaining headroom.  Departures in the same pass free no
+            # headroom (conservative), so the cap is never exceeded —
+            # the vectorized apply used to overshoot it by up to a
+            # chunk's worth of concurrent joins.
+            order2 = np.lexsort((rows_ok, labs_ok))
+            rows_ok, labs_ok = rows_ok[order2], labs_ok[order2]
+            nw_ok = nw_ok[order2]
+            grp = np.flatnonzero(np.r_[True, labs_ok[1:] != labs_ok[:-1]])
+            cum = np.cumsum(nw_ok)
+            base = np.repeat(
+                cum[grp] - nw_ok[grp],
+                np.diff(np.r_[grp, len(labs_ok)]),
+            )
+            accept = (cum - base) <= (cap - cl_w[labs_ok])
+            if not accept.any():
+                continue
+            rows_ok, labs_ok = rows_ok[accept], labs_ok[accept]
             np.subtract.at(cl_w, labels[rows_ok], node_w[rows_ok])
             labels[rows_ok] = labs_ok
             np.add.at(cl_w, labs_ok, node_w[rows_ok])

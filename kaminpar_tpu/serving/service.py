@@ -229,13 +229,28 @@ class PartitionService:
         the padded bucket), the same sizing model the memory budget is
         enforced in; n/m are -1 when unknown without loading the input
         (opaque file path — sized from the file length, never a load)."""
-        from ..resilience.memory import estimate_run_bytes
-
         k = int(req.k or 2)
+
+        def price(n: int, m: int) -> float:
+            # governor pricing at admission: external-scheme services
+            # cost the STREAM state (O(n) vectors + chunk buffers +
+            # the coarse handoff target), everything else the padded
+            # in-core bucket — one sizing model per scheme, shared
+            # with the insufficient-memory rule below
+            from ..context import PartitioningMode
+            from ..resilience import memory as memory_mod
+
+            if self.base_ctx.partitioning.mode == PartitioningMode.EXTERNAL:
+                ext = self.base_ctx.external
+                return float(memory_mod.estimate_stream_bytes(
+                    n, int(ext.chunk_edges), k
+                ))
+            return float(memory_mod.estimate_run_bytes(n, m, k))
+
         g = req.graph
         if hasattr(g, "n") and hasattr(g, "m"):
             n, m = int(g.n), int(g.m)
-            return float(estimate_run_bytes(n, m, k)), n, m
+            return price(n, m), n, m
         if isinstance(g, str) and g.startswith("gen:"):
             try:
                 from ..graphs.factories import parse_gen_spec
@@ -246,7 +261,7 @@ class PartitionService:
                     * int(kw.get("z", 1))
                 ))
                 m = int(kw.get("m") or n * float(kw.get("avg_degree", 8)))
-                return float(estimate_run_bytes(n, m, k)), n, m
+                return price(n, m), n, m
             except Exception:
                 return DEFAULT_COST, -1, -1
         if isinstance(g, str):
@@ -301,16 +316,29 @@ class PartitionService:
         # the 'unsized' breaker-class convention.  Single-shot CLI runs
         # still degrade through every rung.
         if n >= 0:
+            from ..context import PartitioningMode
             from ..resilience import memory as memory_mod
 
             budget = memory_mod.budget_bytes(self.base_ctx)
-            if (
-                budget
-                and memory_mod.governor_enabled()
-                and memory_mod.min_serveable_bytes(n, m, int(req.k or 2))
-                > budget
-            ):
-                return "insufficient-memory"
+            if budget and memory_mod.governor_enabled():
+                # external-scheme services price the STREAM, not the
+                # resident hierarchy — that pricing asymmetry is the
+                # scheme's whole point: a graph far over the in-core
+                # budget is admissible as long as the O(n) vectors +
+                # one floor chunk fit (kaminpar_tpu/external/)
+                if (
+                    self.base_ctx.partitioning.mode
+                    == PartitioningMode.EXTERNAL
+                ):
+                    floor = memory_mod.min_streamable_bytes(
+                        n, int(req.k or 2)
+                    )
+                else:
+                    floor = memory_mod.min_serveable_bytes(
+                        n, m, int(req.k or 2)
+                    )
+                if floor > budget:
+                    return "insufficient-memory"
         if self._class_failures.get(cls, 0) >= self.config.breaker_threshold:
             return "breaker-open"
         return ""

@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -176,6 +176,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # rung, what was resumed) — annotated by the dist driver; shm runs
     # carry the well-formed disabled default
     dist_resilience = info.pop("dist_resilience", {"enabled": False})
+    # schema v9: the out-of-core streaming audit trail (external/driver
+    # annotates it: chunk counts, decoded vs uploaded bytes, the
+    # upload/compute overlap fraction, fine-level device residency);
+    # in-core runs carry the well-formed disabled default
+    external = info.pop("external", {"enabled": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -306,6 +311,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # memory-ladder rung, and the dist resume record
         # (resilience/agreement.py, docs/robustness.md)
         "dist_resilience": dist_resilience,
+        # schema v9: the out-of-core streaming (external scheme)
+        # section — per-level chunk/byte/overlap accounting, the
+        # handoff point, and the fine level's device residency (0 for
+        # any run that actually streamed)
+        "external": external,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

@@ -65,6 +65,12 @@ LARGE_COVERAGE_SINCE = 6
 QUALITY_COVERAGE_KEYS = ("coarsening_locked_frac",
                          "refinement_left_frac")
 
+#: Out-of-core streaming keys (round 13, kaminpar_tpu/external/): the
+#: BENCH line must always carry them from r06 on (null = the external
+#: measurement was skipped/failed, absence = silent coverage loss of
+#: the scale path — the r05 regression class).
+EXTERNAL_COVERAGE_KEYS = ("external_seconds", "stream_overlap")
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -206,6 +212,13 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
     left = parsed.get(
         "refinement_left_frac", q_totals.get("refinement_left_frac")
     )
+    # round-13 out-of-core streaming: promoted BENCH keys first, the
+    # embedded report's external section as the older-round fallback
+    ext_section = report.get("external") or {}
+    ext_s = parsed.get("external_seconds")
+    overlap = parsed.get(
+        "stream_overlap", ext_section.get("overlap_frac")
+    )
     return {
         "round": os.path.basename(path),
         "rc": entry.get("rc"),
@@ -227,6 +240,8 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         ),
         "locked": locked,
         "left": left,
+        "external_s": ext_s,
+        "overlap": overlap,
         "p95_ms": p95_ms,
         "schema": report.get("schema_version"),
     }
@@ -244,8 +259,8 @@ def render(rows: List[Dict[str, Any]]) -> str:
     cols = ("round", "rc", "cut", "vs_baseline", "total_s",
             "coarsening_s", "lp_s", "contract_s", "engines",
             "compile_s", "cache_hit", "hbm_util",
-            "pad_waste", "locked", "left", "p95_ms", "platform",
-            "schema")
+            "pad_waste", "locked", "left", "external_s", "overlap",
+            "p95_ms", "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -369,6 +384,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{name}: quality coverage key {key!r} missing "
                         "(bench.py must emit it every run; null marks a "
                         "run without attribution)"
+                    )
+            for key in EXTERNAL_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: external coverage key {key!r} missing "
+                        "(bench.py must emit it every run; null marks a "
+                        "skipped/failed external measurement)"
                     )
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target

@@ -10,7 +10,11 @@
 # cache hits, and a SIGTERM mid-batch drain — all verdicts in one
 # schema-valid report), a memory-governor smoke (artificially small
 # budget -> ladder engages, forced rung-2 spill/reload, a serving
-# insufficient-memory rejection), a dist resilience smoke (SIGTERM a
+# insufficient-memory rejection), an out-of-core streaming smoke
+# (--scheme external under a 25%-of-estimate budget -> gate-valid,
+# fine level never device-resident, stream events + overlap > 0, and
+# a mid-stream kill-and-resume that is CUT-IDENTICAL), a dist
+# resilience smoke (SIGTERM a
 # mesh run mid-pipeline -> resume is CUT-IDENTICAL; a rank-scoped
 # device-oom walks the cross-rank agreed ladder; a rank-1-scoped fault
 # stays inert on rank 0), and the ROADMAP.md tier-1 pytest command.
@@ -25,13 +29,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/9] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/10] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/9] run-report schema (producer selftest, v1-v7 fixtures + v8 producer) =="
+echo "== [2/10] run-report schema (producer selftest, v1-v8 fixtures + v9 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/9] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/10] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -99,7 +103,7 @@ print(f"quality smoke OK: {len(rows)} attribution row(s), "
       "BENCH quality keys present")
 EOF
 
-echo "== [4/9] telemetry.diff self-test + BENCH trend/kernel gate =="
+echo "== [4/10] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -123,7 +127,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/9] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/10] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -163,7 +167,7 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
-echo "== [6/9] serving smoke (mixed batch + faults + SIGTERM drain) =="
+echo "== [6/10] serving smoke (mixed batch + faults + SIGTERM drain) =="
 SERVE_DIR=/tmp/_kmp_serve_smoke
 rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
 python - <<'EOF3' || exit 1
@@ -260,7 +264,7 @@ print(f"drain OK: counts={c} ({len(drained)} drained)")
 EOF3
 
 
-echo "== [7/9] memory-governor smoke (tiny budget + forced spill + serving) =="
+echo "== [7/10] memory-governor smoke (tiny budget + forced spill + serving) =="
 MEM_DIR=/tmp/_kmp_mem_smoke
 rm -rf "$MEM_DIR"; mkdir -p "$MEM_DIR"
 # an artificially small budget: 25% of the rung-0 estimate for the shape
@@ -331,7 +335,69 @@ assert by_id["oversized"]["reason"] == "insufficient-memory", by_id
 print("serving insufficient-memory OK")
 PYEOF
 
-echo "== [8/9] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
+echo "== [8/10] out-of-core streaming smoke (--scheme external) =="
+EXT_DIR=/tmp/_kmp_ext_smoke
+rm -rf "$EXT_DIR"; mkdir -p "$EXT_DIR"
+# a budget at 25% of the in-core estimate: the external scheme must
+# stream the fine level (never uploading it), stay gate-valid, and
+# report the schema-v9 external section with overlap > 0
+EXT_BUDGET=$(python - <<'PYEOF'
+from kaminpar_tpu.resilience.memory import estimate_run_bytes
+print(int(estimate_run_bytes(65536, 65536 * 8, 8) * 0.25))
+PYEOF
+) || exit 1
+EXT_GRAPH="gen:rgg2d;n=65536;avg_degree=8;seed=1"
+KAMINPAR_TPU_HBM_BYTES=$EXT_BUDGET python -m kaminpar_tpu "$EXT_GRAPH" \
+    -k 8 --scheme external --report-json "$EXT_DIR/ref.json" -q || exit 1
+python scripts/check_report_schema.py "$EXT_DIR/ref.json" || exit 1
+python - <<'PYEOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_ext_smoke/ref.json"))
+assert r["schema_version"] == 9, r["schema_version"]
+ext = r["external"]
+# the out-of-core contract: >= 1 streamed level, the fine level NEVER
+# device-resident, and the chunk pipeline actually overlapped
+assert ext["enabled"] and ext["streamed_levels"] >= 1, ext
+assert ext["fine_device_resident_bytes"] == 0, ext
+assert ext["chunks_total"] >= 1 and ext["decoded_bytes"] > 0, ext
+assert ext["overlap_frac"] > 0, ext
+streams = [e for e in r["events"] if e["name"] == "stream"]
+assert streams, "no stream telemetry events"
+gate = r["output_gate"]
+assert gate["checked"] and gate["valid"], gate
+print(f"external smoke OK: {ext['streamed_levels']} level(s), "
+      f"{ext['chunks_total']} chunk(s), overlap={ext['overlap_frac']}, "
+      f"cut={gate['cut_recomputed']}")
+PYEOF
+# kill-and-resume MID-STREAM (hard preemption at the first streamed
+# level's barrier): the resume must be CUT-IDENTICAL to the reference
+if KAMINPAR_TPU_STOP_AT='stream-coarsen:0!' \
+    KAMINPAR_TPU_HBM_BYTES=$EXT_BUDGET python -m kaminpar_tpu \
+    "$EXT_GRAPH" -k 8 --scheme external \
+    --checkpoint-dir "$EXT_DIR/ckpt" -q 2> /dev/null; then
+    echo "ERROR: simulated mid-stream kill did not kill the run" >&2
+    exit 1
+fi
+[ -f "$EXT_DIR/ckpt/manifest.json" ] \
+    || { echo "ERROR: killed external run left no manifest" >&2; exit 1; }
+KAMINPAR_TPU_HBM_BYTES=$EXT_BUDGET python -m kaminpar_tpu "$EXT_GRAPH" \
+    -k 8 --scheme external --checkpoint-dir "$EXT_DIR/ckpt" --resume \
+    --report-json "$EXT_DIR/res.json" -q || exit 1
+python - <<'PYEOF' || exit 1
+import json
+ref = json.load(open("/tmp/_kmp_ext_smoke/ref.json"))
+res = json.load(open("/tmp/_kmp_ext_smoke/res.json"))
+assert res["checkpoint"].get("resumed_from"), res["checkpoint"]
+assert res["output_gate"]["valid"], res["output_gate"]
+assert res["result"]["cut"] == ref["result"]["cut"], (
+    "mid-stream resume is not cut-identical: "
+    f"ref {ref['result']['cut']} vs resumed {res['result']['cut']}")
+print(f"external resume OK: resumed from "
+      f"{res['checkpoint']['resumed_from']}, cut={res['result']['cut']} "
+      "(identical to the reference)")
+PYEOF
+
+echo "== [9/10] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
 DIST_DIR=/tmp/_kmp_dist_smoke
 rm -rf "$DIST_DIR"; mkdir -p "$DIST_DIR"
 DIST_XLA="--xla_force_host_platform_device_count=8"
@@ -450,11 +516,11 @@ print("rank-scope inert OK: rank=1 plan fired nothing on rank 0")
 EOF8
 
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [9/9] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [10/10] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [9/9] tier-1 pytest (ROADMAP.md) =="
+echo "== [10/10] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -90,8 +90,9 @@ def version_checks(report: Any) -> List[str]:
     `checkpoint` and `anytime` sections, v4+ additionally the `serving`
     section, v5+ additionally the `perf` section, v6+ additionally the
     `memory_budget` section, v7+ additionally the `quality` section,
-    v8+ additionally the `dist_resilience` section; older reports
-    remain valid without them during the transition."""
+    v8+ additionally the `dist_resilience` section, v9+ additionally
+    the `external` section; older reports remain valid without them
+    during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -106,6 +107,7 @@ def version_checks(report: Any) -> List[str]:
         (6, ("memory_budget",)),
         (7, ("quality",)),
         (8, ("dist_resilience",)),
+        (9, ("external",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -196,6 +198,15 @@ def _minimal_v7_report() -> dict:
     r = _minimal_v6_report()
     r["schema_version"] = 7
     r["quality"] = {"enabled": False}
+    return r
+
+
+def _minimal_v8_report() -> dict:
+    """A minimal schema_version-8 report (dist_resilience present, no
+    external section) — the eighth transition fixture."""
+    r = _minimal_v7_report()
+    r["schema_version"] = 8
+    r["dist_resilience"] = {"enabled": False}
     return r
 
 
@@ -307,7 +318,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v8) and validate it plus the embedded v1-v7 transition "
+        "v9) and validate it plus the embedded v1-v8 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -331,19 +342,20 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v8 (progress/compile +
+        # live producer must emit v9 (progress/compile +
         # checkpoint/anytime + serving + perf + memory_budget +
-        # quality + dist_resilience)
-        if report.get("schema_version") != 8:
+        # quality + dist_resilience + external)
+        if report.get("schema_version") != 9:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 8",
+                f"expected 9",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
-                    "memory_budget", "quality", "dist_resilience"):
+                    "memory_budget", "quality", "dist_resilience",
+                    "external"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -375,12 +387,12 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v7 layouts must STILL validate
+        # transition coverage: the v1-v8 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
             ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
-            ("v7", _minimal_v7_report()),
+            ("v7", _minimal_v7_report()), ("v8", _minimal_v8_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

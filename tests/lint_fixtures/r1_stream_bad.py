@@ -1,0 +1,23 @@
+"""R1 bad fixture: the out-of-core streaming hook shape done WRONG —
+per-chunk decode pulls and the round's moved-count readback written
+lexically inside the driver's stream timer span (the PR-13 hook hazard:
+every chunk would host-sync inside the measured region, serializing the
+decode against the device and charging the span).
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def stream_level_with_inline_pulls(store, labels, kernel, out):
+    with scoped_timer("stream-lp"):
+        for c in range(store.num_chunks):
+            block = np.asarray(store.chunk(c))  # line 19: R1 copy
+            labels = kernel(labels, block)
+            moved = int(jnp.sum(labels))  # line 21: R1 int()
+            out.append(moved)
+    return out

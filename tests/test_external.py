@@ -1,0 +1,401 @@
+"""Out-of-core streaming partitioner tests (kaminpar_tpu/external/).
+
+The ISSUE-13 acceptance surface:
+
+  * streaming-vs-in-core equivalence: same graph, same seed -> a
+    gate-valid result whose cut is within the diff-gate threshold of
+    the in-core deep run;
+  * chunk-size invariance: two chunk targets -> bitwise-identical
+    partitions AND identical coarse hierarchy shapes (the
+    round-start-rating + global-apply design makes the stream's result
+    independent of its chunking);
+  * kill-and-resume mid-stream: a hard preemption at a `stream-coarsen`
+    barrier resumes cut-identical to the uninterrupted run;
+  * a forced-tiny-budget end-to-end run whose telemetry proves the fine
+    level was never device-resident (external.fine_device_resident_bytes
+    == 0, overlap > 0, >= 1 stream event);
+  * the chunk store: range coverage, source agreement (CSR vs
+    compressed), the disk spill tier, and the generator-spec wrapper
+    that never materializes the fine graph;
+  * the streaming LP's exact cluster-weight cap;
+  * schema: the v9 `external` report section validates.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import resilience, telemetry
+from kaminpar_tpu.context import PartitioningMode
+from kaminpar_tpu.external import chunkstore, stream_coarsen
+from kaminpar_tpu.graphs.compressed import compress_host_graph
+from kaminpar_tpu.graphs.factories import make_rgg2d
+from kaminpar_tpu.graphs.host import host_partition_metrics
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.resilience import memory as mem
+from kaminpar_tpu.resilience.checkpoint import (
+    STOP_AT_ENV,
+    SimulatedPreemption,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for var in (mem.ENV_BUDGET, mem.ENV_FORCE_RUNG, mem.ENV_GOVERNOR,
+                STOP_AT_ENV, resilience.FAULTS_ENV_VAR):
+        monkeypatch.delenv(var, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _ctx(chunk_edges=1 << 13, **kw):
+    ctx = create_context_by_preset_name("default")
+    ctx.partitioning.mode = PartitioningMode.EXTERNAL
+    ctx.external.chunk_edges = chunk_edges
+    for key, value in kw.items():
+        setattr(ctx.external, key, value)
+    return ctx
+
+
+def _run(graph, ctx, k=4, seed=1):
+    solver = KaMinPar(ctx)
+    solver.set_graph(graph)
+    solver.set_output_level(0)
+    return solver.compute_partition(k=k, epsilon=0.03, seed=seed)
+
+
+def _gate():
+    gates = [e.attrs for e in telemetry.events("output-gate")]
+    return gates[-1] if gates else None
+
+
+# ---------------------------------------------------------------------------
+# chunk store
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_plan_covers_and_shares_one_bucket():
+    g = make_rgg2d(4000, avg_degree=8, seed=2)
+    store = chunkstore.build_store(g, target_edges=2048)
+    assert store.num_chunks > 1
+    # contiguous full coverage
+    assert store.ranges[0][0] == 0 and store.ranges[-1][1] == g.n
+    for (a, b), (c, _) in zip(store.ranges, store.ranges[1:]):
+        assert b == c
+    # one shared bucket: every chunk fits e_pad
+    for c in range(store.num_chunks):
+        assert store.chunk_edges(c) <= store.e_pad
+        block = store.chunk_host(c)
+        assert block.src_local.shape == (store.e_pad,)
+        assert block.dst.shape == (store.e_pad,)
+    assert store.decoded_bytes > 0
+
+
+def test_chunk_sources_agree_csr_vs_compressed():
+    g = make_rgg2d(3000, avg_degree=8, seed=2)
+    cg = compress_host_graph(g)
+    s1 = chunkstore.build_store(g, target_edges=4096)
+    s2 = chunkstore.build_store(cg, target_edges=4096)
+    assert s1.num_chunks == s2.num_chunks and s1.e_pad == s2.e_pad
+    for c in range(s1.num_chunks):
+        b1, b2 = s1.chunk_host(c), s2.chunk_host(c)
+        assert (b1.v0, b1.v1, b1.m_real) == (b2.v0, b2.v1, b2.m_real)
+        assert np.array_equal(b1.src_local, b2.src_local)
+        assert np.array_equal(b1.dst, b2.dst)
+        assert np.array_equal(b1.w, b2.w)
+
+
+def test_spill_tier_writes_once_and_rereads(tmp_path):
+    g = make_rgg2d(2000, avg_degree=8, seed=3)
+    spill = str(tmp_path / "spill")
+    store = chunkstore.build_store(g, target_edges=2048, spill_dir=spill)
+    first = [store.chunk_host(c) for c in range(store.num_chunks)]
+    assert store.spilled_bytes > 0
+    files = sorted(
+        f for f in os.listdir(spill)
+        if f.startswith("chunk-") and f.endswith(".npz")
+    )
+    assert len(files) == store.num_chunks
+    assert os.path.exists(os.path.join(spill, "spill.json"))  # cache key
+    spilled_once = store.spilled_bytes
+    second = [store.chunk_host(c) for c in range(store.num_chunks)]
+    assert store.spilled_bytes == spilled_once  # written exactly once
+    for b1, b2 in zip(first, second):
+        assert np.array_equal(b1.dst, b2.dst)
+        assert np.array_equal(b1.w, b2.w)
+
+
+def test_generator_spec_wrapper_never_materializes():
+    spec = "gen:rgg2d;n=2048;avg_degree=8;seed=4"
+    sg = chunkstore.StreamedSpecGraph(spec, target_edges=4096)
+    assert not hasattr(sg, "adjncy")
+    assert sg.n == 2048 and sg.m == int(sg.xadj[-1]) > 0
+    # iter_rows covers the degree prefix exactly
+    total = 0
+    for v0, v1, adj, ew in sg.iter_rows():
+        assert len(adj) == int(sg.xadj[v1] - sg.xadj[v0])
+        total += len(adj)
+    assert total == sg.m
+    # the assembled twin agrees (chunk determinism)
+    host = sg.to_host_graph()
+    assert host.n == sg.n and host.m == sg.m
+
+
+# ---------------------------------------------------------------------------
+# streaming LP semantics
+# ---------------------------------------------------------------------------
+
+
+def test_stream_lp_cap_is_exact_on_weighted_graph():
+    g = make_rgg2d(1500, avg_degree=8, seed=5)
+    rng = np.random.default_rng(7)
+    node_w = rng.integers(1, 9, g.n).astype(np.int64)
+    g.node_weights = node_w
+    cap = 24
+    store = chunkstore.build_store(g, target_edges=1024)
+    labels, cluster_w, nw_dev = stream_coarsen.make_vectors(store, node_w)
+    labels, cluster_w, _ = stream_coarsen.stream_lp(
+        store, labels, cluster_w, nw_dev, cap, seed=1, rounds=3
+    )
+    lab = chunkstore.pull_labels(labels, g.n)
+    cw = np.zeros(g.n, dtype=np.int64)
+    np.add.at(cw, lab, node_w)
+    members = np.bincount(lab, minlength=g.n)
+    # every multi-member cluster respects the cap EXACTLY; a singleton
+    # heavier than the cap never moved and is legitimately over it
+    over = np.flatnonzero(cw > cap)
+    assert all(members[c] == 1 for c in over), (
+        f"cap overshoot on joined clusters: "
+        f"{[(int(c), int(cw[c]), int(members[c])) for c in over[:5]]}"
+    )
+    assert len(np.unique(lab)) < g.n  # it did cluster
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: equivalence, invariance, resume, budget
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_vs_incore_equivalence():
+    g = make_rgg2d(8192, avg_degree=8, seed=1)
+    ext = _run(g, _ctx(chunk_edges=1 << 13), k=4, seed=1)
+    cut_ext = host_partition_metrics(g, ext, 4)["cut"]
+    gate = _gate()
+    assert gate and gate["valid"]
+    deep_ctx = create_context_by_preset_name("default")
+    deep = _run(g, deep_ctx, k=4, seed=1)
+    cut_deep = host_partition_metrics(g, deep, 4)["cut"]
+    # the telemetry.diff regression threshold (10%) is the contract;
+    # both directions (streaming may win)
+    assert cut_ext <= 1.10 * cut_deep + 1, (cut_ext, cut_deep)
+
+
+def test_chunk_size_invariance():
+    g = make_rgg2d(4096, avg_degree=8, seed=1)
+    parts, shapes = [], []
+    for chunk_edges in (1 << 11, 1 << 13, 10 ** 9):
+        telemetry.reset()
+        parts.append(_run(g, _ctx(chunk_edges=chunk_edges), k=4, seed=1))
+        shapes.append([
+            (e.attrs["coarse_n"], e.attrs["coarse_m"])
+            for e in telemetry.events("stream")
+        ])
+    assert np.array_equal(parts[0], parts[1])
+    assert np.array_equal(parts[0], parts[2])
+    assert shapes[0] == shapes[1] == shapes[2]
+    assert shapes[0], "no streamed levels recorded"
+
+
+def test_kill_and_resume_mid_stream_is_cut_identical(tmp_path, monkeypatch):
+    g = make_rgg2d(8192, avg_degree=8, seed=1)
+    ref = _run(g, _ctx(), k=4, seed=1)
+    ref_cut = host_partition_metrics(g, ref, 4)["cut"]
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    monkeypatch.setenv(STOP_AT_ENV, "stream-coarsen:0!")
+    killed_ctx = _ctx()
+    killed_ctx.resilience.checkpoint_dir = ckpt_dir
+    with pytest.raises(SimulatedPreemption):
+        _run(g, killed_ctx, k=4, seed=1)
+    monkeypatch.delenv(STOP_AT_ENV)
+    assert os.path.exists(os.path.join(ckpt_dir, "manifest.json"))
+
+    resume_ctx = _ctx()
+    resume_ctx.resilience.checkpoint_dir = ckpt_dir
+    resume_ctx.resilience.resume = True
+    resumed = _run(g, resume_ctx, k=4, seed=1)
+    cut = host_partition_metrics(g, resumed, 4)["cut"]
+    assert cut == ref_cut
+    ev = [e.attrs for e in telemetry.events("resume")
+          if e.attrs.get("scheme") == "external"]
+    assert ev and ev[-1]["levels_restored"] >= 1
+
+
+def test_kill_during_incore_phase_keeps_pinned_stream_maps(
+    tmp_path, monkeypatch
+):
+    g = make_rgg2d(8192, avg_degree=8, seed=1)
+    ref = _run(g, _ctx(), k=4, seed=1)
+    ref_cut = host_partition_metrics(g, ref, 4)["cut"]
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    monkeypatch.setenv(STOP_AT_ENV, "initial!")
+    killed_ctx = _ctx()
+    killed_ctx.resilience.checkpoint_dir = ckpt_dir
+    with pytest.raises(SimulatedPreemption):
+        _run(g, killed_ctx, k=4, seed=1)
+    monkeypatch.delenv(STOP_AT_ENV)
+    # the stream-level snapshot is pinned past the deep barriers
+    manifest = json.load(open(os.path.join(ckpt_dir, "manifest.json")))
+    assert any(
+        name.startswith("stream-level-") for name in manifest["snapshots"]
+    ), sorted(manifest["snapshots"])
+
+    resume_ctx = _ctx()
+    resume_ctx.resilience.checkpoint_dir = ckpt_dir
+    resume_ctx.resilience.resume = True
+    resumed = _run(g, resume_ctx, k=4, seed=1)
+    assert host_partition_metrics(g, resumed, 4)["cut"] == ref_cut
+
+
+def test_tiny_budget_streams_and_fine_level_stays_off_device(monkeypatch):
+    g = make_rgg2d(16384, avg_degree=8, seed=1)
+    budget = int(mem.estimate_run_bytes(g.n, g.m, 4) * 0.25)
+    monkeypatch.setenv(mem.ENV_BUDGET, str(budget))
+    part = _run(g, _ctx(chunk_edges=1 << 14), k=4, seed=1)
+    assert part.shape == (g.n,)
+    gate = _gate()
+    assert gate and gate["valid"]
+    section = telemetry.run_info().get("external")
+    assert section and section["enabled"]
+    assert section["streamed_levels"] >= 1
+    assert section["fine_device_resident_bytes"] == 0
+    assert section["overlap_frac"] > 0
+    assert section["chunks_total"] >= 1
+    streams = telemetry.events("stream")
+    assert streams, "no stream telemetry events"
+    # the stream's chunk buffer is a fraction of the fine CSR it avoided
+    assert section["fine_csr_bytes"] > 0
+    lvl0 = section["levels"][0]
+    assert lvl0["chunk_buffer_bytes"] < section["fine_csr_bytes"]
+
+
+def test_generator_spec_end_to_end():
+    spec = "gen:rgg2d;n=4096;avg_degree=8;seed=2"
+    sg = chunkstore.StreamedSpecGraph(spec, target_edges=1 << 12)
+    part = _run(sg, _ctx(chunk_edges=1 << 12), k=4, seed=1)
+    assert part.shape == (sg.n,)
+    gate = _gate()
+    assert gate and gate["valid"]
+    metrics = chunkstore.streamed_partition_metrics(sg, part, 4)
+    assert metrics["cut"] >= 0 and metrics["imbalance"] <= 0.04
+
+
+# ---------------------------------------------------------------------------
+# rung-3 reroute + platform surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_forced_rung3_streams_on_device(monkeypatch):
+    """The memory ladder's rung 3 now routes through the streamed
+    subsystem (the host-only numpy LP is its fallback)."""
+    monkeypatch.setenv(mem.ENV_FORCE_RUNG, "3")
+    monkeypatch.setenv(mem.ENV_BUDGET, str(6_000_000))
+    g = make_rgg2d(8000, avg_degree=8, seed=3)
+    ctx = create_context_by_preset_name("default")
+    part = _run(g, ctx, k=8, seed=1)
+    assert part.shape == (g.n,)
+    gate = _gate()
+    assert gate and gate["valid"]
+    streams = telemetry.events("stream")
+    assert streams and streams[-1].attrs["coarse_n"] < g.n
+
+
+def test_rung3_demotes_to_host_lp_on_stream_failure(monkeypatch):
+    """A non-OOM failure of the streamed subsystem degrades to the
+    legacy host-chunked LP path with a `degraded` event."""
+    monkeypatch.setenv(mem.ENV_FORCE_RUNG, "3")
+    monkeypatch.setenv(mem.ENV_BUDGET, str(6_000_000))
+
+    def boom(graph, ctx, facade=None):
+        raise RuntimeError("stream subsystem unavailable")
+
+    import kaminpar_tpu.external.driver as driver_mod
+
+    monkeypatch.setattr(driver_mod, "external_partition", boom)
+    g = make_rgg2d(2500, avg_degree=8, seed=3)
+    ctx = create_context_by_preset_name("default")
+    part = _run(g, ctx, k=8, seed=1)
+    assert part.shape == (g.n,)
+    gate = _gate()
+    assert gate and gate["valid"]
+    deg = [e.attrs for e in telemetry.events("degraded")
+           if e.attrs.get("site") == "semi-external-stream"]
+    assert deg, "no demotion event"
+    assert telemetry.events("semi-external"), "legacy path never ran"
+
+
+def test_serving_admission_prices_the_stream(monkeypatch):
+    """External-scheme services admit graphs far over the in-core
+    budget: the admission floor is the stream state, not the resident
+    hierarchy."""
+    n, m, k = 1 << 20, (1 << 20) * 16, 64
+    budget = mem.min_streamable_bytes(n, k) * 2
+    assert mem.min_serveable_bytes(n, m, k) > budget  # in-core refuses
+    monkeypatch.setenv(mem.ENV_BUDGET, str(budget))
+    from kaminpar_tpu.serving.service import PartitionRequest, PartitionService
+
+    ext_ctx = create_context_by_preset_name("default")
+    ext_ctx.partitioning.mode = PartitioningMode.EXTERNAL
+    svc = PartitionService(ext_ctx)
+    req = PartitionRequest(
+        graph=f"gen:rmat;n={n};m={m};seed=1", k=k, request_id="big"
+    )
+    rejected = svc.submit(req)
+    assert rejected is None, getattr(rejected, "reason", rejected)
+
+    in_core = PartitionService(create_context_by_preset_name("default"))
+    rej = in_core.submit(PartitionRequest(
+        graph=f"gen:rmat;n={n};m={m};seed=1", k=k, request_id="big2"
+    ))
+    assert rej is not None and rej.reason == "insufficient-memory"
+
+
+def test_external_report_section_is_schema_valid(monkeypatch):
+    g = make_rgg2d(4096, avg_degree=8, seed=1)
+    _run(g, _ctx(), k=4, seed=1)
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH, build_run_report
+
+    report = build_run_report()
+    assert report["schema_version"] == 9
+    assert report["external"]["enabled"] is True
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(REPO, "scripts", "check_report_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    schema = json.load(open(SCHEMA_PATH))
+    errors = checker.validate_instance(report, schema)
+    errors += checker.version_checks(report)
+    assert errors == [], errors
+
+
+def test_incore_runs_carry_disabled_external_default():
+    g = make_rgg2d(1024, avg_degree=8, seed=1)
+    ctx = create_context_by_preset_name("default")
+    _run(g, ctx, k=4, seed=1)
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    assert build_run_report()["external"] == {"enabled": False}

@@ -35,6 +35,9 @@ BAD_EXPECT = {
     # the PR-11 quality-observatory hook shape: per-level cut/cmap
     # pulls lexically inside a driver's uncoarsening span
     "r1_quality_bad.py": [("R1", 19), ("R1", 20)],
+    # the PR-13 streaming hook shape: chunk decode + moved-count pulls
+    # lexically inside a driver's stream span
+    "r1_stream_bad.py": [("R1", 19), ("R1", 21)],
     "r2_bad.py": [("R2", 5), ("R2", 9)],
     "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16), ("R3", 21)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
@@ -50,7 +53,8 @@ def test_rule_fires_on_bad_fixture(name):
 
 
 @pytest.mark.parametrize(
-    "name", ["r1_good.py", "r1_quality_good.py", "r2_good.py",
+    "name", ["r1_good.py", "r1_quality_good.py", "r1_stream_good.py",
+             "r2_good.py",
              "r3_good.py", "r4_good.py", "r5_good.py", "r6_good.py"]
 )
 def test_rule_silent_on_good_fixture(name):

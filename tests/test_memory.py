@@ -358,16 +358,45 @@ def test_host_contract_preserves_weight_and_symmetry():
     validate(coarse)  # CSR invariants incl. symmetric twins
 
 
-def test_forced_semi_external_emits_event(monkeypatch):
+def test_forced_semi_external_streams_by_default(monkeypatch):
+    """Rung 3's primary is the device-streamed external subsystem
+    (ISSUE 13): a forced rung 3 with a budget the stream fits emits
+    `stream` events; the legacy host-chunked numpy LP is its FALLBACK
+    (tests/test_external.py pins the demotion path and `semi-external`
+    event there)."""
     monkeypatch.setenv(mem.ENV_FORCE_RUNG, "3")
-    monkeypatch.setenv(mem.ENV_BUDGET, "800000")  # force host levels
+    monkeypatch.setenv(mem.ENV_BUDGET, "6000000")
     g = make_rgg2d(8000, avg_degree=8, seed=3)
     part, cut = _partition(g, k=8)
     assert part.shape == (g.n,)
     gate = _gate()
     assert gate and gate["valid"]
-    ev = telemetry.events("semi-external")
+    ev = telemetry.events("stream")
     assert ev and ev[-1].attrs["coarse_n"] < g.n
+
+
+def test_host_lp_cluster_cap_exact_on_weighted_graph():
+    """The rung-3 host LP's cluster-weight cap is EXACT: the per-chunk
+    prefix pass accepts only joins that keep every target at or under
+    the cap (the vectorized apply used to overshoot by up to a chunk's
+    worth of concurrent joins on weighted graphs)."""
+    g = make_rgg2d(3000, avg_degree=8, seed=11)
+    rng = np.random.default_rng(13)
+    g.node_weights = rng.integers(1, 9, g.n).astype(np.int64)
+    cap = 25
+    # small chunks force cross-chunk and within-chunk concurrent joins
+    labels = mem._host_lp_cluster(g, max_cluster_weight=cap,
+                                  chunk_nodes=256)
+    cw = np.zeros(int(labels.max()) + 1, dtype=np.int64)
+    np.add.at(cw, labels, g.node_weights)
+    members = np.bincount(labels)
+    over = np.flatnonzero(cw > cap)
+    # a singleton heavier than the cap never joined anything and is
+    # legitimately over; every JOINED cluster respects the cap exactly
+    assert all(members[c] == 1 for c in over), (
+        [(int(c), int(cw[c]), int(members[c])) for c in over[:5]]
+    )
+    assert len(np.unique(labels)) < g.n  # still genuinely coarsens
 
 
 # ---------------------------------------------------------------------------

@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 8
+    assert loaded["schema_version"] == 9
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -661,13 +661,20 @@ def test_schema_accepts_v1_through_v7(tmp_path):
     v8_missing = dict(v7, schema_version=8)
     assert any("dist_resilience" in e
                for e in checker.version_checks(v8_missing))
-    v8 = dict(v8_missing, dist_resilience={"enabled": False})
+    v8 = checker._minimal_v8_report()
     assert checker.validate_instance(v8, schema) == []
     assert checker.version_checks(v8) == []
-    # v9 is not a known version
-    v9 = dict(v1, schema_version=9)
+    # v9 additionally requires the external section
+    v9_missing = dict(v8, schema_version=9)
+    assert any("external" in e
+               for e in checker.version_checks(v9_missing))
+    v9 = dict(v9_missing, external={"enabled": False})
+    assert checker.validate_instance(v9, schema) == []
+    assert checker.version_checks(v9) == []
+    # v10 is not a known version
+    v10 = dict(v1, schema_version=10)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v9, schema))
+               for e in checker.validate_instance(v10, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
