@@ -40,6 +40,50 @@ QUALITY_KEYS = ("coarsening_locked_frac", "refinement_left_frac")
 #: silent coverage loss, gated by bench_trend from r06 on).
 EXTERNAL_KEYS = ("external_seconds", "stream_overlap")
 
+#: Supervised-serving key (round 14, resilience/supervisor.py): p95 of
+#: a small `--serve-isolation process` batch — the latency cost of the
+#: hang/crash-containment boundary (spawn amortized over the warm
+#: worker).  Same never-vanish contract (null = inproc/skipped/failed,
+#: ABSENCE = silent coverage loss, gated by bench_trend from r06 on).
+SUPERVISED_KEYS = ("supervised_p95_ms",)
+
+
+def supervised_key(p95_ms=None) -> dict:
+    """The BENCH line's supervised-serving key; always present, null
+    when the supervised measurement was skipped or failed."""
+    return {"supervised_p95_ms": p95_ms}
+
+
+def _measure_supervised():
+    """p95 total-latency (ms) of a 3-request supervised batch: compute
+    runs in a spawned worker under the hard wall-clock watchdog, so
+    the figure prices the containment boundary (npz exchange + worker
+    supervision) against the same graphs served inproc."""
+    from kaminpar_tpu.serving import (
+        PartitionRequest,
+        PartitionService,
+        ServiceConfig,
+    )
+
+    svc = PartitionService("default", ServiceConfig(
+        isolation="process", worker_max_requests=16,
+    ))
+    try:
+        reqs = [
+            PartitionRequest(
+                f"gen:rgg2d;n=4096;avg_degree=8;seed={i}", k=4, seed=1,
+                request_id=f"sup-{i}",
+            )
+            for i in range(3)
+        ]
+        recs = svc.serve(reqs)
+        bad = [r.verdict for r in recs if r.verdict != "served"]
+        assert not bad, f"supervised batch verdicts: {bad}"
+        lat = svc.latency_summary()["phases"]["total"]
+        return lat["p95_ms"]
+    finally:
+        svc.close()
+
 
 def quality_keys(report) -> dict:
     """The BENCH line's quality-attribution keys from an embedded run
@@ -524,6 +568,19 @@ def _bench_line() -> dict:
             print(f"bench: external measurement failed: {e}",
                   file=sys.stderr)
     line.update(external_keys(ext_seconds, ext_overlap))
+    # supervised-serving latency (round 14): the containment boundary's
+    # p95 — always-present key (null = skipped/failed), same r05-class
+    # presence contract as the 10M/external blocks
+    sup_p95 = None
+    if os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1":
+        try:
+            sup_p95 = _measure_supervised()
+        except Exception as e:
+            import sys
+
+            print(f"bench: supervised measurement failed: {e}",
+                  file=sys.stderr)
+    line.update(supervised_key(sup_p95))
     if best_report is not None:
         # rating-engine choices of the best run (ops/rating.py
         # selection, from the embedded report's `rating` section):

@@ -215,6 +215,26 @@ def build_parser() -> argparse.ArgumentParser:
         "default 8 GiB)",
     )
     p.add_argument(
+        "--serve-isolation", default=None,
+        choices=["inproc", "process"],
+        help="serve mode: execution isolation (default inproc). "
+        "`process` runs every request's compute in a supervised worker "
+        "subprocess (resilience/supervisor.py): a worker hung past its "
+        "hard wall-clock ceiling is SIGKILLed (verdict "
+        "failed/worker-hang), a worker segfault/OOM-kill is classified "
+        "(failed/worker-crash), and the service keeps draining the "
+        "queue; workers are warm-reused and recycled on request-count "
+        "or RSS watermarks (docs/robustness.md, supervision contract)",
+    )
+    p.add_argument(
+        "--heartbeat-file", default=None, metavar="PATH",
+        help="touch PATH's mtime at every pipeline barrier and from "
+        "the watchdog tick while nothing is hung, so external "
+        "supervisors (k8s liveness probes, systemd WatchdogSec) can "
+        "tell slow-but-alive from hung without parsing output (also "
+        "via KAMINPAR_TPU_HEARTBEAT_FILE; docs/robustness.md)",
+    )
+    p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
@@ -371,6 +391,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .resilience import deadline as deadline_mod
 
     deadline_mod.install_signal_handlers()
+
+    # liveness heartbeat (resilience/supervisor.py): configured before
+    # any long-running work so the very first barrier already advances
+    # the file external supervisors watch
+    if args.heartbeat_file:
+        from .resilience import supervisor as supervisor_mod
+
+        supervisor_mod.set_heartbeat(args.heartbeat_file)
 
     from . import telemetry
     from .utils import heap_profiler, statistics

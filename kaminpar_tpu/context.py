@@ -437,6 +437,14 @@ class ResilienceContext:
     resume: bool = False
     time_budget: float = 0.0
     budget_grace: float = 30.0
+    #: Hard wall-clock ceiling multiplier (resilience/supervisor.py):
+    #: with a cooperative `time_budget` armed, the watchdog's hard
+    #: ceiling defaults to max(factor * budget, budget + grace) — the
+    #: backstop for hangs the cooperative budget cannot interrupt
+    #: (hung launches, hung backend init, stuck native calls).
+    #: KAMINPAR_TPU_HARD_DEADLINE_S overrides the derived value; 0
+    #: disables the derived ceiling entirely.
+    hard_deadline_factor: float = 10.0
     #: Declared device-memory budget in bytes (``--memory-budget``;
     #: 0 = take KAMINPAR_TPU_HBM_BYTES, unset = no budget).  With a
     #: budget in force the memory governor (resilience/memory.py)

@@ -137,6 +137,20 @@ def build_parser() -> argparse.ArgumentParser:
         "this flag exists so the two CLIs stay argument-compatible and "
         "fails with a pointer instead of 'unrecognized argument'",
     )
+    p.add_argument(
+        "--serve-isolation", default=None,
+        choices=["inproc", "process"],
+        help="supervised worker execution belongs to the shm serving "
+        "CLI (python -m kaminpar_tpu --serve-batch --serve-isolation "
+        "process); argument-compat flag, fails with a pointer",
+    )
+    p.add_argument(
+        "--heartbeat-file", default=None, metavar="PATH",
+        help="touch PATH's mtime at every dist pipeline barrier (and "
+        "from the watchdog tick while nothing is hung) so external "
+        "supervisors can tell slow-but-alive from hung — the shm CLI's "
+        "flag, honored here too (resilience/supervisor.py)",
+    )
     from . import telemetry
 
     telemetry.add_cli_args(p)
@@ -161,6 +175,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.serve_isolation is not None:
+        print(
+            "error: supervised worker isolation is a serving-layer "
+            "mode — use `python -m kaminpar_tpu --serve-batch "
+            "BATCH.json --serve-isolation process` "
+            "(docs/robustness.md, supervision contract)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.heartbeat_file:
+        from .resilience import supervisor as supervisor_mod
+
+        supervisor_mod.set_heartbeat(args.heartbeat_file)
     if args.graph is None:
         print("error: no graph file given", file=sys.stderr)
         return 1

@@ -185,6 +185,16 @@ class KaMinPar:
         mgr = None
         res_ctx = ctx.resilience
         self.last_anytime = None  # stale verdicts must not survive a rerun
+        # hard wall-clock watchdog (resilience/supervisor.py): the
+        # cooperative budget above is checked BETWEEN launches and can
+        # never interrupt a hung one; when a hard ceiling resolves
+        # (env override, or factor x budget for budgeted runs) the
+        # partitioning block below runs under an armed watchdog stage
+        # that converts a wall-clock overrun into a structured,
+        # breaker-relevant StageHang.  None = no ceiling = no-op guard.
+        from .resilience import supervisor as sup_mod
+
+        hard_ceiling_s = None
         if owns_stream:
             # self-heal leftover state from an exceptional unwind of a
             # previous run in this process (a stale manager or deadline
@@ -195,7 +205,8 @@ class KaMinPar:
             # logged clean restart)
             ckpt_mod.deactivate()
             deadline_mod.begin_run(
-                res_ctx.time_budget or None, res_ctx.budget_grace
+                res_ctx.time_budget or None, res_ctx.budget_grace,
+                getattr(res_ctx, "hard_deadline_factor", None),
             )
             mgr = ckpt_mod.create_manager(res_ctx, self._graph, ctx)
             if mgr is not None:
@@ -206,6 +217,10 @@ class KaMinPar:
             # it); dormant without a budget, but the ladder below still
             # catches any DeviceOOM
             mem_mod.begin_run(graph, ctx)
+            hard_ceiling_s = sup_mod.hard_ceiling(
+                res_ctx.time_budget, res_ctx.budget_grace,
+                getattr(res_ctx, "hard_deadline_factor", None),
+            )
         if not owns_stream:
             # nested run (shm IP inside the dist driver): blind the
             # barrier hook for the duration — inner drivers must neither
@@ -221,7 +236,9 @@ class KaMinPar:
             set_output_level(getattr(self, "_explicit_level", prior_level))
             if self.output_level >= OutputLevel.APPLICATION:
                 self._print_context_summary(graph, ctx)
-            with timer.scoped_timer("partitioning"), scoped_heap_profiler(
+            with sup_mod.stage_guard(
+                "partition", hard_ceiling_s
+            ), timer.scoped_timer("partitioning"), scoped_heap_profiler(
                 "partitioning"
             ):
                 # isolated-node preprocessing (kaminpar.cc:392-404)

@@ -207,7 +207,8 @@ class dKaMinPar:
             # two from drifting apart)
             ckpt_mod.deactivate()
             deadline_mod.begin_run(
-                res_ctx.time_budget or None, res_ctx.budget_grace
+                res_ctx.time_budget or None, res_ctx.budget_grace,
+                getattr(res_ctx, "hard_deadline_factor", None),
             )
             mgr = ckpt_mod.create_manager(res_ctx, graph, self.ctx)
             if mgr is not None:

@@ -38,6 +38,8 @@ from .errors import (  # noqa: F401
     PlanBlowup,
     RankDivergence,
     RefinerRefused,
+    StageHang,
+    WorkerCrash,
     classify,
 )
 from .faults import (  # noqa: F401
@@ -60,12 +62,13 @@ from . import gate  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import deadline  # noqa: F401
 from . import agreement  # noqa: F401
+from . import supervisor  # noqa: F401
 
 
 def reset() -> None:
     """Reset injection counters, circuit breakers, the active checkpoint
-    manager, any armed deadline, and the dist agreement/sentinel state
-    (test isolation)."""
+    manager, any armed deadline, the dist agreement/sentinel state, and
+    the supervision watchdog/heartbeat counters (test isolation)."""
     from . import faults as _faults
 
     _faults.reset()
@@ -74,3 +77,4 @@ def reset() -> None:
     deadline.clear()
     agreement.disarm()
     agreement.set_gather_override(None)
+    supervisor.reset()

@@ -180,6 +180,15 @@ def barrier(
         from . import agreement
 
         agreement.maybe_audit(stage_id)
+        # liveness heartbeat (resilience/supervisor.py): barrier
+        # crossings are the pipeline's proof of forward progress — the
+        # heartbeat file's mtime advances here (and from the watchdog
+        # tick while nothing is hung), so an external supervisor can
+        # tell slow-but-alive from hung.  One attribute read when no
+        # heartbeat file is configured.
+        from . import supervisor as supervisor_mod
+
+        supervisor_mod.heartbeat_touch()
         # device-memory watermark: the perf observatory samples the
         # resident-bytes figure at exactly these multilevel barriers
         # (host side, between launches; one bool check when disabled)

@@ -47,9 +47,19 @@ DEFAULT_GRACE_S = runstate.DEFAULT_GRACE_S
 _prev_handlers: dict = {}
 
 
-def install_budget(budget_s: float, grace_s: Optional[float] = None) -> None:
+def install_budget(budget_s: float, grace_s: Optional[float] = None,
+                   hard_factor: Optional[float] = None) -> None:
     """Arm a fresh deadline ``budget_s`` seconds from now (on the
-    calling thread's current run)."""
+    calling thread's current run).
+
+    The budget here is COOPERATIVE: it is checked between kernel
+    launches at the pipeline barriers, so it can never interrupt a hung
+    launch, a hung backend init, or a stuck native call.  The hard
+    wall-clock watchdog (resilience/supervisor.py) is the backstop for
+    that failure class — when a hard ceiling is active for this budget
+    a ``watchdog-armed`` telemetry event records it, so a run report
+    shows whether hang containment was armed or the budget was on its
+    own (docs/robustness.md, "Supervision contract")."""
     run = runstate.current()
     run.budget_s = float(budget_s)
     run.grace_s = float(grace_s) if grace_s is not None else DEFAULT_GRACE_S
@@ -58,6 +68,22 @@ def install_budget(budget_s: float, grace_s: Optional[float] = None) -> None:
     run.stop = False
     run.reason = ""
     run.announced = False
+    from . import supervisor
+
+    # hard_factor comes from the caller's resilience context (the
+    # facade threads ctx.resilience.hard_deadline_factor through
+    # begin_run) so the event reports the ceiling that is ACTUALLY
+    # armed — factor 0 arms nothing and must emit nothing
+    ceiling = supervisor.hard_ceiling(run.budget_s, run.grace_s,
+                                      hard_factor)
+    if ceiling is not None:
+        from .. import telemetry
+
+        telemetry.event(
+            "watchdog-armed",
+            ceiling_s=round(ceiling, 3),
+            budget_s=run.budget_s,
+        )
 
 
 def clear() -> None:
@@ -68,16 +94,20 @@ def clear() -> None:
 
 
 def begin_run(budget_s: Optional[float] = None,
-              grace_s: Optional[float] = None) -> None:
+              grace_s: Optional[float] = None,
+              hard_factor: Optional[float] = None) -> None:
     """Per-run reset used by the facades (shm and dist): installs a
     FRESH run state — stale budget/stage/stop state from a previous run
     is structurally unreachable, not merely cleared — and arms the
     configured budget.  A pending process-wide preemption signal is
     deliberately NOT dropped: a SIGTERM that arrived while the graph was
-    still loading must wind down the run that follows."""
+    still loading must wind down the run that follows.  ``hard_factor``
+    is the caller's ctx.resilience.hard_deadline_factor — it sizes the
+    `watchdog-armed` event so the report matches the ceiling the facade
+    actually arms."""
     runstate.begin()
     if budget_s is not None and budget_s > 0:
-        install_budget(budget_s, grace_s)
+        install_budget(budget_s, grace_s, hard_factor)
     sig = runstate.signal_reason()
     if sig:
         request_stop(sig)

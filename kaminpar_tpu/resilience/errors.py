@@ -153,6 +153,50 @@ class RankDivergence(DegradationError):
         self.ranks = list(ranks or [])
 
 
+class StageHang(DegradationError):
+    """A pipeline stage exceeded its HARD wall-clock ceiling
+    (resilience/supervisor.py): a hung backend init, a hung device
+    launch, or a supervised worker that stopped answering.  The
+    cooperative deadline budget cannot interrupt these — it is checked
+    between launches — so the watchdog converts them into this
+    structured, breaker-relevant failure instead of an eternal block.
+
+    ``stage`` is the armed stage name, ``scope_path`` the (best-effort)
+    dotted timer-scope path that was open when the ceiling expired —
+    i.e. where the run was stuck — and ``ceiling_s`` the ceiling that
+    was exceeded.  Raised with site ``worker-hang`` by the worker
+    supervisor's SIGKILL path; async-delivered (no site) by the
+    in-process watchdog.  Crash-shaped: it advances the breaker."""
+
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        stage: str = "",
+        scope_path: str = "",
+        ceiling_s: Optional[float] = None,
+        site: Optional[str] = None,
+        injected: bool = False,
+    ) -> None:
+        super().__init__(message, site=site, injected=injected)
+        self.stage = stage
+        self.scope_path = scope_path
+        self.ceiling_s = ceiling_s
+
+
+class WorkerCrash(DegradationError):
+    """A supervised worker subprocess died — segfault in the native
+    library, allocator kill, or an injected SIGKILL (the
+    ``worker-crash`` chaos site).  The supervisor detects the death,
+    surfaces it as this structured failure for that request alone, and
+    keeps draining the queue with a fresh worker.  ``exit_code`` is the
+    subprocess's exit code (negative = killed by that signal).
+    Crash-shaped: it advances the breaker."""
+
+    #: Exit code of the dead worker (None when it could not be read).
+    exit_code: Optional[int] = None
+
+
 class DeviceOOM(DegradationError):
     """The accelerator (or host, for MemoryError) ran out of memory in an
     optional fast path.  Fallback: the path's smaller-footprint twin

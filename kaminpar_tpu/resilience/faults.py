@@ -49,6 +49,8 @@ from .errors import (
     PlanBlowup,
     RankDivergence,
     RefinerRefused,
+    StageHang,
+    WorkerCrash,
 )
 
 ENV_VAR = "KAMINPAR_TPU_FAULTS"
@@ -145,6 +147,24 @@ _register(SiteSpec(
     "allocator-shaped OOM at device upload / contraction / refinement "
     "(resilience/memory.py ladder; ladder-retryable OOMs never latch "
     "the serving per-class breaker — only rung exhaustion does)",
+))
+_register(SiteSpec(
+    "worker-hang", StageHang,
+    "supervisor SIGKILLs the worker past its hard ceiling; the request "
+    "fails with verdict `failed`/reason `worker-hang`, the service "
+    "keeps draining the queue",
+    "supervised worker wall-clock containment (resilience/supervisor.py; "
+    "chaos: the child worker genuinely sleeps past the ceiling and the "
+    "supervisor's kill path is what is exercised)",
+))
+_register(SiteSpec(
+    "worker-crash", WorkerCrash,
+    "worker death is detected, classified, and answered with verdict "
+    "`failed`/reason `worker-crash`; a fresh worker serves the next "
+    "request",
+    "supervised worker crash containment (resilience/supervisor.py; "
+    "chaos: the child worker exits via SIGKILL — the native-segfault "
+    "stand-in)",
 ))
 _register(SiteSpec(
     "rank-divergence", RankDivergence,

@@ -138,6 +138,15 @@ def with_fallback(
                 faults.maybe_inject(site)
             result = primary()
         except Exception as exc:  # classified below; unknowns re-raise
+            from .errors import StageHang
+
+            if isinstance(exc, StageHang) and not exc.injected:
+                # an async-delivered watchdog verdict (a hung stage)
+                # is a process-level failure that happened to LAND
+                # inside this site's primary — it must propagate to
+                # the hang-containment boundary, never be absorbed as
+                # this site's degradation
+                raise
             err = classify(exc, site)
             if err is None:
                 raise

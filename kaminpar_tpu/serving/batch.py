@@ -7,6 +7,8 @@ or ``{"config": {...}, "requests": [...]}``.  Each request object::
      "k": 8,                  # required
      "epsilon": 0.03,         # optional
      "deadline_s": 2.0,       # optional per-request anytime budget
+     "hard_deadline_s": 20.0, # optional per-request HARD wall-clock
+                              # ceiling (supervision contract)
      "priority": 0,           # optional, higher runs first
      "seed": 1,               # optional
      "id": "my-request"}      # optional stable id
@@ -14,7 +16,10 @@ or ``{"config": {...}, "requests": [...]}``.  Each request object::
 ``config`` keys map onto :class:`~kaminpar_tpu.serving.service.
 ServiceConfig` fields (``max_queue_depth``, ``max_queued_cost``,
 ``max_request_cost``, ``result_cache_entries``, ``result_cache_bytes``,
-``default_deadline_s``).
+``default_deadline_s``, and the supervision knobs ``isolation``,
+``hard_deadline_s``, ``hard_deadline_factor``, ``worker_max_requests``,
+``worker_rss_limit_bytes``, ``heartbeat_file``; the CLI flags
+``--serve-isolation`` / ``--heartbeat-file`` override the spec).
 
 Exit-code contract: the PROCESS outcome, not the per-request outcomes —
 isolated request failures and admission rejections still exit 0 (that is
@@ -96,6 +101,10 @@ def load_batch(path: str) -> Tuple[List[PartitionRequest], ServiceConfig]:
                     float(r["deadline_s"])
                     if r.get("deadline_s") is not None else None
                 ),
+                hard_deadline_s=(
+                    float(r["hard_deadline_s"])
+                    if r.get("hard_deadline_s") is not None else None
+                ),
                 priority=int(r.get("priority", 0)),
                 seed=(
                     int(r["seed"]) if r.get("seed") is not None else None
@@ -137,6 +146,10 @@ def run_batch_cli(args, ctx) -> int:
         config.max_queue_depth = int(args.serve_queue_depth)
     if args.serve_cost_cap is not None:
         config.max_queued_cost = float(args.serve_cost_cap)
+    if getattr(args, "serve_isolation", None) is not None:
+        config.isolation = str(args.serve_isolation)
+    if getattr(args, "heartbeat_file", None):
+        config.heartbeat_file = str(args.heartbeat_file)
 
     service = PartitionService(ctx, config, quiet=True)
     t0 = time.perf_counter()
@@ -152,10 +165,12 @@ def run_batch_cli(args, ctx) -> int:
         from ..cli import _emergency_interrupt_exit
 
         service.annotate()
+        service.close()
         return _emergency_interrupt_exit(args, t0)
     wall = time.perf_counter() - t0
 
     summary = service.annotate()
+    service.close()  # release the supervised worker pool, if any
     if telemetry.enabled() and "result" not in telemetry.run_info():
         # the stream belongs to the LAST request; if it never produced a
         # result (failed/rejected/drained), the schema-required section
@@ -183,10 +198,13 @@ def run_batch_cli(args, ctx) -> int:
         )
         print(
             "SERVING total={} served={} anytime={} degraded={} "
-            "rejected={} failed={} cache_hit_rate={} p50_ms={} "
-            "p95_ms={} drained={} wall={:.3f}s".format(
+            "rejected={} failed={} worker_hang={} worker_crash={} "
+            "cache_hit_rate={} p50_ms={} p95_ms={} drained={} "
+            "wall={:.3f}s".format(
                 len(records), counts["served"], counts["anytime"],
                 counts["degraded"], counts["rejected"], counts["failed"],
+                counts.get("worker-hang", 0),
+                counts.get("worker-crash", 0),
                 summary["cache"]["hit_rate"],
                 total_hist.get("p50_ms"), total_hist.get("p95_ms"),
                 int(summary["drained"]), wall,

@@ -77,6 +77,10 @@ class PartitionRequest:
     k: int
     epsilon: float = 0.03
     deadline_s: Optional[float] = None  # per-request anytime budget
+    #: explicit per-request HARD wall-clock ceiling (supervision
+    #: contract): overrides the service-level hard_deadline_s and the
+    #: factor-derived ceiling; None = resolve from the config
+    hard_deadline_s: Optional[float] = None
     priority: int = 0  # higher runs first
     seed: Optional[int] = None
     request_id: str = ""
@@ -110,6 +114,9 @@ class RequestRecord:
     bucket: str = ""  # executable bucket key "n_pad/m_pad/k_pad"
     degraded_sites: List[str] = field(default_factory=list)
     wall_s: float = 0.0
+    # the hard wall-clock ceiling the request ran under (supervision
+    # contract, resilience/supervisor.py); None = no ceiling armed
+    hard_ceiling_s: Optional[float] = None
     # per-phase latency breakdown in ms (admission_wait / resolve /
     # compute / gate) — the per-request rows behind serving.latency
     phases: Dict[str, float] = field(default_factory=dict)
@@ -134,6 +141,8 @@ class RequestRecord:
                 d[key] = v
         if self.gate_valid is not None:
             d["gate_valid"] = bool(self.gate_valid)
+        if self.hard_ceiling_s is not None:
+            d["hard_ceiling_s"] = round(float(self.hard_ceiling_s), 3)
         if self.degraded_sites:
             d["degraded_sites"] = list(self.degraded_sites)
         if self.phases:
@@ -166,6 +175,28 @@ class ServiceConfig:
     #: keep partitions on the records (library callers; the CLI drops
     #: them — a 16-request batch of 1M-node graphs is 64 MB of labels)
     keep_partitions: bool = False
+    #: execution isolation (docs/robustness.md, supervision contract):
+    #: "inproc" (default) runs compute on the caller's thread exactly
+    #: as before; "process" runs it in a supervised worker subprocess
+    #: — a worker hang is SIGKILLed past the hard ceiling (verdict
+    #: `failed`/`worker-hang`), a worker death is classified
+    #: (`worker-crash`), and the service keeps draining either way
+    isolation: str = "inproc"
+    #: explicit per-request hard wall-clock ceiling in seconds (0 =
+    #: derive from the cooperative deadline via hard_deadline_factor,
+    #: or KAMINPAR_TPU_HARD_DEADLINE_S)
+    hard_deadline_s: float = 0.0
+    #: hard ceiling = max(factor * budget, budget + grace) for requests
+    #: that carry a cooperative deadline (resilience/supervisor.py)
+    hard_deadline_factor: float = 10.0
+    #: recycle the warm worker after this many requests (leak
+    #: containment; process isolation only)
+    worker_max_requests: int = 32
+    #: ... or once its peak RSS exceeds this watermark (bytes; 0 = off)
+    worker_rss_limit_bytes: float = float(4 << 30)
+    #: liveness heartbeat file (also settable via --heartbeat-file /
+    #: KAMINPAR_TPU_HEARTBEAT_FILE); "" = disabled
+    heartbeat_file: str = ""
 
 
 class PartitionService:
@@ -220,6 +251,26 @@ class PartitionService:
         }
         self._class_latency: Dict[str, Histogram] = {}
         self._submit_t: Dict[str, float] = {}  # id -> submit stamp
+        # supervised execution (resilience/supervisor.py): in process
+        # mode compute runs in a warm worker subprocess under the hard
+        # wall-clock watchdog — spawned lazily on the first executed
+        # request, recycled on the configured request/RSS watermarks
+        from ..resilience import supervisor as supervisor_mod
+
+        if self.config.isolation not in ("inproc", "process"):
+            raise ValueError(
+                f"unknown isolation mode {self.config.isolation!r} "
+                "(want 'inproc' or 'process')"
+            )
+        self._pool = (
+            supervisor_mod.WorkerPool(
+                max_requests=int(self.config.worker_max_requests),
+                rss_limit_bytes=int(self.config.worker_rss_limit_bytes),
+            )
+            if self.config.isolation == "process" else None
+        )
+        if self.config.heartbeat_file:
+            supervisor_mod.set_heartbeat(self.config.heartbeat_file)
 
     # -- admission -----------------------------------------------------
 
@@ -471,6 +522,25 @@ class PartitionService:
         ctx.partition.epsilon = float(req.epsilon)
         return ctx
 
+    def _hard_ceiling(self, req: PartitionRequest) -> Optional[float]:
+        """The request's hard wall-clock ceiling (supervision contract):
+        explicit service override first, else derived from the
+        cooperative per-request deadline (or the env override) via
+        resilience/supervisor.hard_ceiling.  None = no ceiling."""
+        from ..resilience import supervisor as supervisor_mod
+
+        if req.hard_deadline_s is not None and req.hard_deadline_s > 0:
+            return float(req.hard_deadline_s)
+        if self.config.hard_deadline_s > 0:
+            return float(self.config.hard_deadline_s)
+        budget = (
+            req.deadline_s if req.deadline_s is not None
+            else self.config.default_deadline_s
+        )
+        return supervisor_mod.hard_ceiling(
+            budget or 0.0, factor=self.config.hard_deadline_factor
+        )
+
     def _cache_lookup(self, key, req: PartitionRequest,
                       pre_degraded: List[str]):
         """Result-cache get through the `serving-cache` site: an
@@ -530,19 +600,38 @@ class PartitionService:
             bucket = self._buckets.observe(rec.n, rec.m, int(req.k))
             rec.bucket = "/".join(str(x) for x in bucket)
             cls = self._class_key(rec.n, rec.m, int(req.k))
+            rec.hard_ceiling_s = self._hard_ceiling(req)
 
-            solver = KaMinPar(ctx)
-            if self.quiet:
-                solver.set_output_level(OutputLevel.QUIET)
-            solver.set_graph(graph)
+            winfo = None
+            solver = None
             t_c0 = time.perf_counter()
-            part = solver.compute_partition(
-                k=int(req.k), epsilon=float(req.epsilon), seed=req.seed,
-            )
-            # the gate runs inside compute_partition under its own
-            # top-level scope; the per-run timer reset at compute entry
-            # makes this elapsed figure THIS request's gate time
-            gate_s = timer.GLOBAL_TIMER.elapsed("output-gate")
+            if self._pool is not None:
+                # supervised worker execution: compute runs in the
+                # spawned worker under the hard wall-clock watchdog; a
+                # hang is SIGKILLed and surfaces as StageHang (site
+                # `worker-hang`), a worker death as WorkerCrash — both
+                # land in the isolation boundary below like any other
+                # classified failure, and the queue keeps draining
+                part, winfo = self._pool.run_request(
+                    req.request_id, req.graph, graph, ctx,
+                    k=int(req.k), epsilon=float(req.epsilon),
+                    seed=req.seed, ceiling_s=rec.hard_ceiling_s,
+                )
+                gate_s = float(winfo.get("gate_s") or 0.0)
+            else:
+                solver = KaMinPar(ctx)
+                if self.quiet:
+                    solver.set_output_level(OutputLevel.QUIET)
+                solver.set_graph(graph)
+                part = solver.compute_partition(
+                    k=int(req.k), epsilon=float(req.epsilon),
+                    seed=req.seed,
+                )
+                # the gate runs inside compute_partition under its own
+                # top-level scope; the per-run timer reset at compute
+                # entry makes this elapsed figure THIS request's gate
+                # time
+                gate_s = timer.GLOBAL_TIMER.elapsed("output-gate")
             compute_s = max(time.perf_counter() - t_c0 - gate_s, 0.0)
         except (KeyboardInterrupt, SystemExit, SimulatedPreemption):
             raise  # process-fatal by contract; never a request verdict
@@ -551,9 +640,29 @@ class PartitionService:
             rec.verdict = "failed"
             rec.error = type(err if err is not None else exc).__name__
             rec.detail = str(exc)[:300]
-            rec.reason = (
-                "malformed-input" if _input_shaped(exc) else "exception"
-            )
+            # supervision verdicts (resilience/supervisor.py) carry
+            # their own reason taxonomy: a SIGKILLed hung worker reads
+            # `worker-hang`, a dead worker `worker-crash`, and an
+            # in-process watchdog overrun `stage-hang` — everything
+            # else keeps the malformed-input/exception split
+            if isinstance(err, res_errors.WorkerCrash):
+                rec.reason = "worker-crash"
+            elif isinstance(err, res_errors.StageHang):
+                # in process mode every hang verdict — the supervisor's
+                # SIGKILL path AND a hang the child's own watchdog
+                # managed to convert gracefully — reads `worker-hang`;
+                # an in-process watchdog overrun reads `stage-hang`.
+                # (err.site is NOT trusted here: a hang landing inside
+                # a guarded primary may carry that site's stamp.)
+                rec.reason = (
+                    "worker-hang" if self._pool is not None
+                    else "stage-hang"
+                )
+            else:
+                rec.reason = (
+                    "malformed-input" if _input_shaped(exc)
+                    else "exception"
+                )
             rec.wall_s = time.perf_counter() - t0
             # failures carry latency too (whatever phases completed) —
             # a timeout-shaped failure mode must be visible in p99
@@ -602,22 +711,33 @@ class PartitionService:
             )
             return rec
 
-        # success path: harvest the per-request telemetry (the facade
-        # reset the stream at compute entry, so everything in it belongs
-        # to this request)
+        # success path: harvest the per-request telemetry (inproc: the
+        # facade reset the stream at compute entry, so everything in it
+        # belongs to this request; process: the worker harvested ITS
+        # stream the same way and marshalled the harvest back)
         for c in {cls, cls_submit} - {""}:
             self._class_failures.pop(c, None)
-        metrics = solver.result_metrics(graph, part)
+        if winfo is not None:
+            metrics = dict(winfo["metrics"])
+            rec.gate_valid = winfo.get("gate_valid")
+            worker_degraded = set(winfo.get("degraded_sites") or [])
+            anytime = winfo.get("anytime")
+        else:
+            metrics = solver.result_metrics(graph, part)
+            gate = telemetry.run_info().get("output_gate")
+            if isinstance(gate, dict) and gate.get("checked"):
+                rec.gate_valid = bool(gate.get("valid"))
+            worker_degraded = {
+                e.attrs.get("site", "")
+                for e in telemetry.events("degraded")
+            }
+            anytime = solver.last_anytime
         rec.cut = int(metrics["cut"])
         rec.imbalance = float(metrics["imbalance"])
         rec.feasible = bool(metrics["feasible"])
-        gate = telemetry.run_info().get("output_gate")
-        if isinstance(gate, dict) and gate.get("checked"):
-            rec.gate_valid = bool(gate.get("valid"))
-        rec.degraded_sites = sorted(({
-            e.attrs.get("site", "") for e in telemetry.events("degraded")
-        } | set(pre_degraded)) - {""})
-        anytime = solver.last_anytime
+        rec.degraded_sites = sorted(
+            (worker_degraded | set(pre_degraded)) - {""}
+        )
         if anytime:
             rec.verdict = "anytime"
             rec.reason = str(anytime.get("reason") or "")
@@ -749,6 +869,14 @@ class PartitionService:
         counts = {v: 0 for v in VERDICTS}
         for rec in records:
             counts[rec.verdict] = counts.get(rec.verdict, 0) + 1
+        # supervision verdicts surface in the counts next to the five
+        # verdict keys — only when nonzero, so `sum(counts over the
+        # verdict keys) == len(requests)` stays true for consumers that
+        # sum the whole dict on an unsupervised batch
+        for reason_key in ("worker-hang", "worker-crash"):
+            hit = sum(1 for r in records if r.reason == reason_key)
+            if hit:
+                counts[reason_key] = hit
         result_stats = self._result_cache.stats()
         return {
             "enabled": True,
@@ -771,12 +899,30 @@ class PartitionService:
             "drained": bool(self._drained),
         }
 
+    def supervision_summary(self) -> dict:
+        """The run report's ``supervision`` section (schema v10) for
+        this service: worker-pool lifecycle counters, the hang log,
+        heartbeat state, watchdog stats, and the isolation mode."""
+        from ..resilience import supervisor as supervisor_mod
+
+        return supervisor_mod.summary(
+            pool=self._pool, isolation=self.config.isolation
+        )
+
+    def close(self) -> None:
+        """Shut down the supervised worker pool (process isolation);
+        a plain inproc service has nothing to release.  Idempotent."""
+        if self._pool is not None:
+            self._pool.shutdown()
+
     def annotate(self) -> dict:
-        """Stamp the serving section into the telemetry run info (call
-        AFTER the last request — compute_partition resets the stream at
-        entry) and return it."""
+        """Stamp the serving + supervision sections into the telemetry
+        run info (call AFTER the last request — compute_partition
+        resets the stream at entry) and return the serving section."""
         s = self.summary()
-        telemetry.annotate(serving=s)
+        telemetry.annotate(
+            serving=s, supervision=self.supervision_summary()
+        )
         return s
 
 

@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 9
+SCHEMA_VERSION = 10
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -115,6 +115,18 @@ def _quality_section(ranks=None) -> dict:
     return section
 
 
+def _supervision_section() -> dict:
+    """Schema v10 `supervision` section from the module state (the
+    serving layer overrides this with its pool-aware summary); the
+    disabled default when nothing supervision-shaped ever armed."""
+    try:
+        from ..resilience import supervisor
+
+        return supervisor.summary()
+    except Exception:
+        return {"enabled": False}
+
+
 def _fault_section() -> dict:
     """The fault-plan echo (CLI satellite): plan, sites, injected log."""
     try:
@@ -181,6 +193,15 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     # upload/compute overlap fraction, fine-level device residency);
     # in-core runs carry the well-formed disabled default
     external = info.pop("external", {"enabled": False})
+    # schema v10: the supervision audit trail (resilience/supervisor.py
+    # — worker lifecycle, hang events, heartbeat, watchdog).  The
+    # serving layer annotates its pool-aware view; otherwise the module
+    # state is read directly (a single-shot run with a heartbeat or an
+    # armed watchdog still reports), and a run that configured nothing
+    # carries the well-formed disabled default.
+    supervision = info.pop("supervision", None)
+    if supervision is None:
+        supervision = _supervision_section()
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -316,6 +337,12 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # handoff point, and the fine level's device residency (0 for
         # any run that actually streamed)
         "external": external,
+        # schema v10: the supervision audit trail — worker lifecycle
+        # counters (spawned/recycled/killed/crashed), hang events with
+        # the stuck stage/scope path, heartbeat file + touch count, and
+        # watchdog arm/fire counts (resilience/supervisor.py,
+        # docs/robustness.md "Supervision contract")
+        "supervision": supervision,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

@@ -68,6 +68,23 @@ def ensure_platform_env() -> None:
             # below still restrict every call this package makes
 
 
+def _backend_init_guard():
+    """Watchdog stage for backend discovery, armed only by the explicit
+    env ceiling (KAMINPAR_TPU_HARD_DEADLINE_S) — backend init happens
+    before any run-scoped budget exists.  Degrades to a no-op context
+    while the resilience package is still bootstrapping."""
+    try:
+        from ..resilience import supervisor
+
+        return supervisor.stage_guard(
+            "backend-init", supervisor.env_ceiling()
+        )
+    except Exception:
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
 def requested_platforms() -> Tuple[str, ...]:
     """Platforms the environment restricts jax to ((), when unrestricted)."""
     ensure_platform_env()
@@ -84,12 +101,20 @@ def devices(backend: Optional[str] = None) -> list:
     """``jax.devices()`` behind the gate.
 
     With a platform restriction in force the query names that platform
-    explicitly, so only its backend is ever initialized."""
+    explicitly, so only its backend is ever initialized.  Backend init
+    is the package's canonical non-cooperative hang class (a downed
+    axon tunnel blocked here for 600 s) — with
+    ``KAMINPAR_TPU_HARD_DEADLINE_S`` set the init runs under an armed
+    watchdog stage (resilience/supervisor.py): the hang is recorded
+    with its ceiling, the liveness heartbeat stalls so external
+    supervisors can act, and a ``StageHang`` is async-delivered the
+    moment the blocked call returns to the interpreter."""
     ensure_platform_env()
     import jax
 
     backend = backend or _primary_platform()
-    return jax.devices(backend) if backend else jax.devices()
+    with _backend_init_guard():
+        return jax.devices(backend) if backend else jax.devices()
 
 
 def local_devices(backend: Optional[str] = None) -> list:

@@ -71,6 +71,13 @@ QUALITY_COVERAGE_KEYS = ("coarsening_locked_frac",
 #: the scale path — the r05 regression class).
 EXTERNAL_COVERAGE_KEYS = ("external_seconds", "stream_overlap")
 
+#: Supervised-serving key (round 14, resilience/supervisor.py): the
+#: BENCH line must always carry it from r06 on (null = the supervised
+#: batch was skipped/failed or the platform can't spawn workers,
+#: absence = silent coverage loss of the containment boundary's
+#: latency trend — the r05 regression class).
+SUPERVISED_COVERAGE_KEYS = ("supervised_p95_ms",)
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -243,6 +250,7 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "external_s": ext_s,
         "overlap": overlap,
         "p95_ms": p95_ms,
+        "sup_p95": parsed.get("supervised_p95_ms"),
         "schema": report.get("schema_version"),
     }
 
@@ -260,7 +268,7 @@ def render(rows: List[Dict[str, Any]]) -> str:
             "coarsening_s", "lp_s", "contract_s", "engines",
             "compile_s", "cache_hit", "hbm_util",
             "pad_waste", "locked", "left", "external_s", "overlap",
-            "p95_ms", "platform", "schema")
+            "p95_ms", "sup_p95", "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -391,6 +399,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{name}: external coverage key {key!r} missing "
                         "(bench.py must emit it every run; null marks a "
                         "skipped/failed external measurement)"
+                    )
+            for key in SUPERVISED_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: supervised coverage key {key!r} "
+                        "missing (bench.py must emit it every run; null "
+                        "marks a skipped/failed supervised batch)"
                     )
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target

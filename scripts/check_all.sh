@@ -8,7 +8,12 @@
 # sections), a serving smoke (16-request batch with one poisoned graph,
 # fault injection, a tight per-request deadline, repeated shapes for
 # cache hits, and a SIGTERM mid-batch drain — all verdicts in one
-# schema-valid report), a memory-governor smoke (artificially small
+# schema-valid report), a supervision smoke (--serve-isolation
+# process: one injected worker hang SIGKILLed past its 2 s hard
+# ceiling, one injected worker crash, the rest served from recycled
+# warm workers, heartbeat mtime advancing throughout — exit 0 with
+# exactly those two failed verdicts), a memory-governor smoke
+# (artificially small
 # budget -> ladder engages, forced rung-2 spill/reload, a serving
 # insufficient-memory rejection), an out-of-core streaming smoke
 # (--scheme external under a 25%-of-estimate budget -> gate-valid,
@@ -29,13 +34,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/10] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/11] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/10] run-report schema (producer selftest, v1-v8 fixtures + v9 producer) =="
+echo "== [2/11] run-report schema (producer selftest, v1-v9 fixtures + v10 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/10] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/11] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -103,7 +108,7 @@ print(f"quality smoke OK: {len(rows)} attribution row(s), "
       "BENCH quality keys present")
 EOF
 
-echo "== [4/10] telemetry.diff self-test + BENCH trend/kernel gate =="
+echo "== [4/11] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -127,7 +132,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/10] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/11] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -167,7 +172,7 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
-echo "== [6/10] serving smoke (mixed batch + faults + SIGTERM drain) =="
+echo "== [6/11] serving smoke (mixed batch + faults + SIGTERM drain) =="
 SERVE_DIR=/tmp/_kmp_serve_smoke
 rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
 python - <<'EOF3' || exit 1
@@ -264,7 +269,77 @@ print(f"drain OK: counts={c} ({len(drained)} drained)")
 EOF3
 
 
-echo "== [7/10] memory-governor smoke (tiny budget + forced spill + serving) =="
+echo "== [7/11] supervision smoke (worker hang/crash containment) =="
+SUP_DIR=/tmp/_kmp_sup_smoke
+rm -rf "$SUP_DIR"; mkdir -p "$SUP_DIR"
+SUP_START_NS=$(python -c "import time; print(time.time_ns())")
+python - <<'EOF7' || exit 1
+# 10 requests, distinct seeds (no cache hits — the chaos nth counters
+# count pool executions): #3 is the hang target (2 s hard ceiling, the
+# injected chaos makes the worker sleep forever), #6 the crash target
+# (worker SIGKILLs itself); worker_max_requests=4 forces >= 1 recycle
+# across the 8 clean requests
+import json
+
+reqs = []
+for i in range(1, 11):
+    r = {"graph": f"gen:rgg2d;n=4096;avg_degree=8;seed={i}", "k": 4,
+         "seed": 1, "id": f"s{i}"}
+    if i == 3:
+        r["hard_deadline_s"] = 2.0
+    reqs.append(r)
+json.dump({"config": {"worker_max_requests": 4}, "requests": reqs},
+          open("/tmp/_kmp_sup_smoke/batch.json", "w"))
+EOF7
+KAMINPAR_TPU_FAULTS=worker-hang:nth=3,worker-crash:nth=6 \
+    python -m kaminpar_tpu --serve-batch "$SUP_DIR/batch.json" \
+    --serve-isolation process --heartbeat-file "$SUP_DIR/heartbeat" \
+    --report-json "$SUP_DIR/report.json" \
+    || { echo "ERROR: supervised batch exited nonzero (containment broken)" >&2; exit 1; }
+python scripts/check_report_schema.py "$SUP_DIR/report.json" || exit 1
+SUP_START_NS=$SUP_START_NS python - <<'EOF7' || exit 1
+import json, os
+
+r = json.load(open("/tmp/_kmp_sup_smoke/report.json"))
+assert r["schema_version"] == 10, r["schema_version"]
+s = r["serving"]
+by_id = {q["request_id"]: q for q in s["requests"]}
+assert len(by_id) == 10, len(by_id)
+# the two injected failures — and ONLY those two — failed, with the
+# supervision reasons and the per-request hard-ceiling field recorded
+assert by_id["s3"]["verdict"] == "failed", by_id["s3"]
+assert by_id["s3"]["reason"] == "worker-hang", by_id["s3"]
+assert by_id["s3"]["hard_ceiling_s"] == 2.0, by_id["s3"]
+assert by_id["s6"]["verdict"] == "failed", by_id["s6"]
+assert by_id["s6"]["reason"] == "worker-crash", by_id["s6"]
+served = [q for q in s["requests"] if q["verdict"] == "served"]
+assert len(served) >= 8, s["counts"]
+for q in served:
+    assert q["feasible"] and q.get("gate_valid", True), q
+assert s["counts"]["failed"] == 2, s["counts"]
+assert s["counts"].get("worker-hang") == 1, s["counts"]
+assert s["counts"].get("worker-crash") == 1, s["counts"]
+# supervision section: workers were spawned, the hung one was killed,
+# the crashed one detected, and the clean tail reused a recycled warm
+# worker; the hang event carries its stage + ceiling
+sup = r["supervision"]
+assert sup["enabled"] and sup["isolation"] == "process", sup
+w = sup["workers"]
+assert w["spawned"] >= 2 and w["killed"] >= 1 and w["crashed"] >= 1, w
+assert w["recycled"] >= 1, w
+assert sup["hangs"] and sup["hangs"][0]["ceiling_s"] == 2.0, sup["hangs"]
+# heartbeat: touched at barriers + watchdog ticks + per-request, and
+# the file's mtime advanced past the stage's start stamp
+hb = sup["heartbeat"]
+assert hb["file"].endswith("heartbeat") and hb["count"] >= 10, hb
+mtime_ns = os.stat("/tmp/_kmp_sup_smoke/heartbeat").st_mtime_ns
+assert mtime_ns > int(os.environ["SUP_START_NS"]), (
+    mtime_ns, os.environ["SUP_START_NS"])
+print(f"supervision smoke OK: counts={s['counts']}, workers={w}, "
+      f"{len(sup['hangs'])} hang(s), heartbeat={hb['count']} touch(es)")
+EOF7
+
+echo "== [8/11] memory-governor smoke (tiny budget + forced spill + serving) =="
 MEM_DIR=/tmp/_kmp_mem_smoke
 rm -rf "$MEM_DIR"; mkdir -p "$MEM_DIR"
 # an artificially small budget: 25% of the rung-0 estimate for the shape
@@ -335,7 +410,7 @@ assert by_id["oversized"]["reason"] == "insufficient-memory", by_id
 print("serving insufficient-memory OK")
 PYEOF
 
-echo "== [8/10] out-of-core streaming smoke (--scheme external) =="
+echo "== [9/11] out-of-core streaming smoke (--scheme external) =="
 EXT_DIR=/tmp/_kmp_ext_smoke
 rm -rf "$EXT_DIR"; mkdir -p "$EXT_DIR"
 # a budget at 25% of the in-core estimate: the external scheme must
@@ -353,7 +428,7 @@ python scripts/check_report_schema.py "$EXT_DIR/ref.json" || exit 1
 python - <<'PYEOF' || exit 1
 import json
 r = json.load(open("/tmp/_kmp_ext_smoke/ref.json"))
-assert r["schema_version"] == 9, r["schema_version"]
+assert r["schema_version"] == 10, r["schema_version"]
 ext = r["external"]
 # the out-of-core contract: >= 1 streamed level, the fine level NEVER
 # device-resident, and the chunk pipeline actually overlapped
@@ -397,7 +472,7 @@ print(f"external resume OK: resumed from "
       "(identical to the reference)")
 PYEOF
 
-echo "== [9/10] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
+echo "== [10/11] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
 DIST_DIR=/tmp/_kmp_dist_smoke
 rm -rf "$DIST_DIR"; mkdir -p "$DIST_DIR"
 DIST_XLA="--xla_force_host_platform_device_count=8"
@@ -516,11 +591,11 @@ print("rank-scope inert OK: rank=1 plan fired nothing on rank 0")
 EOF8
 
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [10/10] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [11/11] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [10/10] tier-1 pytest (ROADMAP.md) =="
+echo "== [11/11] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -91,8 +91,9 @@ def version_checks(report: Any) -> List[str]:
     section, v5+ additionally the `perf` section, v6+ additionally the
     `memory_budget` section, v7+ additionally the `quality` section,
     v8+ additionally the `dist_resilience` section, v9+ additionally
-    the `external` section; older reports remain valid without them
-    during the transition."""
+    the `external` section, v10+ additionally the `supervision`
+    section; older reports remain valid without them during the
+    transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -108,6 +109,7 @@ def version_checks(report: Any) -> List[str]:
         (7, ("quality",)),
         (8, ("dist_resilience",)),
         (9, ("external",)),
+        (10, ("supervision",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -210,6 +212,15 @@ def _minimal_v8_report() -> dict:
     return r
 
 
+def _minimal_v9_report() -> dict:
+    """A minimal schema_version-9 report (external present, no
+    supervision section) — the ninth transition fixture."""
+    r = _minimal_v8_report()
+    r["schema_version"] = 9
+    r["external"] = {"enabled": False}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
@@ -253,7 +264,8 @@ def _selftest_report(path: str) -> None:
                 {"request_id": "req-1", "verdict": "served", "k": 4,
                  "n": 100, "m": 400, "cut": 12, "imbalance": 0.01,
                  "feasible": True, "cached": False, "gate_valid": True,
-                 "bucket": "256/512/4", "wall_s": 0.5},
+                 "bucket": "256/512/4", "wall_s": 0.5,
+                 "hard_ceiling_s": 30.0},
                 {"request_id": "req-2", "verdict": "rejected",
                  "reason": "queue-full", "k": 4, "n": -1, "m": -1,
                  "cut": -1, "imbalance": 0.0, "feasible": False,
@@ -270,6 +282,18 @@ def _selftest_report(path: str) -> None:
                                      "misses": 1, "hit_rate": 0.0},
                       "hit_rate": 0.0},
             "drained": False,
+        },
+        supervision={
+            "enabled": True,
+            "isolation": "process",
+            "workers": {"spawned": 2, "recycled": 1, "killed": 1,
+                        "crashed": 1, "requests": 10},
+            "hangs": [{"stage": "worker-compute",
+                       "path": "partitioning.coarsening",
+                       "ceiling_s": 2.0, "request": "req-9",
+                       "worker_pid": 1234}],
+            "heartbeat": {"file": "/tmp/hb", "count": 42},
+            "watchdog": {"armed": 3, "fired": 1},
         },
     )
     # exercise the v5 perf producer surface: one pad-waste record and
@@ -318,7 +342,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v9) and validate it plus the embedded v1-v8 transition "
+        "v10) and validate it plus the embedded v1-v9 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -342,20 +366,20 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v9 (progress/compile +
+        # live producer must emit v10 (progress/compile +
         # checkpoint/anytime + serving + perf + memory_budget +
-        # quality + dist_resilience + external)
-        if report.get("schema_version") != 9:
+        # quality + dist_resilience + external + supervision)
+        if report.get("schema_version") != 10:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 9",
+                f"expected 10",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
                     "memory_budget", "quality", "dist_resilience",
-                    "external"):
+                    "external", "supervision"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -387,12 +411,13 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v8 layouts must STILL validate
+        # transition coverage: the v1-v9 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
             ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
             ("v7", _minimal_v7_report()), ("v8", _minimal_v8_report()),
+            ("v9", _minimal_v9_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

@@ -1,0 +1,24 @@
+"""R1 bad fixture: the supervision hook shape done WRONG — the driver
+"proves liveness" by pulling device state to the host lexically inside
+its guarded timer span (the PR-14 watchdog-hook hazard: every barrier
+would host-sync inside the measured region just to touch the heartbeat,
+serializing the async dispatch queue against a liveness file — the
+heartbeat/watchdog hooks are host-side bookkeeping and must never read
+device values).
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def guarded_run_with_inline_liveness_pulls(levels, kernel, labels, hb):
+    with scoped_timer("partition"):
+        for g in levels:
+            labels = kernel(labels, g)
+            alive = int(jnp.sum(labels))  # line 22: R1 int() readback
+            hb.write(np.asarray(labels))  # line 23: R1 device->host copy
+    return labels, alive

@@ -1,0 +1,24 @@
+"""R1 good fixture: the supervision hook shape done RIGHT — the
+watchdog arm/disarm and the heartbeat touch are pure host-side
+bookkeeping (resilience/supervisor.py: stage_guard + heartbeat_touch
+read no device values), and the one legitimate end-of-stage scalar
+readback lives in a helper OUTSIDE the timer span, so the span body
+only makes function calls and the async dispatch queue stays full."""
+import jax.numpy as jnp
+
+from kaminpar_tpu.resilience.supervisor import heartbeat_touch, stage_guard
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def _pull_alive(labels):
+    # the stage boundary's single scalar readback, factored out like
+    # chunkstore.pull_moved — plain module code, not inside a span
+    return int(jnp.sum(labels))
+
+
+def guarded_run_with_hooked_liveness(levels, kernel, labels, ceiling_s):
+    with stage_guard("partition", ceiling_s), scoped_timer("partition"):
+        for g in levels:
+            labels = kernel(labels, g)
+            heartbeat_touch()  # host-side mtime bump, no device read
+    return labels, _pull_alive(labels)

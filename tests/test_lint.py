@@ -38,6 +38,11 @@ BAD_EXPECT = {
     # the PR-13 streaming hook shape: chunk decode + moved-count pulls
     # lexically inside a driver's stream span
     "r1_stream_bad.py": [("R1", 19), ("R1", 21)],
+    # the PR-14 supervision hook shape: liveness "proof" pulls device
+    # state lexically inside the guarded driver span (the watchdog/
+    # heartbeat hooks are host-side bookkeeping and read no device
+    # values)
+    "r1_supervisor_bad.py": [("R1", 22), ("R1", 23)],
     "r2_bad.py": [("R2", 5), ("R2", 9)],
     "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16), ("R3", 21)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
@@ -54,7 +59,7 @@ def test_rule_fires_on_bad_fixture(name):
 
 @pytest.mark.parametrize(
     "name", ["r1_good.py", "r1_quality_good.py", "r1_stream_good.py",
-             "r2_good.py",
+             "r1_supervisor_good.py", "r2_good.py",
              "r3_good.py", "r4_good.py", "r5_good.py", "r6_good.py"]
 )
 def test_rule_silent_on_good_fixture(name):

@@ -1,0 +1,515 @@
+"""Supervision-layer tests: the hard wall-clock watchdog, supervised
+worker execution for serving, liveness heartbeats, and the schema-v10
+``supervision`` report section (docs/robustness.md, supervision
+contract).
+
+The process-isolation tests spawn real worker subprocesses (the
+containment machinery under test must kill a genuinely hung child and
+classify a genuinely dead one), so the graphs are tiny and the chaos
+directives fire *before* the child imports anything heavy.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import resilience, telemetry
+from kaminpar_tpu.resilience import StageHang, WorkerCrash, faults
+from kaminpar_tpu.resilience import deadline as deadline_mod
+from kaminpar_tpu.resilience import supervisor
+from kaminpar_tpu.serving import (
+    PartitionRequest,
+    PartitionService,
+    ServiceConfig,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(resilience.FAULTS_ENV_VAR, raising=False)
+    monkeypatch.delenv(supervisor.ENV_HARD_DEADLINE_S, raising=False)
+    monkeypatch.delenv(supervisor.ENV_HEARTBEAT_FILE, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _gen(n=600, seed=3):
+    return f"gen:rgg2d;n={n};avg_degree=8;seed={seed}"
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(REPO, "scripts", "check_report_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    return checker
+
+
+# ---------------------------------------------------------------------------
+# watchdog + hard-ceiling resolution (host-side units)
+# ---------------------------------------------------------------------------
+
+
+def test_hard_ceiling_resolution(monkeypatch):
+    # no budget, no env: no ceiling — hang containment is opt-in
+    assert supervisor.hard_ceiling(0.0) is None
+    assert supervisor.hard_ceiling(None) is None
+    # derived: max(factor * budget, budget + grace) — the grace floor
+    # keeps a tight anytime budget from arming a self-defeating ceiling
+    assert supervisor.hard_ceiling(0.05, 30.0, 10.0) == pytest.approx(
+        30.05
+    )
+    assert supervisor.hard_ceiling(100.0, 30.0, 10.0) == pytest.approx(
+        1000.0
+    )
+    # factor 0 disables the derived ceiling
+    assert supervisor.hard_ceiling(100.0, 30.0, 0.0) is None
+    # env override wins over everything
+    monkeypatch.setenv(supervisor.ENV_HARD_DEADLINE_S, "7.5")
+    assert supervisor.hard_ceiling(100.0, 30.0, 10.0) == 7.5
+    assert supervisor.hard_ceiling(0.0) == 7.5
+    monkeypatch.setenv(supervisor.ENV_HARD_DEADLINE_S, "0")
+    assert supervisor.env_ceiling() is None
+
+
+def test_watchdog_converts_overrun_to_stage_hang():
+    """An armed stage that blows its ceiling gets a StageHang delivered
+    at the next bytecode boundary, carrying the stage, ceiling, and the
+    stuck timer-scope path."""
+    from kaminpar_tpu.utils import timer
+
+    caught = {}
+
+    def victim():
+        try:
+            with timer.scoped_timer("victim-phase"):
+                with supervisor.stage_guard("unit-stage", 0.3):
+                    t0 = time.time()
+                    while time.time() - t0 < 8.0:
+                        time.sleep(0.01)
+        except StageHang as e:
+            caught["exc"] = e
+
+    t = threading.Thread(target=victim)
+    t.start()
+    t.join(12.0)
+    exc = caught.get("exc")
+    assert exc is not None, "watchdog never fired"
+    assert exc.stage == "unit-stage"
+    assert exc.ceiling_s == 0.3
+    # the hang record carries the scope that was open when it expired
+    hangs = supervisor.hang_log()
+    assert hangs and hangs[-1]["stage"] == "unit-stage"
+    assert "victim-phase" in hangs[-1]["path"]
+    assert supervisor.watchdog_stats()["fired"] >= 1
+    # a stage-hang telemetry event landed in the stream
+    assert any(e.name == "stage-hang" for e in telemetry.events())
+
+
+def test_stage_guard_without_ceiling_is_noop():
+    before = supervisor.watchdog_stats()["armed"]
+    with supervisor.stage_guard("noop", None):
+        pass
+    with supervisor.stage_guard("noop", 0.0):
+        pass
+    assert supervisor.watchdog_stats()["armed"] == before
+
+
+def test_with_fallback_never_swallows_watchdog_verdicts():
+    """An async-delivered StageHang landing inside a guarded primary is
+    a process-level hang verdict, not that site's degradation — it must
+    propagate to the containment boundary."""
+    def primary():
+        raise StageHang("delivered mid-primary")
+
+    with pytest.raises(StageHang):
+        resilience.with_fallback(
+            primary, lambda exc: "swallowed", site="refiner",
+        )
+    # the INJECTED StageHang (the worker-hang chaos site) still follows
+    # the normal injection path
+    rec = resilience.with_fallback(
+        lambda: (_ for _ in ()).throw(
+            StageHang("injected", injected=True)
+        ),
+        lambda exc: "fell-back", site="worker-hang",
+    )
+    assert rec == "fell-back"
+
+
+def test_deadline_budget_emits_watchdog_armed_event():
+    deadline_mod.begin_run(1.0)
+    events = [e for e in telemetry.events() if e.name == "watchdog-armed"]
+    assert events, "no watchdog-armed event for a budgeted run"
+    assert events[0].attrs["ceiling_s"] >= 1.0
+    assert events[0].attrs["budget_s"] == 1.0
+    # an unbudgeted run arms nothing
+    telemetry.reset()
+    telemetry.enable()
+    deadline_mod.begin_run(None)
+    assert not [e for e in telemetry.events()
+                if e.name == "watchdog-armed"]
+
+
+def test_watchdog_armed_event_respects_factor_zero():
+    """ctx.resilience.hard_deadline_factor=0 disables the derived
+    ceiling — the facade arms nothing, so the event must not claim
+    otherwise (it reports what is ACTUALLY armed)."""
+    deadline_mod.begin_run(1.0, 30.0, 0.0)
+    assert not [e for e in telemetry.events()
+                if e.name == "watchdog-armed"]
+    # a custom factor sizes the reported ceiling
+    telemetry.reset()
+    telemetry.enable()
+    deadline_mod.begin_run(100.0, 30.0, 2.0)
+    ev = [e for e in telemetry.events() if e.name == "watchdog-armed"]
+    assert ev and ev[0].attrs["ceiling_s"] == pytest.approx(200.0)
+
+
+def test_injected_hang_without_ceiling_fails_fast():
+    """A worker-hang chaos rule on a request with NO hard ceiling must
+    fail the request immediately (the supervisor could never time it
+    out) instead of hanging the queue forever."""
+    from kaminpar_tpu.resilience.supervisor import WorkerPool
+
+    os.environ[resilience.FAULTS_ENV_VAR] = "worker-hang:nth=1"
+    pool = WorkerPool()
+    try:
+        t0 = time.time()
+        with pytest.raises(StageHang) as ei:
+            pool.run_request("fast-fail", _gen(), None, None,
+                             k=4, epsilon=0.03, seed=1, ceiling_s=None)
+        assert time.time() - t0 < 5.0, "fail-fast path took too long"
+        assert ei.value.injected
+        assert pool.stats["spawned"] == 0  # never even spawned a worker
+    finally:
+        del os.environ[resilience.FAULTS_ENV_VAR]
+        pool.shutdown()
+
+
+def test_worker_fault_sites_registered_and_parseable():
+    assert "worker-hang" in faults.SITES
+    assert "worker-crash" in faults.SITES
+    assert faults.SITES["worker-hang"].exc is StageHang
+    assert faults.SITES["worker-crash"].exc is WorkerCrash
+    rules = faults.parse_plan("worker-hang:nth=2,worker-crash")
+    assert rules[0].site == "worker-hang" and rules[0].nth == 2
+    assert rules[1].site == "worker-crash" and rules[1].nth is None
+
+
+def test_marshalled_errors_reraise_as_their_own_types():
+    """The worker error protocol: a classified in-worker failure is
+    re-raised in the parent as its own type — a ladder-retryable
+    DeviceOOM stays retryable (never a crash verdict), rung exhaustion
+    stays crash-shaped."""
+    from kaminpar_tpu.resilience.errors import DeviceOOM
+    from kaminpar_tpu.resilience.supervisor import _raise_marshalled
+
+    with pytest.raises(DeviceOOM) as ei:
+        _raise_marshalled({
+            "type": "error", "error": "DeviceOOM",
+            "detail": "retryable", "rungs_exhausted": False,
+        })
+    assert ei.value.rungs_exhausted is False
+    with pytest.raises(DeviceOOM) as ei:
+        _raise_marshalled({
+            "type": "error", "error": "DeviceOOM",
+            "detail": "exhausted", "rungs_exhausted": True,
+        })
+    assert ei.value.rungs_exhausted is True
+    with pytest.raises(ValueError):
+        _raise_marshalled({
+            "type": "error", "error": "ValueError", "detail": "bad",
+        })
+    from kaminpar_tpu.io import GraphFormatError
+
+    with pytest.raises(GraphFormatError):
+        _raise_marshalled({
+            "type": "error", "error": "GraphFormatError",
+            "detail": "truncated",
+        })
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_mtime_advances_across_barriers(tmp_path):
+    """The checkpoint-barrier hook touches the heartbeat file: its
+    mtime strictly advances across an inproc run's barriers, so an
+    external supervisor polling stat() sees forward progress."""
+    from kaminpar_tpu.graphs.factories import make_rgg2d
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    hb = tmp_path / "heartbeat"
+    supervisor.set_heartbeat(str(hb))
+    assert hb.exists()
+    m0 = hb.stat().st_mtime_ns
+    count0 = supervisor.heartbeat_state()["count"]
+    g = make_rgg2d(256, avg_degree=8, seed=1)
+    p = KaMinPar("default")
+    p.set_output_level(OutputLevel.QUIET)
+    part = p.set_graph(g).compute_partition(k=2, epsilon=0.05, seed=1)
+    assert len(part) == g.n
+    state = supervisor.heartbeat_state()
+    assert state["count"] > count0, "no barrier ever touched the file"
+    assert hb.stat().st_mtime_ns > m0, "mtime did not advance"
+
+
+# ---------------------------------------------------------------------------
+# supervised worker execution (real subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def _psvc(**cfg):
+    cfg.setdefault("isolation", "process")
+    return PartitionService("default", ServiceConfig(**cfg))
+
+
+def test_worker_hang_verdict_and_queue_keeps_serving(monkeypatch):
+    """An injected child hang (the worker genuinely sleeps forever) is
+    SIGKILLed past its 2nd request's hard ceiling and surfaces as
+    verdict failed/worker-hang; the requests before AND after it are
+    served normally by fresh warm workers."""
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "worker-hang:nth=2")
+    svc = _psvc()
+    try:
+        reqs = [
+            PartitionRequest(_gen(seed=1), k=4, seed=1, request_id="a"),
+            PartitionRequest(_gen(seed=2), k=4, seed=1, request_id="b",
+                             hard_deadline_s=1.0),
+            PartitionRequest(_gen(seed=3), k=4, seed=1, request_id="c"),
+        ]
+        recs = svc.serve(reqs)
+        by_id = {r.request_id: r for r in recs}
+        assert by_id["a"].verdict == "served" and by_id["a"].feasible
+        assert by_id["b"].verdict == "failed"
+        assert by_id["b"].reason == "worker-hang"
+        assert by_id["b"].error == "StageHang"
+        assert by_id["b"].hard_ceiling_s == 1.0
+        assert by_id["c"].verdict == "served" and by_id["c"].feasible
+        sup = svc.supervision_summary()
+        assert sup["enabled"] and sup["isolation"] == "process"
+        assert sup["workers"]["killed"] == 1
+        assert sup["hangs"] and sup["hangs"][0]["request"] == "b"
+        # the serving counts surface the supervision reason
+        counts = svc.summary()["counts"]
+        assert counts["failed"] == 1 and counts["worker-hang"] == 1
+    finally:
+        svc.close()
+
+
+def test_worker_crash_and_same_class_breaker(monkeypatch):
+    """Three injected child SIGKILLs (the native-segfault stand-in) in
+    one request class open the per-class breaker — the 4th same-class
+    request is rejected at admission — while a different class still
+    serves from a fresh worker."""
+    monkeypatch.setenv(
+        resilience.FAULTS_ENV_VAR,
+        "worker-crash:nth=1,worker-crash:nth=2,worker-crash:nth=3",
+    )
+    svc = _psvc()
+    try:
+        crash_reqs = [
+            PartitionRequest(_gen(n=600, seed=s), k=4, seed=1,
+                             request_id=f"x{s}")
+            for s in (1, 2, 3)
+        ]
+        recs = svc.serve(crash_reqs)
+        assert [r.verdict for r in recs] == ["failed"] * 3
+        assert [r.reason for r in recs] == ["worker-crash"] * 3
+        assert all(r.error == "WorkerCrash" for r in recs)
+        # 4th request of the SAME class: rejected at admission
+        rec = svc.submit(
+            PartitionRequest(_gen(n=600, seed=9), k=4, request_id="x9")
+        )
+        assert rec is not None and rec.verdict == "rejected"
+        assert rec.reason == "breaker-open"
+        # a DIFFERENT class still serves (chaos plan exhausted at nth=3)
+        ok = svc.serve([
+            PartitionRequest(_gen(n=2048, seed=1), k=4, seed=1,
+                             request_id="other"),
+        ])
+        assert ok[-1].verdict == "served" and ok[-1].feasible
+        sup = svc.supervision_summary()
+        assert sup["workers"]["crashed"] == 3
+        assert svc.summary()["counts"]["worker-crash"] == 3
+    finally:
+        svc.close()
+
+
+def test_worker_recycled_after_max_requests():
+    """Leak containment: the warm worker is retired after N requests
+    and the next request gets a fresh one (recycle count advances; the
+    service never notices)."""
+    svc = _psvc(worker_max_requests=1)
+    try:
+        recs = svc.serve([
+            PartitionRequest(_gen(n=256, seed=1), k=2, seed=1,
+                             request_id="r1"),
+            PartitionRequest(_gen(n=256, seed=2), k=2, seed=1,
+                             request_id="r2"),
+        ])
+        assert [r.verdict for r in recs] == ["served", "served"]
+        stats = svc.supervision_summary()["workers"]
+        assert stats["recycled"] >= 1
+        assert stats["spawned"] == 2
+        assert stats["requests"] == 2
+    finally:
+        svc.close()
+
+
+def test_object_graph_ships_as_npz_and_spool_is_cleaned():
+    """An in-memory HostGraph request exchanges through the npz spool
+    — and the per-request scratch files (graph AND result) are
+    unlinked once the request completes, so a long-lived service does
+    not leak a CSR copy per request."""
+    from kaminpar_tpu.graphs.factories import make_rgg2d
+
+    svc = _psvc()
+    try:
+        g = make_rgg2d(256, avg_degree=8, seed=1)
+        recs = svc.serve([
+            PartitionRequest(g, k=2, seed=1, request_id="obj"),
+        ])
+        assert recs[0].verdict == "served" and recs[0].feasible
+        spool = svc._pool._spool
+        leftovers = [f for f in os.listdir(spool) if f.endswith(".npz")]
+        assert leftovers == [], leftovers
+    finally:
+        svc.close()
+
+
+def test_retryable_worker_oom_does_not_latch_breaker(monkeypatch):
+    """Satellite contract: a ladder-retryable DeviceOOM inside a worker
+    (governor kill-switched, so it escapes to the isolation boundary)
+    is marshalled back as a classified DeviceOOM re-raise — verdict
+    `failed` with error DeviceOOM, NOT a worker-crash — and never
+    latches the per-class breaker (it indicts the budget, not the
+    class)."""
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "device-oom:always")
+    monkeypatch.setenv("KAMINPAR_TPU_MEM_GOVERNOR", "0")
+    svc = _psvc()
+    try:
+        recs = svc.serve([
+            PartitionRequest(_gen(n=256, seed=1), k=2, seed=1,
+                             request_id="oom"),
+        ])
+        assert recs[0].verdict == "failed"
+        assert recs[0].error == "DeviceOOM"
+        assert recs[0].reason not in ("worker-crash", "worker-hang")
+        # the worker did NOT die — a marshalled error keeps it warm
+        assert svc.supervision_summary()["workers"]["crashed"] == 0
+        # and the class breaker holds no strike
+        assert svc._class_failures == {}
+    finally:
+        svc.close()
+
+
+def test_inproc_clean_batch_bitwise_unchanged():
+    """The supervision refactor must not touch inproc execution: a
+    clean batch served inproc returns bitwise the same partitions as
+    the facade called directly with the same inputs."""
+    from kaminpar_tpu.graphs.factories import generate
+    from kaminpar_tpu.kaminpar import KaMinPar
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    specs = [(_gen(n=600, seed=1), 4), (_gen(n=600, seed=2), 2)]
+    svc = PartitionService(
+        "default", ServiceConfig(keep_partitions=True)
+    )
+    assert svc._pool is None  # inproc default: no worker machinery
+    recs = svc.serve([
+        PartitionRequest(g, k=k, seed=7, request_id=f"q{i}")
+        for i, (g, k) in enumerate(specs)
+    ])
+    assert [r.verdict for r in recs] == ["served", "served"]
+    for rec, (g, k) in zip(recs, specs):
+        p = KaMinPar("default")
+        p.set_output_level(OutputLevel.QUIET)
+        ref = p.set_graph(generate(g)).compute_partition(k=k, seed=7)
+        assert np.array_equal(rec.partition, ref), rec.request_id
+        # no ceiling resolved: nothing supervision-shaped on the record
+        assert rec.hard_ceiling_s is None
+
+
+# ---------------------------------------------------------------------------
+# schema v10 report surface
+# ---------------------------------------------------------------------------
+
+
+def test_supervision_disabled_default_for_single_shot_runs():
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    report = build_run_report()
+    assert report["schema_version"] == 10
+    assert report["supervision"] == {"enabled": False}
+
+
+def test_supervision_section_schema_valid(tmp_path):
+    """A populated supervision section (heartbeat + a recorded hang)
+    validates against the checked-in schema, and the disabled default
+    stays the section for runs that configured nothing."""
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH, build_run_report
+
+    supervisor.set_heartbeat(str(tmp_path / "hb"))
+    supervisor.record_hang({
+        "stage": "worker-compute", "path": "partitioning.coarsening",
+        "ceiling_s": 2.0, "request": "req-1", "worker_pid": 42,
+    })
+    telemetry.annotate(
+        result={"cut": -1, "imbalance": 0.0, "feasible": False}
+    )
+    report = build_run_report()
+    sup = report["supervision"]
+    assert sup["enabled"] is True
+    assert sup["hangs"][0]["stage"] == "worker-compute"
+    assert sup["heartbeat"]["count"] >= 1
+    checker = _load_checker()
+    schema = json.load(open(SCHEMA_PATH))
+    errors = checker.validate_instance(report, schema)
+    errors += checker.version_checks(report)
+    assert errors == [], errors
+
+
+def test_service_config_rejects_unknown_isolation():
+    with pytest.raises(ValueError):
+        PartitionService("default", ServiceConfig(isolation="thread"))
+
+
+def test_batch_spec_parses_supervision_fields(tmp_path):
+    from kaminpar_tpu.serving.batch import load_batch
+
+    spec = {
+        "config": {"isolation": "process", "worker_max_requests": 4,
+                   "hard_deadline_s": 5.0},
+        "requests": [
+            {"graph": _gen(), "k": 4, "id": "a",
+             "hard_deadline_s": 2.0},
+            {"graph": _gen(), "k": 4, "id": "b"},
+        ],
+    }
+    path = tmp_path / "batch.json"
+    path.write_text(json.dumps(spec))
+    requests, config = load_batch(str(path))
+    assert config.isolation == "process"
+    assert config.worker_max_requests == 4
+    assert config.hard_deadline_s == 5.0
+    assert requests[0].hard_deadline_s == 2.0
+    assert requests[1].hard_deadline_s is None
