@@ -85,6 +85,67 @@ def _measure_supervised():
         svc.close()
 
 
+#: Dynamic-repartitioning keys (round 15, kaminpar_tpu/dynamic/):
+#: warm-vs-cold wall speedup and the max warm-vs-cold-twin cut drift
+#: over a short delta chain on the medium bench graph.  Same
+#: never-vanish contract (null = skipped/failed, ABSENCE = silent
+#: coverage loss, gated by bench_trend from r06 on).
+DYNAMIC_KEYS = ("dynamic_warm_speedup", "dynamic_cut_drift")
+
+
+def dynamic_keys(speedup=None, drift=None) -> dict:
+    """The BENCH line's dynamic-repartitioning keys; always present,
+    null when the dynamic measurement was skipped or failed."""
+    return {"dynamic_warm_speedup": speedup, "dynamic_cut_drift": drift}
+
+
+def _measure_dynamic():
+    """A 4-step ~1% churn delta chain on the medium bench graph: per
+    step, a warm-started v-cycle repartition AND its cold twin from
+    scratch.  Returns (warm_speedup, cut_drift): mean cold wall / mean
+    warm wall, and the max fractional cut gap warm-vs-cold-twin —
+    the dynamic acceptance pair (warm must be faster, and within the
+    diff gate of the cold run it replaces)."""
+    import time
+
+    from kaminpar_tpu.dynamic import GraphSession, synth_chain
+    from kaminpar_tpu.dynamic.repartition import repartition
+    from kaminpar_tpu.graphs.factories import generate
+    from kaminpar_tpu.kaminpar import KaMinPar, context_from_preset
+
+    graph = generate(f"rmat;n={MED_N};m={MED_M};seed={MED_SEED}")
+    batches = synth_chain(graph, steps=4, seed=41, edge_churn=0.01)
+    ctx = context_from_preset("default")
+    session = GraphSession("bench", graph, k=BENCH_K)
+    solver = KaMinPar(ctx)
+    solver.set_graph(session.graph)
+    part = solver.compute_partition(k=BENCH_K, epsilon=BENCH_EPS, seed=1)
+    m0 = solver.result_metrics(session.graph, part)
+    session.commit_partition(part, int(m0["cut"]))
+
+    warm_walls, cold_walls, drifts = [], [], []
+    for i, batch in enumerate(batches):
+        session.apply(batch)
+        out = repartition(session, ctx, k=BENCH_K, epsilon=BENCH_EPS,
+                          seed=1)
+        warm_walls.append(
+            out.warm_wall_s if out.warm_wall_s is not None
+            else out.wall_s)
+        # the cold twin: the per-step from-scratch run warm replaced
+        cold_solver = KaMinPar(context_from_preset("default"))
+        cold_solver.set_graph(session.graph)
+        t0 = time.perf_counter()
+        cold_part = cold_solver.compute_partition(
+            k=BENCH_K, epsilon=BENCH_EPS, seed=1)
+        cold_walls.append(time.perf_counter() - t0)
+        cold_cut = int(cold_solver.result_metrics(
+            session.graph, cold_part)["cut"])
+        drifts.append(abs(out.cut - cold_cut) / max(cold_cut, 1))
+    speedup = (sum(cold_walls) / len(cold_walls)) / max(
+        sum(warm_walls) / len(warm_walls), 1e-9)
+    return round(speedup, 2), round(max(drifts), 4)
+
+
 def quality_keys(report) -> dict:
     """The BENCH line's quality-attribution keys from an embedded run
     report (telemetry/quality.py totals); every key present, null when
@@ -581,6 +642,19 @@ def _bench_line() -> dict:
             print(f"bench: supervised measurement failed: {e}",
                   file=sys.stderr)
     line.update(supervised_key(sup_p95))
+    # dynamic-repartitioning coverage (round 15): warm-vs-cold speedup
+    # and cut drift over a short delta chain — always-present keys
+    # (null = skipped/failed), same r05-class presence contract
+    dyn_speedup = dyn_drift = None
+    if os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1":
+        try:
+            dyn_speedup, dyn_drift = _measure_dynamic()
+        except Exception as e:
+            import sys
+
+            print(f"bench: dynamic measurement failed: {e}",
+                  file=sys.stderr)
+    line.update(dynamic_keys(dyn_speedup, dyn_drift))
     if best_report is not None:
         # rating-engine choices of the best run (ops/rating.py
         # selection, from the embedded report's `rating` section):

@@ -399,6 +399,16 @@ def full_graph_digest(graph) -> str:
 
     import numpy as np
 
+    # dynamic graph sessions (dynamic/session.py) stamp their evolving
+    # delta-chain digest onto the graph object: the chain already covers
+    # the base adjacency (hashed once at register) plus every applied
+    # DeltaBatch, so a mutate costs O(delta), never a fresh O(m) sweep.
+    # The "dyn:" domain prefix keeps chain digests disjoint from the raw
+    # hex digests below — a (possibly poisoned) chain hash can never
+    # alias the exact digest of a differing plain graph.
+    chain = getattr(graph, "_chain_digest", None)
+    if chain is not None:
+        return str(chain)
     h = hashlib.sha256()
 
     def _arr(a) -> None:

@@ -193,6 +193,25 @@ def build_parser() -> argparse.ArgumentParser:
         "host-only) — never RESOURCE_EXHAUSTED (docs/robustness.md)",
     )
     p.add_argument(
+        "--delta-batch", default=None, metavar="DELTAS.json",
+        help="dynamic repartitioning (kaminpar_tpu/dynamic/): apply the "
+        "JSON delta chain (edge inserts/deletes, vertex add/remove, "
+        "weight updates) to the positional graph step by step; each "
+        "step gets a warm-started v-cycle repartition (or a cold run "
+        "when the drift estimator says warm-starting would lose) and "
+        "the PR-4 diff cut gate asserts stability across deltas.  "
+        "Per-step DYNAMIC lines on stdout, the `dynamic` report "
+        "section in --report-json; works with --checkpoint-dir/"
+        "--resume (mid-chain kill-and-resume restores the session "
+        "cut-identically; docs/robustness.md)",
+    )
+    p.add_argument(
+        "--dynamic-replicas", type=int, default=None, metavar="G",
+        help="delta-batch mode: race the warm v-cycle against G-1 cold "
+        "replicas per step and keep the better cut (PASCO-style "
+        "replicated repartitioning; default 1 = drift decision only)",
+    )
+    p.add_argument(
         "--serve-batch", default=None, metavar="BATCH.json",
         help="serve/batch mode (partitioning-as-a-service): run every "
         "request in the JSON batch spec through the admission-"
@@ -359,6 +378,8 @@ def make_context(args: argparse.Namespace) -> Context:
         ctx.external.chunk_edges = args.external_chunk_edges
     if args.external_spill_dir is not None:
         ctx.external.spill_dir = args.external_spill_dir
+    if getattr(args, "dynamic_replicas", None) is not None:
+        ctx.dynamic.replicas = int(args.dynamic_replicas)
     if args.seed is not None:  # -C config may set the seed; flag wins
         ctx.seed = args.seed
     return ctx
@@ -380,6 +401,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("error: need -k or -B/--max-block-weights",
                   file=sys.stderr)
             return 1
+    if args.delta_batch is not None:
+        if args.serve_batch is not None:
+            print("error: --delta-batch and --serve-batch are mutually "
+                  "exclusive (session requests inside a batch spec "
+                  "cover the serve-mode story)", file=sys.stderr)
+            return 2
+        if args.k is None:
+            print("error: --delta-batch needs -k", file=sys.stderr)
+            return 2
+        if args.node_ordering != "natural":
+            print("error: --delta-batch needs natural node ordering "
+                  "(delta vertex ids refer to file order; a "
+                  "permutation would silently remap them)",
+                  file=sys.stderr)
+            return 2
+        if args.output_remapping:
+            print("error: --output-remapping is not supported with "
+                  "--delta-batch (vertex add/remove deltas change the "
+                  "node set, so no input-file-indexed remapping "
+                  "exists; the partition output is indexed by the "
+                  "FINAL node set)", file=sys.stderr)
+            return 2
     if args.resume and not args.checkpoint_dir:
         print("error: --resume requires --checkpoint-dir", file=sys.stderr)
         return 2
@@ -497,6 +540,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         base = os.path.basename(args.graph)
         ctx.debug.graph_name = os.path.splitext(base)[0] or "graph"
 
+    if args.delta_batch is not None:
+        return _run_delta_chain(args, ctx, graph, io_s)
+
     partitioner = KaMinPar(ctx)
     if args.quiet:
         # instance-scoped: compute_partition applies and restores it
@@ -573,6 +619,85 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.output_block_sizes:
         io_mod.write_block_sizes(
             args.output_block_sizes, partition, ctx.partition.k
+        )
+    return rc
+
+
+def _run_delta_chain(args, ctx, graph, io_s: float) -> int:
+    """``--delta-batch`` mode: drive the delta chain through the
+    dynamic session driver (register -> per-delta mutate + warm/cold
+    repartition), print per-step DYNAMIC lines, annotate the `dynamic`
+    report section, and write the FINAL partition via the ordinary
+    output flags."""
+    from . import telemetry
+    from .dynamic import load_delta_file, run_chain
+    from .io.errors import GraphFormatError
+
+    try:
+        batches = load_delta_file(args.delta_batch)
+    except GraphFormatError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    def _cb(step: int, row: dict) -> None:
+        if not args.quiet:
+            print(
+                "DYNAMIC step={} mode={} cut={} drift={} stable={} "
+                "gate_valid={} wall={:.3f}s".format(
+                    step, row.get("mode"), row.get("cut"),
+                    row.get("drift"), row.get("stable"),
+                    row.get("gate_valid"), row.get("wall_s", 0.0),
+                )
+            )
+
+    t0 = time.perf_counter()
+    try:
+        partition, section = run_chain(
+            graph, batches, ctx,
+            k=int(args.k),
+            # None keeps a -C config's epsilon, like the single-shot path
+            epsilon=args.epsilon,
+            seed=args.seed, quiet=bool(args.quiet), step_cb=_cb,
+        )
+    except KeyboardInterrupt:
+        return _emergency_interrupt_exit(args, t0)
+    except GraphFormatError as e:
+        # a malformed delta (or a non-CSR input) is a data problem,
+        # exactly like a malformed graph file in single-shot mode
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    wall = time.perf_counter() - t0
+
+    # the stream belongs to the LAST step's run; the chain-level
+    # sections ride on it (the serving layer's annotate-after idiom)
+    telemetry.annotate(dynamic=section)
+    if not args.quiet:
+        counts = section.get("counts", {})
+        print(
+            "DYNAMIC-CHAIN steps={} warm={} cold={} replica={} "
+            "in_place={} rebuilds={} final_cut={} wall={:.3f}s".format(
+                len(section.get("decisions", [])),
+                counts.get("warm", 0), counts.get("cold", 0),
+                counts.get("replica", 0), counts.get("in_place", 0),
+                counts.get("rebuilds", 0),
+                (section.get("cut_trajectory") or [None])[-1], wall,
+            )
+        )
+    rc = telemetry.export_cli_outputs(
+        args,
+        extra_run={"io_seconds": round(io_s, 3),
+                   "delta_batch": args.delta_batch,
+                   "delta_steps": len(batches),
+                   "partition_seconds": round(wall, 3)},
+        quiet=args.quiet,
+    )
+    if args.output:
+        io_mod.write_partition(args.output, partition)
+    if args.output_block_sizes:
+        # args.k, not ctx.partition.k: a resumed chain may never run
+        # ctx.partition.setup in this process (register fast-forwarded)
+        io_mod.write_block_sizes(
+            args.output_block_sizes, partition, int(args.k)
         )
     return rc
 

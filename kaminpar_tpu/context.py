@@ -487,6 +487,45 @@ class ExternalContext:
 
 
 @dataclass
+class DynamicContext:
+    """Dynamic repartitioning policy (kaminpar_tpu/dynamic/,
+    docs/robustness.md "Dynamic sessions"): graphs that mutate between
+    requests get a warm-started v-cycle repartition over the previous
+    partition instead of a cold run.  The drift estimator (delta edge
+    mass touching the cut / total edge mass, plus the post-patch balance
+    violation) picks warm vs cold per request; PASCO-style replicas race
+    warm against cold and keep the better cut (arXiv 2412.13592's
+    replicated-coarsening knob as the escape hatch when drift makes
+    warm-starting worse than restarting).
+
+    INCLUDED in the ctx fingerprint (unlike the resilience subtree):
+    these knobs change the produced partition, so they must fork
+    result-cache keys and checkpoints."""
+
+    #: Accumulated drift above this runs a cold repartition instead of
+    #: the warm v-cycle (drift = cut-touching delta mass fraction +
+    #: balance violation after the patch).
+    drift_threshold: float = 0.25
+    #: Replicated repartitioning: 1 = the drift decision alone; G >= 2
+    #: races the warm v-cycle against (G - 1) cold replicas (seeds
+    #: varied per replica) and keeps the best feasible cut.
+    replicas: int = 1
+    #: Restricted-coarsening depth of the warm v-cycle (0 = a pure
+    #: refinement pass over the previous partition at the fine level —
+    #: the fine-level cluster LP dominates cold runs, so bounding the
+    #: warm hierarchy is what buys the warm-vs-cold speedup; raise for
+    #: higher-drift workloads).
+    warm_levels: int = 0
+    #: The PR-4 telemetry.diff cut gate applied across a delta: a warm
+    #: result whose cut regressed more than this fraction vs the
+    #: pre-delta cut escalates to a cold run (and keeps the better).
+    cut_gate_threshold: float = 0.10
+    #: Whether a gate-violating warm result may escalate to a cold
+    #: retry at all (tests pin the no-escalation path).
+    cold_fallback: bool = True
+
+
+@dataclass
 class DebugContext:
     """kaminpar.h:484-496."""
 
@@ -545,6 +584,7 @@ class Context:
     )
     resilience: ResilienceContext = field(default_factory=ResilienceContext)
     external: ExternalContext = field(default_factory=ExternalContext)
+    dynamic: DynamicContext = field(default_factory=DynamicContext)
     debug: DebugContext = field(default_factory=DebugContext)
     seed: int = 0
 

@@ -138,6 +138,21 @@ def build_parser() -> argparse.ArgumentParser:
         "fails with a pointer instead of 'unrecognized argument'",
     )
     p.add_argument(
+        "--delta-batch", default=None, metavar="DELTAS.json",
+        help="dynamic repartitioning (delta chains + warm-started "
+        "v-cycle) is served by the shm CLI (python -m kaminpar_tpu "
+        "GRAPH -k K --delta-batch DELTAS.json); session graphs are "
+        "host-resident CSRs the dist driver does not mutate — "
+        "argument-compat flag, fails with a pointer",
+    )
+    p.add_argument(
+        "--dynamic-replicas", type=int, default=None, metavar="G",
+        help="the warm-vs-cold replica race belongs to the shm dynamic "
+        "CLI (python -m kaminpar_tpu GRAPH -k K --delta-batch ... "
+        "--dynamic-replicas G); argument-compat flag, fails with a "
+        "pointer",
+    )
+    p.add_argument(
         "--serve-isolation", default=None,
         choices=["inproc", "process"],
         help="supervised worker execution belongs to the shm serving "
@@ -164,6 +179,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             "error: --scheme external runs on the shm pipeline — use "
             "`python -m kaminpar_tpu GRAPH -k K --scheme external` "
             "(docs/performance.md, out-of-core streaming)",
+            file=sys.stderr,
+        )
+        return 2
+    if args.delta_batch is not None or args.dynamic_replicas is not None:
+        print(
+            "error: dynamic repartitioning runs on the shm pipeline — "
+            "use `python -m kaminpar_tpu GRAPH -k K --delta-batch "
+            "DELTAS.json [--dynamic-replicas G]` (docs/robustness.md, "
+            "dynamic sessions)",
             file=sys.stderr,
         )
         return 2

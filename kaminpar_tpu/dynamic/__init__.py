@@ -1,0 +1,34 @@
+"""Dynamic repartitioning: graph sessions, delta ingestion, and
+warm-started v-cycle repartition (ROADMAP item 5(a)).
+
+Three modules:
+
+  * :mod:`.session` — :class:`GraphSession` (mutable host graph + last
+    gate-valid partition + the evolving base-fingerprint/delta-chain
+    identity) and :class:`DeltaBatch` (validated edge/vertex/weight
+    mutations applied through the padded-bucket-aware CSR patch path);
+  * :mod:`.repartition` — the warm/cold/replica policy: neighbor-
+    majority seeding of new vertices, the drift estimator, the
+    warm-started v-cycle pass (partitioning/vcycle.py plumbing,
+    checkpoint barriers included), the PASCO-style replica race, and
+    the PR-4 ``telemetry.diff`` cut gate across each delta;
+  * :mod:`.driver` — the ``--delta-batch`` chain driver with
+    kill-and-resume chain state, synthetic churn batches, and the
+    schema-v11 ``dynamic`` report section shared with the serving
+    layer's session-scoped request kinds (serving/service.py
+    ``register`` / ``mutate`` / ``repartition``).
+"""
+
+from .repartition import (  # noqa: F401
+    RepartitionOutcome,
+    repartition,
+    seed_new_vertices,
+)
+from .session import DeltaBatch, GraphSession, chain_digest  # noqa: F401
+from .driver import (  # noqa: F401
+    load_delta_file,
+    random_delta_batch,
+    run_chain,
+    summarize,
+    synth_chain,
+)

@@ -48,6 +48,12 @@ class KaMinPar:
         # set by compute_partition when a run wound down early under a
         # deadline/preemption (resilience/deadline.py); None otherwise
         self.last_anytime: Optional[dict] = None
+        # warm-start state (dynamic repartitioning, dynamic/): a valid
+        # full-k partition that seeds the v-cycle scheme instead of the
+        # initial deep run; one-shot — consumed by the next
+        # compute_partition call and cleared afterwards
+        self._warm_part: Optional[np.ndarray] = None
+        self._warm_levels: Optional[int] = None
 
     # -- graph ingestion (KaMinPar::borrow_and_mutate_graph / copy_graph) --
     def set_graph(self, graph, validate: bool = False) -> "KaMinPar":
@@ -103,6 +109,22 @@ class KaMinPar:
 
     def graph(self) -> Optional[HostGraph]:
         return self._graph
+
+    def set_initial_partition(
+        self, partition, max_levels: Optional[int] = None
+    ) -> "KaMinPar":
+        """Warm-start the next ``compute_partition`` call (v-cycle
+        scheme only): ``partition`` must be a valid full-k labeling of
+        the current graph; the v-cycle driver refines it instead of
+        running the initial deep multilevel pass.  ``max_levels`` bounds
+        the warm cycle's restricted-coarsening depth (0 = refinement
+        only).  One-shot: cleared when the call returns."""
+        self._warm_part = (
+            None if partition is None
+            else np.asarray(partition, dtype=np.int32)
+        )
+        self._warm_levels = max_levels
+        return self
 
     # -- main entry point (KaMinPar::compute_partition, kaminpar.cc:297) --
     def compute_partition(
@@ -295,6 +317,13 @@ class KaMinPar:
                 ):
                     core, perm, _ = remove_isolated_nodes(graph)
                     core_ctx = ctx  # weights already set up from the full graph
+                    if self._warm_part is not None:
+                        # warm seed follows the core permutation (the
+                        # first core.n permuted slots are the connected
+                        # nodes the core run partitions)
+                        self._warm_part = self._warm_part[
+                            perm.new_to_old[: core.n]
+                        ]
                     part_core = self._partition_core_governed(core, core_ctx)
                     partition = self._reintegrate_isolated(
                         graph, core, perm, num_isolated, part_core
@@ -305,6 +334,11 @@ class KaMinPar:
                     partition = self._partition_core_governed(graph, ctx)
         finally:
             set_output_level(prior_level)
+            # warm-start state is one-shot: a later call on this
+            # instance (different graph, different k) must never
+            # silently inherit it
+            self._warm_part = None
+            self._warm_levels = None
             if not owns_stream:
                 ckpt_mod.unsuspend()
 
@@ -442,7 +476,11 @@ class KaMinPar:
         elif mode == PartitioningMode.VCYCLE:
             from .partitioning.vcycle import VcycleDeepMultilevelPartitioner
 
-            return VcycleDeepMultilevelPartitioner(ctx).partition(graph)
+            return VcycleDeepMultilevelPartitioner(
+                ctx,
+                initial_partition=self._warm_part,
+                max_levels=self._warm_levels,
+            ).partition(graph)
         elif mode == PartitioningMode.EXTERNAL:
             from .external.driver import ExternalPartitioner
 

@@ -28,8 +28,18 @@ from .refiner import RefinerPipeline
 
 
 class VcycleDeepMultilevelPartitioner:
-    def __init__(self, ctx: Context):
+    def __init__(self, ctx: Context, initial_partition=None,
+                 max_levels: int | None = None):
+        """``initial_partition`` warm-starts the cycles: the initial
+        deep multilevel run is skipped and the given (valid, full-k)
+        partition seeds cycle 0 — the dynamic-repartitioning driver's
+        entry (dynamic/repartition.py).  ``max_levels`` bounds the
+        restricted-coarsening depth per cycle (0 = a pure refinement
+        pass at the fine level); None = coarsen to the usual threshold.
+        """
         self.ctx = ctx
+        self.initial_partition = initial_partition
+        self.max_levels = max_levels
 
     def partition(self, graph: HostGraph) -> np.ndarray:
         ctx = self.ctx
@@ -56,6 +66,21 @@ class VcycleDeepMultilevelPartitioner:
                 level=resume.get("level"),
             )
 
+        if part is None and self.initial_partition is not None:
+            # warm start: the previous (session) partition replaces the
+            # initial deep run; a checkpoint resume above still wins —
+            # kill-and-resume must re-enter the recorded cycle, not
+            # restart from the warm seed
+            part = np.asarray(self.initial_partition, dtype=np.int32)
+            if part.shape != (graph.n,):
+                raise ValueError(
+                    f"warm-start partition shape {part.shape} != "
+                    f"({graph.n},)")
+            if len(part) and (int(part.min()) < 0
+                              or int(part.max()) >= k):
+                raise ValueError(
+                    "warm-start partition labels out of range "
+                    f"[0, {k})")
         if part is None:
             # initial partition via one full deep multilevel run
             deep_ctx = ctx.copy()
@@ -147,7 +172,9 @@ class VcycleDeepMultilevelPartitioner:
         current_n = graph.n
         threshold = max(2 * ctx.coarsening.contraction_limit, 2)
         level = 0
-        while current_n > threshold:
+        while current_n > threshold and (
+            self.max_levels is None or level < self.max_levels
+        ):
             max_cw = max(
                 1,
                 ctx.coarsening.max_cluster_weight(

@@ -33,6 +33,7 @@ from .errors import (  # noqa: F401
     CheckpointWriteFailed,
     CollectiveTimeout,
     DegradationError,
+    DeltaApplyFailed,
     DeviceOOM,
     NativeUnavailable,
     PlanBlowup,

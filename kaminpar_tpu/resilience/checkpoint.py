@@ -266,6 +266,13 @@ def graph_fingerprint(graph) -> str:
     and boundary samples of the adjacency — O(1)-ish even for TeraPart
     inputs (never a full-graph hash), but enough that resuming against a
     different graph is practically impossible to miss."""
+    # dynamic graph sessions (dynamic/session.py) stamp an evolving
+    # fingerprint (base fingerprint + delta-chain hash) onto the graph
+    # object so checkpoints of a mutated graph key on the exact chain
+    # step — the sampling hash below could miss interior-only deltas
+    session_fp = getattr(graph, "_session_fp", None)
+    if session_fp is not None:
+        return str(session_fp)
     h = hashlib.sha256()
     n, m = int(graph.n), int(graph.m)
     h.update(f"n={n};m={m};".encode())

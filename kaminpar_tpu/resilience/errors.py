@@ -129,6 +129,17 @@ class CacheDegraded(DegradationError):
     breaker_relevant = False
 
 
+class DeltaApplyFailed(DegradationError):
+    """The in-place CSR delta-apply of a dynamic graph session failed —
+    today only via the `dynamic-apply` injection site; a real failure
+    class would be a patched bucket disagreeing with the device arrays.
+    Fallback: the session rebuilds the CSR and re-uploads into a fresh
+    bucket (the bucket-crossing path) — strictly more work, never a
+    wrong graph, so the breaker ignores it."""
+
+    breaker_relevant = False
+
+
 class RankDivergence(DegradationError):
     """The cross-rank divergence sentinel fired: at a dist pipeline
     barrier the ranks disagreed on the stage id, the memory-ladder rung,

@@ -44,6 +44,7 @@ from .errors import (
     CheckpointWriteFailed,
     CollectiveTimeout,
     DegradationError,
+    DeltaApplyFailed,
     DeviceOOM,
     NativeUnavailable,
     PlanBlowup,
@@ -165,6 +166,15 @@ _register(SiteSpec(
     "supervised worker crash containment (resilience/supervisor.py; "
     "chaos: the child worker exits via SIGKILL — the native-segfault "
     "stand-in)",
+))
+_register(SiteSpec(
+    "dynamic-apply", DeltaApplyFailed,
+    "full CSR rebuild + re-upload into a fresh bucket for that delta "
+    "(the bucket-crossing path; strictly more work, never a wrong "
+    "graph)",
+    "in-place CSR delta application of a dynamic graph session "
+    "(dynamic/session.py; deltas that fit the padded bucket's slack "
+    "reuse the compiled executables)",
 ))
 _register(SiteSpec(
     "rank-divergence", RankDivergence,

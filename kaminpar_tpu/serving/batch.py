@@ -13,6 +13,15 @@ or ``{"config": {...}, "requests": [...]}``.  Each request object::
      "seed": 1,               # optional
      "id": "my-request"}      # optional stable id
 
+Session-scoped request kinds (dynamic repartitioning,
+kaminpar_tpu/dynamic/; inproc isolation only)::
+
+    {"kind": "register",    "session": "s1", "graph": ..., "k": 8}
+    {"kind": "mutate",      "session": "s1",
+     "delta": {"edge_inserts": [[0, 5]], "edge_deletes": [[1, 2]]}}
+    {"kind": "repartition", "session": "s1"}   # k defaults to the
+                                               # session's k
+
 ``config`` keys map onto :class:`~kaminpar_tpu.serving.service.
 ServiceConfig` fields (``max_queue_depth``, ``max_queued_cost``,
 ``max_request_cost``, ``result_cache_entries``, ``result_cache_bytes``,
@@ -88,15 +97,44 @@ def load_batch(path: str) -> Tuple[List[PartitionRequest], ServiceConfig]:
 
     requests: List[PartitionRequest] = []
     for i, r in enumerate(raw_requests):
-        if not isinstance(r, dict) or "graph" not in r or "k" not in r:
+        kind = (r or {}).get("kind", "partition") \
+            if isinstance(r, dict) else "partition"
+        session_kind = kind in ("register", "mutate", "repartition")
+        if not isinstance(r, dict) or (
+            not session_kind and ("graph" not in r or "k" not in r)
+        ):
             raise BatchSpecError(
                 f"{path}: request #{i} needs at least 'graph' and 'k'"
             )
+        if session_kind and not r.get("session"):
+            raise BatchSpecError(
+                f"{path}: request #{i} (kind={kind!r}) needs 'session'"
+            )
+        if kind == "register" and ("graph" not in r or "k" not in r):
+            raise BatchSpecError(
+                f"{path}: request #{i} (register) needs 'graph' and 'k'"
+            )
+        if kind == "mutate" and not isinstance(r.get("delta"), dict):
+            raise BatchSpecError(
+                f"{path}: request #{i} (mutate) needs a 'delta' object"
+            )
         try:
             requests.append(PartitionRequest(
-                graph=r["graph"],
-                k=int(r["k"]),
-                epsilon=float(r.get("epsilon", 0.03)),
+                graph=r.get("graph", ""),
+                k=int(r.get("k", 0) or 0),
+                kind=str(kind),
+                session=str(r.get("session", "") or ""),
+                delta=(r.get("delta")
+                       if isinstance(r.get("delta"), dict) else None),
+                # session kinds: an ABSENT epsilon means "the session's
+                # contract" (register: the ctx default; repartition:
+                # whatever the session was registered with), not the
+                # stateless wire default
+                epsilon=(
+                    float(r["epsilon"])
+                    if r.get("epsilon") is not None
+                    else (None if session_kind else 0.03)
+                ),
                 deadline_s=(
                     float(r["deadline_s"])
                     if r.get("deadline_s") is not None else None

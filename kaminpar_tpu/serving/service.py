@@ -75,7 +75,22 @@ class PartitionRequest:
 
     graph: Any
     k: int
-    epsilon: float = 0.03
+    #: balance tolerance; for SESSION kinds, None means "the session's
+    #: contract" (register: the service ctx default; repartition: the
+    #: epsilon the session was registered with)
+    epsilon: Optional[float] = 0.03
+    #: request kind (dynamic repartitioning, kaminpar_tpu/dynamic/):
+    #: "partition" (the stateless default), or the session-scoped kinds
+    #: "register" (create a session from ``graph`` + compute its
+    #: initial partition), "mutate" (apply ``delta`` to ``session``),
+    #: "repartition" (warm/cold repartition of ``session``; ``graph``
+    #: is ignored for mutate/repartition)
+    kind: str = "partition"
+    #: session id for the session-scoped kinds
+    session: str = ""
+    #: DeltaBatch wire dict for kind="mutate" (parsed inside the
+    #: isolation boundary — a malformed delta fails the request)
+    delta: Optional[dict] = None
     deadline_s: Optional[float] = None  # per-request anytime budget
     #: explicit per-request HARD wall-clock ceiling (supervision
     #: contract): overrides the service-level hard_deadline_s and the
@@ -236,6 +251,14 @@ class PartitionService:
         self._buckets = caching.BucketTracker()
         # per-request-class (executable bucket) crash counters
         self._class_failures: Dict[str, int] = {}
+        # dynamic graph sessions (kaminpar_tpu/dynamic/): id -> live
+        # GraphSession, plus the decision rows for the report's
+        # `dynamic` section.  Session requests run inproc only — the
+        # supervised worker exchange ships graphs by value and cannot
+        # carry mutable session state (documented; admission rejects
+        # with `session-isolation` under process isolation).
+        self._sessions: Dict[str, Any] = {}
+        self._dynamic_decisions: List[dict] = []
         self._drained = False
         # serving latency metrics (telemetry/perf.py Histogram): one
         # streaming histogram per request phase plus a per-class (bucket)
@@ -298,6 +321,19 @@ class PartitionService:
                 ))
             return float(memory_mod.estimate_run_bytes(n, m, k))
 
+        kind = getattr(req, "kind", "partition")
+        if kind in ("mutate", "repartition"):
+            # session kinds are sized from the LIVE session graph (the
+            # one state admission can know without loading anything); a
+            # mutate is host-side CSR work — priced nominally so the
+            # cost cap still counts it
+            sess = self._sessions.get(req.session or "")
+            if sess is None:
+                return DEFAULT_COST, -1, -1
+            n, m = int(sess.graph.n), int(sess.graph.m)
+            if kind == "mutate":
+                return float(1 << 20), n, m
+            return price(n, m), n, m
         g = req.graph
         if hasattr(g, "n") and hasattr(g, "m"):
             n, m = int(g.n), int(g.m)
@@ -345,7 +381,28 @@ class PartitionService:
             return "fault-injected"
         if deadline_mod.draining():
             return "draining"
-        if req.k is None or int(req.k) < 1:
+        kind = getattr(req, "kind", "partition")
+        if kind not in ("partition", "register", "mutate", "repartition"):
+            return "invalid-parameters"
+        if kind != "partition":
+            if self._pool is not None:
+                # the worker exchange ships graphs by value; mutable
+                # session state cannot round-trip it (docs/robustness.md
+                # "Dynamic sessions") — refuse structurally instead of
+                # silently running outside the supervision boundary
+                return "session-isolation"
+            if not req.session:
+                return "invalid-parameters"
+            if kind == "register" and req.session in self._sessions:
+                return "duplicate-session"
+            if kind in ("mutate", "repartition") \
+                    and req.session not in self._sessions:
+                return "unknown-session"
+            if kind == "mutate" and not isinstance(req.delta, dict):
+                return "invalid-parameters"
+        if kind in ("partition", "register") and (
+            req.k is None or int(req.k) < 1
+        ):
             return "invalid-parameters"
         if req.request_id in self._queued_cost:
             # a pending duplicate would corrupt the cost/FIFO maps keyed
@@ -470,7 +527,14 @@ class PartitionService:
         (admission rejections included, in order)."""
         start = len(self._records)
         for req in requests:
-            if self._queue and self._would_overflow(req):
+            # session-kind requests depend on earlier requests having
+            # EXECUTED (a mutate needs its register/mutate predecessors
+            # committed, and priority sorting must not reorder a
+            # session's chain) — drain the queue before admitting one
+            if self._queue and (
+                self._would_overflow(req)
+                or getattr(req, "kind", "partition") != "partition"
+            ):
                 self.run_pending()
             self.submit(req)
         self.run_pending()
@@ -519,7 +583,8 @@ class PartitionService:
         # stamp the partition target so the ctx fingerprint (and with it
         # the result-cache key) covers (k, eps) before setup runs
         ctx.partition.k = int(req.k)
-        ctx.partition.epsilon = float(req.epsilon)
+        if req.epsilon is not None:  # None = keep the ctx default
+            ctx.partition.epsilon = float(req.epsilon)
         return ctx
 
     def _hard_ceiling(self, req: PartitionRequest) -> Optional[float]:
@@ -560,6 +625,244 @@ class PartitionService:
             site="serving-cache", where=req.request_id,
         )
 
+    def _note_failure(self, rec: RequestRecord, exc: BaseException,
+                      cls: str, cls_submit: str) -> None:
+        """THE failure bookkeeping of the isolation boundary — shared
+        by the stateless (:meth:`_execute`) and session-kind
+        (:meth:`_execute_session`) paths so the verdict/reason
+        taxonomy, the breaker exemptions, and the telemetry surface
+        can never drift apart: classify, stamp the reason
+        (worker-crash / worker-hang|stage-hang / malformed-input /
+        exception), advance the per-class breaker for crash-shaped
+        failures only."""
+        err = res_errors.classify(exc, site="")
+        rec.verdict = "failed"
+        rec.error = type(err if err is not None else exc).__name__
+        rec.detail = str(exc)[:300]
+        # supervision verdicts (resilience/supervisor.py) carry their
+        # own reason taxonomy: a SIGKILLed hung worker reads
+        # `worker-hang`, a dead worker `worker-crash`, and an
+        # in-process watchdog overrun `stage-hang` — everything else
+        # keeps the malformed-input/exception split.  (err.site is NOT
+        # trusted for hangs: a hang landing inside a guarded primary
+        # may carry that site's stamp.)
+        if isinstance(err, res_errors.WorkerCrash):
+            rec.reason = "worker-crash"
+        elif isinstance(err, res_errors.StageHang):
+            rec.reason = (
+                "worker-hang" if self._pool is not None
+                else "stage-hang"
+            )
+        else:
+            rec.reason = (
+                "malformed-input" if _input_shaped(exc)
+                else "exception"
+            )
+        # crash-shaped failures advance the request-class breaker;
+        # refusal-shaped degradations (breaker_relevant=False) and
+        # malformed inputs do not — a bad file/delta says nothing about
+        # the next request of the same shape.  Latched under BOTH the
+        # resolved executable bucket and the admission-time estimate
+        # class (for file-backed inputs those differ), so the admission
+        # check actually observes the count.
+        crash = (
+            err.breaker_relevant if err is not None
+            else not _input_shaped(exc)
+        )
+        if (
+            isinstance(err, res_errors.DeviceOOM)
+            and not err.rungs_exhausted
+        ):
+            # a ladder-retryable OOM indicts the budget, not the
+            # request class — only rung EXHAUSTION is crash-shaped
+            crash = False
+        if crash:
+            for c in {cls, cls_submit} - {""}:
+                self._class_failures[c] = (
+                    self._class_failures.get(c, 0) + 1
+                )
+        telemetry.event(
+            "serving", action="failed", request=rec.request_id,
+            error=rec.error, reason=rec.reason,
+        )
+        from ..utils.logger import log_warning
+
+        log_warning(
+            f"serving[{rec.request_id}]: request failed in isolation "
+            f"({rec.error}: {rec.detail[:120]}); service continues"
+        )
+
+    def _execute_session(self, req: PartitionRequest,
+                         cls_submit: str = "",
+                         wait_s: float = 0.0) -> RequestRecord:
+        """The session-scoped request kinds (register / mutate /
+        repartition, kaminpar_tpu/dynamic/) under the same isolation
+        boundary, breaker, and latency accounting as stateless
+        requests.  Sessions are created only on a fully successful
+        register; a failed mutate leaves the session at its pre-delta
+        state (the CSR patch is computed pure before either commit
+        path)."""
+        from ..resilience.checkpoint import SimulatedPreemption
+        from ..utils.logger import OutputLevel
+
+        t0 = time.perf_counter()
+        rec = RequestRecord(
+            request_id=req.request_id, verdict="failed",
+            k=int(req.k or 0),
+        )
+        cls = cls_submit or "unsized"
+        resolve_s = compute_s = 0.0
+        try:
+            if req.kind == "register":
+                from ..dynamic import GraphSession
+                from ..kaminpar import KaMinPar
+
+                graph = self._resolve_graph(req.graph)
+                resolve_s = time.perf_counter() - t0
+                sess = GraphSession(
+                    req.session, graph, k=int(req.k))
+                rec.n, rec.m = int(graph.n), int(graph.m)
+                bucket = self._buckets.observe(rec.n, rec.m, int(req.k))
+                rec.bucket = "/".join(str(x) for x in bucket)
+                cls = self._class_key(rec.n, rec.m, int(req.k))
+                rec.hard_ceiling_s = self._hard_ceiling(req)
+                ctx = self._request_ctx(req)
+                solver = KaMinPar(ctx)
+                if self.quiet:
+                    solver.set_output_level(OutputLevel.QUIET)
+                solver.set_graph(sess.graph)
+                # the session REMEMBERS its balance contract: later
+                # repartitions without an explicit epsilon reuse it
+                sess.epsilon = (
+                    float(req.epsilon) if req.epsilon is not None
+                    else None
+                )
+                t_c0 = time.perf_counter()
+                part = solver.compute_partition(
+                    k=int(req.k), epsilon=req.epsilon,
+                    seed=req.seed,
+                )
+                compute_s = time.perf_counter() - t_c0
+                metrics = solver.result_metrics(sess.graph, part)
+                rec.gate_valid = telemetry.gate_verdict()
+                sess.commit_partition(
+                    part, int(metrics["cut"]),
+                    gate_valid=rec.gate_valid)
+                self._sessions[req.session] = sess
+                rec.cut = int(metrics["cut"])
+                rec.imbalance = float(metrics["imbalance"])
+                rec.feasible = bool(metrics["feasible"])
+                rec.degraded_sites = sorted({
+                    e.attrs.get("site", "")
+                    for e in telemetry.events("degraded")
+                } - {""})
+                anytime = solver.last_anytime
+                self._dynamic_decisions.append({
+                    "session": sess.id, "kind": "register",
+                    "mode": "cold", "drift": None, "cut_before": None,
+                    "cut": rec.cut, "feasible": rec.feasible,
+                    "stable": None, "escalated": False, "seeded": 0,
+                    "wall_s": round(compute_s, 4),
+                    "warm_wall_s": None,
+                    "cold_wall_s": round(compute_s, 4),
+                    **({"gate_valid": rec.gate_valid}
+                       if rec.gate_valid is not None else {}),
+                })
+            elif req.kind == "mutate":
+                from ..dynamic import DeltaBatch
+
+                sess = self._sessions[req.session]
+                batch = DeltaBatch.from_dict(req.delta)
+                resolve_s = time.perf_counter() - t0
+                # mutate runs no compute, so the telemetry stream is
+                # NOT reset for this request — snapshot the degraded
+                # count so a previous request's degradations are not
+                # attributed to this one
+                deg_before = len(telemetry.events("degraded"))
+                t_c0 = time.perf_counter()
+                info = sess.apply(batch)
+                compute_s = time.perf_counter() - t_c0
+                rec.degraded_sites = sorted({
+                    e.attrs.get("site", "")
+                    for e in telemetry.events("degraded")[deg_before:]
+                } - {""})
+                rec.k = int(sess.k)
+                rec.n, rec.m = info["n"], info["m"]
+                rec.bucket = info["bucket"]
+                cls = self._class_key(rec.n, rec.m, int(sess.k))
+                rec.reason = (
+                    "in-place" if info["in_place"] else "rebuild")
+                anytime = None
+                rec.cut = (
+                    -1 if sess.last_cut is None else int(sess.last_cut))
+                rec.feasible = sess.last_cut is not None
+            else:  # repartition
+                from ..dynamic import repartition as _repartition
+
+                sess = self._sessions[req.session]
+                resolve_s = time.perf_counter() - t0
+                k = int(req.k or sess.k)
+                rec.k = k
+                rec.n, rec.m = int(sess.graph.n), int(sess.graph.m)
+                bucket = self._buckets.observe(rec.n, rec.m, k)
+                rec.bucket = "/".join(str(x) for x in bucket)
+                cls = self._class_key(rec.n, rec.m, k)
+                rec.hard_ceiling_s = self._hard_ceiling(req)
+                ctx = self._request_ctx(req)
+                ctx.partition.k = k  # req.k may be 0 = "the session's k"
+                # epsilon defaults to the SESSION's contract (set at
+                # register), not the wire default — caps and the diff
+                # gate must match what the session was partitioned under
+                eps = (
+                    req.epsilon if req.epsilon is not None
+                    else sess.epsilon
+                )
+                t_c0 = time.perf_counter()
+                outcome = _repartition(
+                    sess, ctx, k=k, epsilon=eps,
+                    seed=req.seed, quiet=self.quiet,
+                )
+                compute_s = time.perf_counter() - t_c0
+                rec.cut = int(outcome.cut)
+                rec.imbalance = float(outcome.imbalance)
+                rec.feasible = bool(outcome.feasible)
+                rec.gate_valid = outcome.gate_valid
+                rec.degraded_sites = list(outcome.degraded_sites)
+                anytime = outcome.anytime
+                self._dynamic_decisions.append({
+                    **outcome.to_row(sess.id), "kind": "repartition",
+                })
+        except (KeyboardInterrupt, SystemExit, SimulatedPreemption):
+            raise  # process-fatal by contract; never a request verdict
+        except BaseException as exc:  # the isolation boundary
+            self._note_failure(rec, exc, cls, cls_submit)
+            rec.wall_s = time.perf_counter() - t0
+            self._observe_latency(
+                rec, wait_s, resolve_s,
+                max(rec.wall_s - resolve_s, 0.0), 0.0,
+            )
+            return rec
+
+        for c in {cls, cls_submit} - {""}:
+            self._class_failures.pop(c, None)
+        if anytime:
+            rec.verdict = "anytime"
+            if not rec.reason:
+                rec.reason = str(anytime.get("reason") or "")
+            if rec.reason in ("sigterm", "sigint", "draining"):
+                self._drained = True
+        elif rec.degraded_sites:
+            rec.verdict = "degraded"
+        else:
+            rec.verdict = "served"
+        rec.wall_s = time.perf_counter() - t0
+        self._observe_latency(rec, wait_s, resolve_s, compute_s, 0.0)
+        telemetry.event(
+            "dynamic", action=req.kind, request=req.request_id,
+            session=req.session, verdict=rec.verdict,
+        )
+        return rec
+
     def _execute(self, req: PartitionRequest,
                  cls_submit: str = "",
                  wait_s: float = 0.0) -> RequestRecord:
@@ -567,6 +870,9 @@ class PartitionService:
         from ..resilience.checkpoint import SimulatedPreemption
         from ..utils import timer
         from ..utils.logger import OutputLevel
+
+        if getattr(req, "kind", "partition") != "partition":
+            return self._execute_session(req, cls_submit, wait_s)
 
         t0 = time.perf_counter()
         rec = RequestRecord(
@@ -614,7 +920,9 @@ class PartitionService:
                 # classified failure, and the queue keeps draining
                 part, winfo = self._pool.run_request(
                     req.request_id, req.graph, graph, ctx,
-                    k=int(req.k), epsilon=float(req.epsilon),
+                    k=int(req.k),
+                    epsilon=float(req.epsilon if req.epsilon is not None
+                                  else 0.03),
                     seed=req.seed, ceiling_s=rec.hard_ceiling_s,
                 )
                 gate_s = float(winfo.get("gate_s") or 0.0)
@@ -624,7 +932,9 @@ class PartitionService:
                     solver.set_output_level(OutputLevel.QUIET)
                 solver.set_graph(graph)
                 part = solver.compute_partition(
-                    k=int(req.k), epsilon=float(req.epsilon),
+                    k=int(req.k),
+                    epsilon=float(req.epsilon if req.epsilon is not None
+                                  else 0.03),
                     seed=req.seed,
                 )
                 # the gate runs inside compute_partition under its own
@@ -636,78 +946,13 @@ class PartitionService:
         except (KeyboardInterrupt, SystemExit, SimulatedPreemption):
             raise  # process-fatal by contract; never a request verdict
         except BaseException as exc:  # the isolation boundary
-            err = res_errors.classify(exc, site="")
-            rec.verdict = "failed"
-            rec.error = type(err if err is not None else exc).__name__
-            rec.detail = str(exc)[:300]
-            # supervision verdicts (resilience/supervisor.py) carry
-            # their own reason taxonomy: a SIGKILLed hung worker reads
-            # `worker-hang`, a dead worker `worker-crash`, and an
-            # in-process watchdog overrun `stage-hang` — everything
-            # else keeps the malformed-input/exception split
-            if isinstance(err, res_errors.WorkerCrash):
-                rec.reason = "worker-crash"
-            elif isinstance(err, res_errors.StageHang):
-                # in process mode every hang verdict — the supervisor's
-                # SIGKILL path AND a hang the child's own watchdog
-                # managed to convert gracefully — reads `worker-hang`;
-                # an in-process watchdog overrun reads `stage-hang`.
-                # (err.site is NOT trusted here: a hang landing inside
-                # a guarded primary may carry that site's stamp.)
-                rec.reason = (
-                    "worker-hang" if self._pool is not None
-                    else "stage-hang"
-                )
-            else:
-                rec.reason = (
-                    "malformed-input" if _input_shaped(exc)
-                    else "exception"
-                )
+            self._note_failure(rec, exc, cls, cls_submit)
             rec.wall_s = time.perf_counter() - t0
             # failures carry latency too (whatever phases completed) —
             # a timeout-shaped failure mode must be visible in p99
             self._observe_latency(
                 rec, wait_s, resolve_s,
                 max(rec.wall_s - resolve_s - gate_s, 0.0), gate_s,
-            )
-            # crash-shaped failures advance the request-class breaker;
-            # refusal-shaped degradations (breaker_relevant=False) and
-            # malformed inputs do not — a bad file says nothing about
-            # the next request of the same shape.  Latched under BOTH
-            # the resolved executable bucket and the admission-time
-            # estimate class (for file-backed inputs those differ:
-            # admission can only see "unsized" without loading the
-            # file), so the admission check — which can only ever
-            # compute the estimate class — actually observes the count.
-            crash = (
-                err.breaker_relevant if err is not None
-                else not _input_shaped(exc)
-            )
-            if (
-                isinstance(err, res_errors.DeviceOOM)
-                and not err.rungs_exhausted
-            ):
-                # a ladder-retryable OOM can only reach this boundary in
-                # a governor-disabled process (KAMINPAR_TPU_MEM_GOVERNOR
-                # =0) — it indicts the budget, not the request class, so
-                # it must never latch the per-class breaker; only rung
-                # EXHAUSTION (every rung incl. host-only failed) is
-                # crash-shaped
-                crash = False
-            if crash:
-                for c in {cls, cls_submit} - {""}:
-                    self._class_failures[c] = (
-                        self._class_failures.get(c, 0) + 1
-                    )
-            telemetry.event(
-                "serving", action="failed", request=req.request_id,
-                error=rec.error, reason=rec.reason,
-            )
-            from ..utils.logger import log_warning
-
-            log_warning(
-                f"serving[{req.request_id}]: request failed in isolation "
-                f"({rec.error}: {rec.detail[:120]}); service continues"
             )
             return rec
 
@@ -724,9 +969,7 @@ class PartitionService:
             anytime = winfo.get("anytime")
         else:
             metrics = solver.result_metrics(graph, part)
-            gate = telemetry.run_info().get("output_gate")
-            if isinstance(gate, dict) and gate.get("checked"):
-                rec.gate_valid = bool(gate.get("valid"))
+            rec.gate_valid = telemetry.gate_verdict()
             worker_degraded = {
                 e.attrs.get("site", "")
                 for e in telemetry.events("degraded")
@@ -899,6 +1142,18 @@ class PartitionService:
             "drained": bool(self._drained),
         }
 
+    def dynamic_summary(self) -> dict:
+        """The run report's ``dynamic`` section (schema v11) for this
+        service: live session rows + the decision log
+        (kaminpar_tpu/dynamic/driver.summarize; {'enabled': False}
+        when no session request ever ran)."""
+        from ..dynamic import summarize
+
+        with self._lock:
+            sessions = list(self._sessions.values())
+            decisions = list(self._dynamic_decisions)
+        return summarize(sessions, decisions)
+
     def supervision_summary(self) -> dict:
         """The run report's ``supervision`` section (schema v10) for
         this service: worker-pool lifecycle counters, the hang log,
@@ -921,7 +1176,8 @@ class PartitionService:
         resets the stream at entry) and return the serving section."""
         s = self.summary()
         telemetry.annotate(
-            serving=s, supervision=self.supervision_summary()
+            serving=s, supervision=self.supervision_summary(),
+            dynamic=self.dynamic_summary(),
         )
         return s
 

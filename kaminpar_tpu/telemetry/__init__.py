@@ -290,6 +290,18 @@ def run_info() -> Dict[str, Any]:
         return dict(_run_info)
 
 
+def gate_verdict() -> Any:
+    """The current stream's output-gate verdict as a tri-state:
+    True/False when the gate checked this run's partition, None when it
+    never ran (gate disabled, no partition in this stream).  The one
+    place the `output_gate` annotation shape is interpreted — the
+    serving layer and the dynamic repartition policy both read it."""
+    gate = run_info().get("output_gate")
+    if isinstance(gate, dict) and gate.get("checked"):
+        return bool(gate.get("valid"))
+    return None
+
+
 def is_primary_process() -> bool:
     """True on process 0 (or without a backend).  File-writing exporters
     gate on this: on multi-host runs every process must still CALL them

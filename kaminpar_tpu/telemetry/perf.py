@@ -561,6 +561,15 @@ def snapshot() -> dict:
                 row[axis + "_real"] = int(e[axis])
                 row[axis + "_pad"] = int(e[axis + "_pad"])
                 row[axis + "_waste"] = w
+                # per-bucket pad slack ("headroom", element count per
+                # launch): the free padded slots of this bucket — the
+                # same number that decides whether a dynamic-session
+                # delta can apply IN PLACE (same executable bucket,
+                # dynamic/session.py) or must rebuild and re-upload
+                row[axis + "_slack"] = int(
+                    (e[axis + "_pad"] - e[axis])
+                    // max(int(e["launches"]), 1)
+                )
                 pad_real += e[axis]
                 pad_padded += e[axis + "_pad"]
                 axis_real[axis] += e[axis]
@@ -598,6 +607,13 @@ def snapshot() -> dict:
             for axis in ("n", "m", "k")
             if (w := _waste(axis_real[axis], axis_padded[axis]))
             is not None
+        },
+        # per-axis total slack (padded - real element counts): the
+        # aggregate headroom twin of the per-row *_slack figures
+        "pad_slack_axes": {
+            axis: int(axis_padded[axis] - axis_real[axis])
+            for axis in ("n", "m", "k")
+            if axis_padded[axis]
         },
     }
     if total_wall > 0:

@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -202,6 +202,13 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     supervision = info.pop("supervision", None)
     if supervision is None:
         supervision = _supervision_section()
+    # schema v11: the dynamic-repartitioning audit trail (kaminpar_tpu/
+    # dynamic/) — live sessions (deltas applied, in-place vs rebuild
+    # counts, chain digest), the warm/cold/replica decision log with
+    # drift scores and diff-gate verdicts, and the per-step cut
+    # trajectory.  Annotated by the chain driver / serving layer; runs
+    # with no sessions carry the well-formed disabled default.
+    dynamic = info.pop("dynamic", {"enabled": False})
     run = dict(info)
     if extra_run:
         run.update({k: jsonable(v) for k, v in extra_run.items()})
@@ -343,6 +350,13 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # watchdog arm/fire counts (resilience/supervisor.py,
         # docs/robustness.md "Supervision contract")
         "supervision": supervision,
+        # schema v11: dynamic repartitioning — graph sessions (delta
+        # chains, in-place vs rebuild bucket accounting, chain
+        # digests), warm/cold/replica decisions with drift scores and
+        # the PR-4 diff-gate verdict per step, and the cut trajectory
+        # (kaminpar_tpu/dynamic/, docs/robustness.md "Dynamic
+        # sessions")
+        "dynamic": dynamic,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

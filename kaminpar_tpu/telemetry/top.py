@@ -79,6 +79,17 @@ def render_report(report: dict, top_n: int = DEFAULT_TOP_N) -> List[str]:
                 if a in axes
             )
         )
+    slack = totals.get("pad_slack_axes") or {}
+    if slack:
+        # aggregate headroom (free padded elements) — the budget that
+        # decides in-place dynamic-delta application (dynamic/)
+        lines.append(
+            "pad_slack by axis (headroom, elements): "
+            + ", ".join(
+                f"{a}={_fmt(slack[a])}" for a in ("n", "m", "k")
+                if a in slack
+            )
+        )
 
     # -- top scopes by wall (every report has a scope tree) --------------
     scopes = flatten_scopes(report.get("scope_tree", {}))
@@ -148,13 +159,18 @@ def render_report(report: dict, top_n: int = DEFAULT_TOP_N) -> List[str]:
     by_waste = sorted(pad, key=lambda r: -worst_waste(r))[:top_n]
     if by_waste:
         lines.append("")
-        lines.append(f"top {len(by_waste)} pad-waste rows:")
+        # *_slack = per-launch free padded slots of the bucket (the
+        # headroom a dynamic-session delta can grow into IN PLACE
+        # before crossing buckets — dynamic/session.py)
+        lines.append(f"top {len(by_waste)} pad-waste rows "
+                     "(slack = per-launch headroom, elements):")
         lines.extend(_table(
             ["scope", "bucket", "launches", "n_waste", "m_waste",
-             "k_waste"],
+             "k_waste", "n_slack", "m_slack"],
             [
                 [r.get("scope"), r.get("bucket"), r.get("launches"),
-                 r.get("n_waste"), r.get("m_waste"), r.get("k_waste")]
+                 r.get("n_waste"), r.get("m_waste"), r.get("k_waste"),
+                 r.get("n_slack"), r.get("m_slack")]
                 for r in by_waste
             ],
         ))

@@ -78,6 +78,12 @@ EXTERNAL_COVERAGE_KEYS = ("external_seconds", "stream_overlap")
 #: latency trend — the r05 regression class).
 SUPERVISED_COVERAGE_KEYS = ("supervised_p95_ms",)
 
+#: Dynamic-repartitioning keys (round 15, kaminpar_tpu/dynamic/): the
+#: BENCH line must always carry them from r06 on (null = the dynamic
+#: chain measurement was skipped/failed, absence = silent coverage
+#: loss of the warm-repartition trend — the r05 regression class).
+DYNAMIC_COVERAGE_KEYS = ("dynamic_warm_speedup", "dynamic_cut_drift")
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -251,6 +257,8 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "overlap": overlap,
         "p95_ms": p95_ms,
         "sup_p95": parsed.get("supervised_p95_ms"),
+        "dyn_speedup": parsed.get("dynamic_warm_speedup"),
+        "dyn_drift": parsed.get("dynamic_cut_drift"),
         "schema": report.get("schema_version"),
     }
 
@@ -268,7 +276,8 @@ def render(rows: List[Dict[str, Any]]) -> str:
             "coarsening_s", "lp_s", "contract_s", "engines",
             "compile_s", "cache_hit", "hbm_util",
             "pad_waste", "locked", "left", "external_s", "overlap",
-            "p95_ms", "sup_p95", "platform", "schema")
+            "p95_ms", "sup_p95", "dyn_speedup", "dyn_drift",
+            "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -406,6 +415,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{name}: supervised coverage key {key!r} "
                         "missing (bench.py must emit it every run; null "
                         "marks a skipped/failed supervised batch)"
+                    )
+            for key in DYNAMIC_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: dynamic coverage key {key!r} missing "
+                        "(bench.py must emit it every run; null marks a "
+                        "skipped/failed dynamic chain measurement)"
                     )
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target

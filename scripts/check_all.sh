@@ -18,7 +18,11 @@
 # insufficient-memory rejection), an out-of-core streaming smoke
 # (--scheme external under a 25%-of-estimate budget -> gate-valid,
 # fine level never device-resident, stream events + overlap > 0, and
-# a mid-stream kill-and-resume that is CUT-IDENTICAL), a dist
+# a mid-stream kill-and-resume that is CUT-IDENTICAL), a dynamic
+# repartition smoke (8-delta chain with one bucket-crossing delta and
+# one injected dynamic-apply fault: every step gate-valid, >= 1
+# in-place and >= 1 rebuild apply, cut trajectory inside the diff
+# gate), a dist
 # resilience smoke (SIGTERM a
 # mesh run mid-pipeline -> resume is CUT-IDENTICAL; a rank-scoped
 # device-oom walks the cross-rank agreed ladder; a rank-1-scoped fault
@@ -34,13 +38,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/11] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/12] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/11] run-report schema (producer selftest, v1-v9 fixtures + v10 producer) =="
+echo "== [2/12] run-report schema (producer selftest, v1-v10 fixtures + v11 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/11] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/12] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -108,7 +112,7 @@ print(f"quality smoke OK: {len(rows)} attribution row(s), "
       "BENCH quality keys present")
 EOF
 
-echo "== [4/11] telemetry.diff self-test + BENCH trend/kernel gate =="
+echo "== [4/12] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -132,7 +136,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/11] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/12] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -172,7 +176,7 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
-echo "== [6/11] serving smoke (mixed batch + faults + SIGTERM drain) =="
+echo "== [6/12] serving smoke (mixed batch + faults + SIGTERM drain) =="
 SERVE_DIR=/tmp/_kmp_serve_smoke
 rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
 python - <<'EOF3' || exit 1
@@ -269,7 +273,7 @@ print(f"drain OK: counts={c} ({len(drained)} drained)")
 EOF3
 
 
-echo "== [7/11] supervision smoke (worker hang/crash containment) =="
+echo "== [7/12] supervision smoke (worker hang/crash containment) =="
 SUP_DIR=/tmp/_kmp_sup_smoke
 rm -rf "$SUP_DIR"; mkdir -p "$SUP_DIR"
 SUP_START_NS=$(python -c "import time; print(time.time_ns())")
@@ -339,7 +343,7 @@ print(f"supervision smoke OK: counts={s['counts']}, workers={w}, "
       f"{len(sup['hangs'])} hang(s), heartbeat={hb['count']} touch(es)")
 EOF7
 
-echo "== [8/11] memory-governor smoke (tiny budget + forced spill + serving) =="
+echo "== [8/12] memory-governor smoke (tiny budget + forced spill + serving) =="
 MEM_DIR=/tmp/_kmp_mem_smoke
 rm -rf "$MEM_DIR"; mkdir -p "$MEM_DIR"
 # an artificially small budget: 25% of the rung-0 estimate for the shape
@@ -410,7 +414,7 @@ assert by_id["oversized"]["reason"] == "insufficient-memory", by_id
 print("serving insufficient-memory OK")
 PYEOF
 
-echo "== [9/11] out-of-core streaming smoke (--scheme external) =="
+echo "== [9/12] out-of-core streaming smoke (--scheme external) =="
 EXT_DIR=/tmp/_kmp_ext_smoke
 rm -rf "$EXT_DIR"; mkdir -p "$EXT_DIR"
 # a budget at 25% of the in-core estimate: the external scheme must
@@ -472,7 +476,83 @@ print(f"external resume OK: resumed from "
       "(identical to the reference)")
 PYEOF
 
-echo "== [10/11] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
+echo "== [10/12] dynamic repartition smoke (8-delta chain + chaos + bucket crossing) =="
+DYN_DIR=/tmp/_kmp_dynamic_smoke
+rm -rf "$DYN_DIR"; mkdir -p "$DYN_DIR"
+# synthesize the chain OUTSIDE the fault plan (the generator applies
+# deltas to a scratch session and must not consume the injection
+# budget): 7 small ~1% churn batches + ONE bucket-crossing batch that
+# inserts past the padded edge bucket's slack
+python - <<'PYEOF' || exit 1
+import json
+import numpy as np
+from kaminpar_tpu import caching
+from kaminpar_tpu.dynamic import GraphSession, random_delta_batch, synth_chain
+from kaminpar_tpu.graphs.factories import generate
+
+g = generate("gen:rgg2d;n=4096;avg_degree=8;seed=1")
+scratch = GraphSession("gen", g, k=4)
+batches = []
+for i in range(8):
+    if i == 4:
+        # bucket-crossing delta: insert past the m bucket's slack
+        m_pad = caching.pad_size(max(scratch.graph.m, 1))
+        slack_und = (m_pad - scratch.graph.m) // 2
+        b = random_delta_batch(scratch.graph, seed=900,
+                               edge_churn=float(slack_und + 64)
+                               / max(scratch.graph.m // 2, 1),
+                               insert_frac=1.0)
+    else:
+        b = random_delta_batch(scratch.graph, seed=300 + i,
+                               edge_churn=0.01)
+    info = scratch.apply(b)
+    batches.append(b.to_dict())
+assert scratch.rebuilds >= 1, "no bucket-crossing delta synthesized"
+json.dump({"deltas": batches}, open("/tmp/_kmp_dynamic_smoke/deltas.json", "w"))
+print(f"chain synthesized: {len(batches)} deltas, "
+      f"{scratch.in_place} in-place / {scratch.rebuilds} rebuild")
+PYEOF
+# drive the chain with one injected dynamic-apply chaos fault (forces
+# one in-place-eligible delta down the rebuild path)
+KAMINPAR_TPU_FAULTS=dynamic-apply:nth=2 python -m kaminpar_tpu \
+    "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 -s 1 \
+    --delta-batch "$DYN_DIR/deltas.json" \
+    --report-json "$DYN_DIR/report.json" -q || exit 1
+python scripts/check_report_schema.py "$DYN_DIR/report.json" || exit 1
+python - <<'PYEOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_dynamic_smoke/report.json"))
+d = r["dynamic"]
+assert d["enabled"], d
+sess = d["sessions"][0]
+assert sess["deltas_applied"] == 8, sess
+# >= 1 in-place and >= 1 rebuild (the bucket-crossing delta plus the
+# injected dynamic-apply fault both force rebuilds)
+assert sess["in_place"] >= 1 and sess["rebuilds"] >= 1, sess
+inj = [row for row in r["faults"]["injected"]
+       if row["site"] == "dynamic-apply"]
+assert inj, r["faults"]
+# every repartition gate-valid...
+reparts = [row for row in d["decisions"] if row.get("step", 0) >= 1]
+assert len(reparts) == 8, [row.get("step") for row in d["decisions"]]
+bad_gate = [row for row in d["decisions"]
+            if row.get("gate_valid") is False]
+assert not bad_gate, bad_gate
+# ...and the cut trajectory stays inside the diff-gate threshold: every
+# step either passed the PR-4 cut gate vs its pre-delta baseline or was
+# escalated to the cold run and kept the better of the two
+unstable = [row for row in reparts
+            if row.get("stable") is False and not row.get("escalated")]
+assert not unstable, unstable
+traj = d["cut_trajectory"]
+assert len(traj) == 9 and all(isinstance(c, int) for c in traj), traj
+counts = d["counts"]
+print(f"dynamic smoke OK: warm={counts['warm']} cold={counts['cold']} "
+      f"in_place={counts['in_place']} rebuilds={counts['rebuilds']} "
+      f"trajectory={traj}")
+PYEOF
+
+echo "== [11/12] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
 DIST_DIR=/tmp/_kmp_dist_smoke
 rm -rf "$DIST_DIR"; mkdir -p "$DIST_DIR"
 DIST_XLA="--xla_force_host_platform_device_count=8"
@@ -591,11 +671,11 @@ print("rank-scope inert OK: rank=1 plan fired nothing on rank 0")
 EOF8
 
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [11/11] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [12/12] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [11/11] tier-1 pytest (ROADMAP.md) =="
+echo "== [12/12] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

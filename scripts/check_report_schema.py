@@ -92,8 +92,8 @@ def version_checks(report: Any) -> List[str]:
     `memory_budget` section, v7+ additionally the `quality` section,
     v8+ additionally the `dist_resilience` section, v9+ additionally
     the `external` section, v10+ additionally the `supervision`
-    section; older reports remain valid without them during the
-    transition."""
+    section, v11+ additionally the `dynamic` section; older reports
+    remain valid without them during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -110,6 +110,7 @@ def version_checks(report: Any) -> List[str]:
         (8, ("dist_resilience",)),
         (9, ("external",)),
         (10, ("supervision",)),
+        (11, ("dynamic",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -221,6 +222,15 @@ def _minimal_v9_report() -> dict:
     return r
 
 
+def _minimal_v10_report() -> dict:
+    """A minimal schema_version-10 report (supervision present, no
+    dynamic section) — the tenth transition fixture."""
+    r = _minimal_v9_report()
+    r["schema_version"] = 10
+    r["supervision"] = {"enabled": False}
+    return r
+
+
 def _selftest_report(path: str) -> None:
     """Generate a minimal live report so producer and schema are checked
     against each other with no partition run (the pre-commit /
@@ -283,6 +293,32 @@ def _selftest_report(path: str) -> None:
                       "hit_rate": 0.0},
             "drained": False,
         },
+        dynamic={
+            "enabled": True,
+            "sessions": [
+                {"id": "s1", "n": 100, "m": 400, "k": 4,
+                 "deltas_applied": 3, "in_place": 2, "rebuilds": 1,
+                 "repartitions": 3, "chain": "dyn:abc123",
+                 "bucket": "256/512/4", "cut": 10},
+            ],
+            "decisions": [
+                {"session": "s1", "step": 1, "mode": "warm",
+                 "drift": 0.01, "cut_before": 12, "cut": 10,
+                 "feasible": True, "stable": True, "gate_valid": True,
+                 "escalated": False, "seeded": 1, "in_place": True,
+                 "wall_s": 0.2, "warm_wall_s": 0.2,
+                 "cold_wall_s": None},
+                {"session": "s1", "step": 2, "mode": "replica",
+                 "drift": 0.4, "cut_before": 10, "cut": 11,
+                 "feasible": True, "stable": True, "escalated": False,
+                 "seeded": 0, "wall_s": 0.5, "warm_wall_s": 0.2,
+                 "cold_wall_s": 0.3, "replica_cuts": [12, 11]},
+            ],
+            "counts": {"warm": 1, "cold": 0, "replica": 1,
+                       "escalated": 0, "deltas": 3, "in_place": 2,
+                       "rebuilds": 1},
+            "cut_trajectory": [10, 11],
+        },
         supervision={
             "enabled": True,
             "isolation": "process",
@@ -342,7 +378,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v10) and validate it plus the embedded v1-v9 transition "
+        "v11) and validate it plus the embedded v1-v10 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -366,20 +402,21 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v10 (progress/compile +
+        # live producer must emit v11 (progress/compile +
         # checkpoint/anytime + serving + perf + memory_budget +
-        # quality + dist_resilience + external + supervision)
-        if report.get("schema_version") != 10:
+        # quality + dist_resilience + external + supervision +
+        # dynamic)
+        if report.get("schema_version") != 11:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 10",
+                f"expected 11",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
                     "memory_budget", "quality", "dist_resilience",
-                    "external", "supervision"):
+                    "external", "supervision", "dynamic"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -411,13 +448,13 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v9 layouts must STILL validate
+        # transition coverage: the v1-v10 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
             ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
             ("v7", _minimal_v7_report()), ("v8", _minimal_v8_report()),
-            ("v9", _minimal_v9_report()),
+            ("v9", _minimal_v9_report()), ("v10", _minimal_v10_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

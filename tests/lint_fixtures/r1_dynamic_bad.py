@@ -1,0 +1,22 @@
+"""R1 bad fixture: the dynamic delta-apply hook shape done WRONG —
+the host CSR patch pull and the post-apply cut readback written
+lexically inside the driver's dynamic-apply timer span (the PR-15 hook
+hazard: every delta would host-sync the patched adjacency and a device
+scalar inside the measured region, serializing the session mutate
+against the device queue and charging the span).
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def apply_delta_with_inline_pulls(session, batch, labels, out):
+    with scoped_timer("dynamic-apply"):
+        patched = np.asarray(session.patch(batch))  # line 19: R1 copy
+        session.commit(patched)
+        out.append(int(jnp.sum(labels)))  # line 21: R1 int()
+    return out

@@ -1,0 +1,29 @@
+"""R1 good fixture: the dynamic delta-apply hook shape done RIGHT —
+the CSR patch work and the cut readback live in session-style helpers
+OUTSIDE the driver's timer span (dynamic/session.py's pattern: the
+span body only makes function calls, so the host-side patch sits in
+plain module code tpulint's span tracking does not cover and the
+device queue stays busy)."""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def _patch_csr(session, batch):
+    # plain helper, not jit-reachable, not lexically inside a span:
+    # the host CSR patch is fine here (the session.apply hook shape)
+    return np.asarray(session.patch(batch))
+
+
+def _pull_cut(labels):
+    # the step boundary's single scalar readback, factored out like
+    # the repartition driver's metrics hook
+    return int(jnp.sum(labels))
+
+
+def apply_delta_with_hooked_pulls(session, batch, labels, out):
+    with scoped_timer("dynamic-apply"):
+        session.commit(_patch_csr(session, batch))
+    out.append(_pull_cut(labels))
+    return out

@@ -1,0 +1,698 @@
+"""Dynamic repartitioning: sessions, delta ingestion, warm v-cycles.
+
+Covers the PR-15 subsystem (kaminpar_tpu/dynamic/): DeltaBatch
+validation through the GraphFormatError taxonomy, the padded-bucket
+in-place/rebuild CSR patch path (incl. the `dynamic-apply` chaos
+site), the delta-chain identity (no full re-hash per mutate, no
+aliasing against plain graph digests), neighbor-majority seeding, the
+warm/cold/replica decision + the PR-4 diff cut gate, mid-chain
+kill-and-resume cut-identity (the KAMINPAR_TPU_STOP_AT hard-kill
+idiom), the serving session request kinds, the schema-v11 `dynamic`
+report section, and the per-bucket pad-slack surfacing.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kaminpar_tpu import caching, resilience, telemetry
+from kaminpar_tpu.dynamic import (
+    DeltaBatch,
+    GraphSession,
+    random_delta_batch,
+    repartition,
+    run_chain,
+    seed_new_vertices,
+    summarize,
+    synth_chain,
+)
+from kaminpar_tpu.graphs.factories import make_rgg2d
+from kaminpar_tpu.graphs.host import (
+    from_edge_list,
+    host_partition_metrics,
+    validate as validate_graph,
+)
+from kaminpar_tpu.io.errors import GraphFormatError
+from kaminpar_tpu.kaminpar import KaMinPar
+from kaminpar_tpu.presets import create_context_by_preset_name
+from kaminpar_tpu.resilience import checkpoint as ckpt_mod
+from kaminpar_tpu.resilience.checkpoint import (
+    SimulatedPreemption,
+    graph_fingerprint,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, K = 1024, 4
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(ckpt_mod.STOP_AT_ENV, raising=False)
+    monkeypatch.delenv(resilience.FAULTS_ENV_VAR, raising=False)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _graph():
+    return make_rgg2d(N, avg_degree=8, seed=3)
+
+
+def _tiny():
+    # path 0-1-2-3 plus a triangle 3-4-5-3
+    return from_edge_list(6, np.array(
+        [[0, 1], [1, 2], [2, 3], [3, 4], [4, 5], [5, 3]]))
+
+
+_PART_CACHE = {}
+
+
+def _partitioned_session(session_id="s", k=K, seed=1):
+    """A session over the shared test graph with a committed initial
+    partition (the expensive cold run is computed once per module)."""
+    g = _graph()
+    key = (k, seed)
+    if key not in _PART_CACHE:
+        ctx = create_context_by_preset_name("default")
+        solver = KaMinPar(ctx)
+        solver.set_output_level(0)
+        solver.set_graph(g)
+        part = solver.compute_partition(k=k, seed=seed)
+        cut = int(host_partition_metrics(g, part, k)["cut"])
+        _PART_CACHE[key] = (np.asarray(part, dtype=np.int32), cut)
+    part, cut = _PART_CACHE[key]
+    s = GraphSession(session_id, g, k=k)
+    s.commit_partition(part.copy(), cut, gate_valid=True)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# DeltaBatch validation (io.GraphFormatError taxonomy)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("delta,frag", [
+    ({"edge_inserts": [[0, 99]]}, "out of range"),
+    ({"edge_inserts": [[2, 2]]}, "self loops"),
+    ({"edge_inserts": [[0, 1]]}, "already exists"),
+    ({"edge_inserts": [[0, 4], [4, 0]]}, "duplicate pair"),
+    ({"edge_deletes": [[0, 5]]}, "does not exist"),
+    ({"edge_deletes": [[0, 1], [1, 0]]}, "duplicate pair"),
+    ({"edge_weight_updates": [[0, 5]], "update_weights": [2]},
+     "does not exist"),
+    ({"edge_weight_updates": [[0, 1]]}, "requires update_weights"),
+    ({"edge_inserts": [[0, 4]], "insert_weights": [0]}, ">= 1"),
+    ({"vertex_removes": [9]}, "out of range"),
+    ({"vertex_removes": [1, 1]}, "duplicate"),
+    ({"node_weight_updates": [[1, 0]]}, ">= 1"),
+    ({"vertex_adds": -1}, ">= 0"),
+    ({"bogus_key": 1}, "unknown delta key"),
+])
+def test_delta_validation_errors(delta, frag):
+    s = GraphSession("v", _tiny(), k=2)
+    with pytest.raises(GraphFormatError) as ei:
+        s.apply(DeltaBatch.from_dict(delta))
+    assert frag in str(ei.value)
+
+
+def test_failed_apply_leaves_session_untouched():
+    s = GraphSession("v", _tiny(), k=2)
+    chain0, n0, m0 = s.chain, s.graph.n, s.graph.m
+    with pytest.raises(GraphFormatError):
+        s.apply(DeltaBatch.from_dict({"edge_deletes": [[0, 5]]}))
+    assert (s.chain, s.graph.n, s.graph.m) == (chain0, n0, m0)
+    assert s.deltas_applied == 0
+
+
+# ---------------------------------------------------------------------------
+# the CSR patch path
+# ---------------------------------------------------------------------------
+
+
+def test_patch_matches_rebuilt_graph():
+    s = GraphSession("p", _tiny(), k=2)
+    s.apply(DeltaBatch.from_dict({
+        "edge_inserts": [[0, 2], [1, 6]],  # 6 = the added vertex
+        "insert_weights": [3, 1],
+        "edge_deletes": [[3, 4]],
+        "edge_weight_updates": [[0, 1]],
+        "update_weights": [7],
+        "vertex_adds": 1,
+        "node_weight_updates": [[2, 5]],
+    }))
+    g = s.graph
+    validate_graph(g)
+    # expected: the same edge set built from scratch
+    expected = from_edge_list(7, np.array(
+        [[0, 1], [1, 2], [2, 3], [4, 5], [5, 3], [0, 2], [1, 6]]),
+        edge_weights=np.array([7, 1, 1, 1, 1, 3, 1]),
+        node_weights=np.array([1, 1, 5, 1, 1, 1, 1]),
+    )
+    assert np.array_equal(g.xadj, expected.xadj)
+    assert np.array_equal(g.adjncy, expected.adjncy)
+    assert np.array_equal(g.edge_weight_array(),
+                          expected.edge_weight_array())
+    assert np.array_equal(g.node_weight_array(),
+                          expected.node_weight_array())
+
+
+def test_vertex_remove_compacts_and_remaps_partition():
+    s = GraphSession("p", _tiny(), k=2)
+    s.commit_partition(np.array([0, 0, 0, 1, 1, 1], dtype=np.int32),
+                       cut=1)
+    s.apply(DeltaBatch.from_dict({"vertex_removes": [1]}))
+    g = s.graph
+    assert g.n == 5
+    validate_graph(g)
+    # old 2..5 shift down to 1..4; edges 0-1(old 0-1? removed), the
+    # old (1,2) edge is gone with vertex 1
+    assert np.array_equal(s.partition, np.array([0, 0, 1, 1, 1]))
+    # the removed vertex's incident edge mass left the graph
+    assert g.m == 2 * 4  # path 1-2 gone, 0 isolated: 2-3,3-4,4-5? ->
+    # remaining undirected edges: (2,3),(3,4),(4,5),(5,3) minus none
+    # = 4 edges, stored twice
+
+
+def test_reinsert_after_delete_in_one_batch():
+    s = GraphSession("p", _tiny(), k=2)
+    s.apply(DeltaBatch.from_dict({
+        "edge_deletes": [[0, 1]],
+        "edge_inserts": [[0, 1]],
+        "insert_weights": [9],
+    }))
+    g = s.graph
+    w = g.edge_weight_array()[
+        (g.edge_sources() == 0) & (g.adjncy == 1)]
+    assert list(w) == [9]
+
+
+def test_in_place_vs_rebuild_bucket_accounting():
+    s = GraphSession("b", _graph(), k=K)
+    epoch0 = s.device_epoch
+    info = s.apply(random_delta_batch(s.graph, seed=5, edge_churn=0.005))
+    assert info["in_place"] and s.in_place == 1 and s.rebuilds == 0
+    assert s.device_epoch == epoch0
+    # a delta past the padded edge bucket's slack must rebuild
+    m_pad = caching.pad_size(max(s.graph.m, 1))
+    need = (m_pad - s.graph.m) // 2 + 8
+    big = random_delta_batch(
+        s.graph, seed=6,
+        edge_churn=float(need + 1) / max(s.graph.m // 2, 1),
+        insert_frac=1.0)
+    info2 = s.apply(big)
+    assert not info2["in_place"] and s.rebuilds == 1
+    assert s.device_epoch == epoch0 + 1
+    # executable-identity accounting: the in-place commit was a bucket
+    # hit, the crossing a miss
+    stats = s.tracker.stats()
+    assert stats["hits"] >= 1 and stats["misses"] >= 2
+
+
+def test_dynamic_apply_fault_forces_rebuild(monkeypatch):
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "dynamic-apply:nth=1")
+    s = GraphSession("c", _graph(), k=K)
+    info = s.apply(random_delta_batch(s.graph, seed=5, edge_churn=0.005))
+    assert not info["in_place"] and s.rebuilds == 1 and s.in_place == 0
+    deg = [e for e in telemetry.events("degraded")
+           if e.attrs.get("site") == "dynamic-apply"]
+    assert deg and deg[0].attrs.get("injected")
+
+
+# ---------------------------------------------------------------------------
+# the delta-chain identity (satellite: no O(m) re-hash, no aliasing)
+# ---------------------------------------------------------------------------
+
+
+def test_chain_hash_deterministic_and_sensitive():
+    g1, g2 = _tiny(), _tiny()
+    a, b = GraphSession("a", g1, k=2), GraphSession("b", g2, k=2)
+    assert a.digest() == b.digest()
+    d1 = DeltaBatch.from_dict({"edge_inserts": [[0, 3]]})
+    d2 = DeltaBatch.from_dict({"edge_inserts": [[0, 4]]})
+    a.apply(d1)
+    b.apply(DeltaBatch.from_dict({"edge_inserts": [[0, 3]]}))
+    assert a.digest() == b.digest()
+    c = GraphSession("c", _tiny(), k=2)
+    c.apply(d2)
+    assert c.digest() != a.digest()
+
+
+def test_chain_digest_never_aliases_plain_digests():
+    """The anti-aliasing guard: a (possibly poisoned) chain digest
+    lives in the `dyn:`-prefixed domain, plain full_graph_digest
+    values are bare hex — no differing graph's exact digest can ever
+    equal a session's chain identity."""
+    s = GraphSession("a", _tiny(), k=2)
+    s.apply(DeltaBatch.from_dict({"edge_inserts": [[0, 3]]}))
+    assert s.digest().startswith("dyn:")
+    other = caching.full_graph_digest(make_rgg2d(256, avg_degree=4,
+                                                 seed=1))
+    assert not other.startswith("dyn:")
+    assert s.digest() != other
+    # poisoning the chain keeps it in the dyn: domain via the stamp
+    assert caching.full_graph_digest(s.graph) == s.digest()
+
+
+def test_mutate_digest_is_chain_not_rehash():
+    """full_graph_digest on a session graph reads the stamped chain —
+    the digest must change with the chain even though the adjacency
+    bytes also changed, and must NOT equal the raw re-hash (which
+    would mean the O(m) sweep ran)."""
+    s = GraphSession("a", _graph(), k=K)
+    s.apply(random_delta_batch(s.graph, seed=5, edge_churn=0.005))
+    stamped = caching.full_graph_digest(s.graph)
+    assert stamped == s.digest()
+    # the raw adjacency re-hash of the same object (stamp removed)
+    raw_copy = from_edge_list(s.graph.n, np.stack(
+        [s.graph.edge_sources(), s.graph.adjncy], axis=1),
+        edge_weights=s.graph.edge_weight_array(), symmetrize=False)
+    assert caching.full_graph_digest(raw_copy) != stamped
+
+
+def test_repartition_points_fork_the_chain():
+    """Two histories with the SAME deltas but different repartition
+    points must not share an identity (the partition state is part of
+    the session's cache identity)."""
+    d1 = {"edge_inserts": [[0, 3]]}
+    d2 = {"edge_inserts": [[0, 4]]}
+    a = GraphSession("a", _tiny(), k=2)
+    a.apply(DeltaBatch.from_dict(d1))
+    a.commit_partition(np.array([0, 0, 0, 1, 1, 1], np.int32), cut=2)
+    a.apply(DeltaBatch.from_dict(d2))
+    b = GraphSession("b", _tiny(), k=2)
+    b.apply(DeltaBatch.from_dict(d1))
+    b.apply(DeltaBatch.from_dict(d2))
+    assert a.digest() != b.digest()
+
+
+def test_session_fingerprint_keys_checkpoints_and_cache():
+    s = GraphSession("a", _graph(), k=K)
+    assert graph_fingerprint(s.graph) == s.fingerprint()
+    ctx = create_context_by_preset_name("default")
+    key0 = caching.result_cache_key(s.graph, ctx)
+    s.apply(random_delta_batch(s.graph, seed=5, edge_churn=0.005))
+    key1 = caching.result_cache_key(s.graph, ctx)
+    assert key0 != key1 and key0[1] == key1[1]
+    assert graph_fingerprint(s.graph) == s.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# seeding + the warm/cold/replica policy
+# ---------------------------------------------------------------------------
+
+
+def test_seed_new_vertices_majority_and_fill():
+    g = from_edge_list(7, np.array(
+        [[0, 1], [1, 2], [3, 4], [4, 5], [2, 6], [1, 6]]))
+    part = np.array([0, 0, 0, 1, 1, 1, -1], dtype=np.int32)
+    seeded, cnt = seed_new_vertices(g, part, k=2)
+    assert cnt == 1 and seeded[6] == 0  # both neighbors in block 0
+    # an isolated newcomer falls back to headroom fill
+    g2 = from_edge_list(5, np.array([[0, 1], [2, 3]]))
+    part2 = np.array([0, 0, 1, 1, -1], dtype=np.int32)
+    seeded2, cnt2 = seed_new_vertices(
+        g2, part2, k=2, max_block_weights=np.array([3, 3]))
+    assert cnt2 == 1 and seeded2[4] in (0, 1)
+    # a chain of newcomers resolves over the bounded passes
+    g3 = from_edge_list(4, np.array([[0, 1], [1, 2], [2, 3]]))
+    part3 = np.array([0, 0, -1, -1], dtype=np.int32)
+    seeded3, _ = seed_new_vertices(g3, part3, k=2)
+    assert (seeded3 >= 0).all()
+
+
+def test_warm_decision_low_drift():
+    s = _partitioned_session()
+    s.apply(random_delta_batch(s.graph, seed=11, edge_churn=0.005))
+    ctx = create_context_by_preset_name("default")
+    out = repartition(s, ctx, k=K, seed=1)
+    assert out.mode == "warm"
+    assert out.drift is not None and out.drift < ctx.dynamic.drift_threshold
+    assert out.feasible
+    # 2 = the committed initial partition + this repartition
+    assert s.repartitions == 2 and s.last_cut == out.cut
+    ev = [e for e in telemetry.events("dynamic")
+          if e.attrs.get("action") == "repartition"]
+    assert ev and ev[-1].attrs["mode"] == "warm"
+
+
+def test_drift_exceeds_threshold_on_uniform_churn():
+    """The cheap half of the cold-decision story (the full compute is
+    the slow-marked test below): adversarial uniform churn lands above
+    the default drift threshold."""
+    s = _partitioned_session()
+    s.apply(random_delta_batch(s.graph, seed=12, edge_churn=1.0,
+                               insert_frac=1.0, uniform_frac=1.0))
+    ctx = create_context_by_preset_name("default")
+    assert s.drift_estimate() > ctx.dynamic.drift_threshold
+
+
+@pytest.mark.slow  # a full-size cold run on a churn-doubled graph —
+# the decision threshold itself is asserted by the cheap test above
+def test_cold_decision_high_drift():
+    s = _partitioned_session()
+    # adversarial uniform churn at high volume: drift above threshold
+    s.apply(random_delta_batch(s.graph, seed=12, edge_churn=1.0,
+                               insert_frac=1.0, uniform_frac=1.0))
+    ctx = create_context_by_preset_name("default")
+    assert s.drift_estimate() > ctx.dynamic.drift_threshold
+    out = repartition(s, ctx, k=K, seed=1)
+    assert out.mode == "cold" and out.feasible
+
+
+def test_replica_race_keeps_better_cut():
+    s = _partitioned_session()
+    s.apply(random_delta_batch(s.graph, seed=13, edge_churn=0.005))
+    ctx = create_context_by_preset_name("default")
+    ctx.dynamic.replicas = 2
+    out = repartition(s, ctx, k=K, seed=1)
+    assert out.mode == "replica"
+    assert len(out.replica_cuts) == 2
+    assert out.cut == min(out.replica_cuts) or out.cut in out.replica_cuts
+    assert out.warm_wall_s is not None and out.cold_wall_s is not None
+
+
+def test_warm_preserves_cut_on_unchanged_graph():
+    s = _partitioned_session()
+    before = s.last_cut
+    ctx = create_context_by_preset_name("default")
+    out = repartition(s, ctx, k=K, seed=1)
+    assert out.mode == "warm"
+    # a refinement-only warm pass over an already-refined partition
+    # must not regress the cut past the diff gate
+    assert out.cut <= before * (1.0 + ctx.dynamic.cut_gate_threshold)
+    assert out.stable is not False or out.escalated
+
+
+# ---------------------------------------------------------------------------
+# chain driver: determinism + mid-chain kill-and-resume (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _chain_ctx(ckpt_dir=None, resume=False):
+    ctx = create_context_by_preset_name("default")
+    if ckpt_dir is not None:
+        ctx.resilience.checkpoint_dir = str(ckpt_dir)
+        ctx.resilience.resume = resume
+    return ctx
+
+
+def test_chain_kill_and_resume_cut_identical(tmp_path):
+    g = _graph()
+    batches = synth_chain(g, steps=3, seed=50, edge_churn=0.01,
+                          vertex_adds_every=2)
+
+    # reference: the uninterrupted chain
+    part_ref, section_ref = run_chain(
+        g, batches, _chain_ctx(tmp_path / "ref"), k=K, seed=1)
+    cuts_ref = section_ref["cut_trajectory"]
+    assert len(cuts_ref) == 4
+
+    # the same chain, hard-killed at step 1's warm v-cycle barrier
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    os.environ[ckpt_mod.STOP_AT_ENV] = "vcycle:0!"
+    try:
+        with pytest.raises(SimulatedPreemption):
+            run_chain(make_rgg2d(N, avg_degree=8, seed=3), batches,
+                      _chain_ctx(tmp_path / "kill"), k=K, seed=1)
+    finally:
+        os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+
+    # resume: fast-forwards the completed steps, re-enters the killed
+    # one through the facade's own manifest — cut-identical throughout
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    part_res, section_res = run_chain(
+        make_rgg2d(N, avg_degree=8, seed=3), batches,
+        _chain_ctx(tmp_path / "kill", resume=True), k=K, seed=1)
+    assert section_res["cut_trajectory"] == cuts_ref
+    assert np.array_equal(part_res, part_ref)
+    assert (section_res["sessions"][0]["chain"]
+            == section_ref["sessions"][0]["chain"])
+    # the durable resume record (the chain-resume event is wiped by
+    # the next compute's stream reset)
+    assert section_res["resumed_from_step"] == 0
+    assert "resumed_from_step" not in section_ref
+
+
+@pytest.mark.slow  # the mid-chain kill test above covers the resume
+# machinery; this adds the register-barrier variant (runs in plain
+# pytest, like the dist suite's slow marks — tier-1 budget)
+def test_chain_kill_during_register_resumes(tmp_path):
+    """The register step owns the telemetry/checkpoint stream like any
+    single-shot run (no wrapping timer scope — GLOBAL_TIMER.idle()
+    decides stream ownership): a hard kill at its result barrier
+    resumes instantly from the snapshot, cut-identical."""
+    g = _graph()
+    batches = synth_chain(g, steps=1, seed=55, edge_churn=0.01)
+    part_ref, sec_ref = run_chain(
+        g, batches, _chain_ctx(tmp_path / "ref"), k=K, seed=1)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    os.environ[ckpt_mod.STOP_AT_ENV] = "result!"
+    try:
+        with pytest.raises(SimulatedPreemption):
+            run_chain(make_rgg2d(N, avg_degree=8, seed=3), batches,
+                      _chain_ctx(tmp_path / "kill"), k=K, seed=1)
+    finally:
+        os.environ.pop(ckpt_mod.STOP_AT_ENV, None)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    part_res, sec_res = run_chain(
+        make_rgg2d(N, avg_degree=8, seed=3), batches,
+        _chain_ctx(tmp_path / "kill", resume=True), k=K, seed=1)
+    assert sec_res["cut_trajectory"] == sec_ref["cut_trajectory"]
+    assert np.array_equal(part_res, part_ref)
+
+
+def test_chain_resume_replays_batches_without_drift_inflation(tmp_path):
+    """A resume whose fast-forward REPLAYS applied deltas (kill after
+    step 2 of 3) must land on the same decisions as the uninterrupted
+    chain — in particular the recomputed step's drift must NOT be
+    inflated by the replayed delta mass (the accumulators are reset to
+    the committed-step boundary)."""
+    g = _graph()
+    batches = synth_chain(g, steps=3, seed=70, edge_churn=0.01)
+    part_ref, sec_ref = run_chain(
+        g, batches, _chain_ctx(tmp_path / "ref"), k=K, seed=1)
+    # simulate a kill BETWEEN steps 2 and 3: run the truncated chain
+    # (its chain state records step 2), then resume with the full list
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    run_chain(make_rgg2d(N, avg_degree=8, seed=3), batches[:2],
+              _chain_ctx(tmp_path / "kill"), k=K, seed=1)
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable()
+    part_res, sec_res = run_chain(
+        make_rgg2d(N, avg_degree=8, seed=3), batches,
+        _chain_ctx(tmp_path / "kill", resume=True), k=K, seed=1)
+    assert sec_res["resumed_from_step"] == 2
+    assert sec_res["cut_trajectory"] == sec_ref["cut_trajectory"]
+    assert np.array_equal(part_res, part_ref)
+    # the recomputed step's decision row must MATCH the reference —
+    # drift included (the inflation bug flipped warm to cold here)
+    ref_row = sec_ref["decisions"][3]
+    res_row = sec_res["decisions"][3]
+    assert res_row["mode"] == ref_row["mode"] == "warm"
+    assert res_row["drift"] == pytest.approx(ref_row["drift"])
+
+
+def test_chain_state_mismatch_restarts_cleanly(tmp_path):
+    g = _graph()
+    batches = synth_chain(g, steps=1, seed=60, edge_churn=0.005)
+    part1, sec1 = run_chain(g, batches, _chain_ctx(tmp_path), k=K,
+                            seed=1)
+    # poison the stored chain hash: resume must NOT trust the state
+    jpath = tmp_path / "dynamic" / "chain-state.json"
+    state = json.loads(jpath.read_text())
+    state["chain"] = "poisoned"
+    jpath.write_text(json.dumps(state))
+    part2, sec2 = run_chain(
+        make_rgg2d(N, avg_degree=8, seed=3), batches,
+        _chain_ctx(tmp_path, resume=True), k=K, seed=1)
+    # a clean restart reproduces the deterministic chain
+    assert sec2["cut_trajectory"] == sec1["cut_trajectory"]
+    assert np.array_equal(part1, part2)
+
+
+# ---------------------------------------------------------------------------
+# serving surface
+# ---------------------------------------------------------------------------
+
+
+def test_serving_session_kinds():
+    from kaminpar_tpu.serving import PartitionRequest, PartitionService
+
+    svc = PartitionService("default")
+    spec = "gen:rgg2d;n=1024;avg_degree=8;seed=3"
+    recs = svc.serve([
+        PartitionRequest(spec, k=K, kind="register", session="s1",
+                         seed=1, request_id="r1"),
+        PartitionRequest(spec, k=K, kind="register", session="s1",
+                         request_id="r1b"),          # duplicate-session
+        PartitionRequest("", k=0, kind="mutate", session="s1",
+                         delta={"edge_inserts": [[0, 999]]},
+                         request_id="r2"),
+        PartitionRequest("", k=0, kind="repartition", session="s1",
+                         seed=1, request_id="r3"),
+        PartitionRequest("", k=0, kind="mutate", session="ghost",
+                         delta={"edge_inserts": [[0, 1]]},
+                         request_id="r4"),           # unknown-session
+        PartitionRequest("", k=0, kind="mutate", session="s1",
+                         delta={"edge_deletes": [[0, 999998]]},
+                         request_id="r5"),           # malformed delta
+        PartitionRequest("", k=0, kind="bogus", session="s1",
+                         request_id="r6"),
+    ])
+    by_id = {r.request_id: r for r in recs}
+    assert by_id["r1"].verdict == "served" and by_id["r1"].cut >= 0
+    assert by_id["r1b"].verdict == "rejected"
+    assert by_id["r1b"].reason == "duplicate-session"
+    assert by_id["r2"].verdict == "served"
+    assert by_id["r2"].reason in ("in-place", "rebuild")
+    assert by_id["r3"].verdict == "served" and by_id["r3"].cut >= 0
+    assert by_id["r4"].reason == "unknown-session"
+    assert by_id["r5"].verdict == "failed"
+    assert by_id["r5"].reason == "malformed-input"
+    assert by_id["r6"].reason == "invalid-parameters"
+    d = svc.dynamic_summary()
+    assert d["enabled"] and d["counts"]["deltas"] == 1
+    assert len(d["sessions"]) == 1
+    assert d["sessions"][0]["repartitions"] == 2  # register + repart
+    # the failed mutate left the session consistent (still servable)
+    rec = svc.serve([PartitionRequest(
+        "", k=0, kind="repartition", session="s1", seed=1,
+        request_id="r7")])[0]
+    assert rec.verdict == "served"
+
+
+def test_serving_mutate_degradation_visible(monkeypatch):
+    """An injected dynamic-apply fault during a serving mutate must
+    surface as verdict `degraded` (the matrix row's contract), not be
+    swallowed into `served`."""
+    from kaminpar_tpu.serving import PartitionRequest, PartitionService
+
+    svc = PartitionService("default")
+    svc.serve([PartitionRequest(
+        "gen:rgg2d;n=1024;avg_degree=8;seed=3", k=K, kind="register",
+        session="s1", seed=1, request_id="reg")])
+    monkeypatch.setenv(resilience.FAULTS_ENV_VAR, "dynamic-apply:nth=1")
+    rec = svc.serve([PartitionRequest(
+        "", k=0, kind="mutate", session="s1",
+        delta={"edge_inserts": [[0, 999]]}, request_id="mut")])[0]
+    assert rec.verdict == "degraded"
+    assert rec.degraded_sites == ["dynamic-apply"]
+    assert rec.reason == "rebuild"
+
+
+def test_serving_session_epsilon_sticks():
+    """A repartition request without an explicit epsilon reuses the
+    epsilon the session was REGISTERED with, not the wire default."""
+    from kaminpar_tpu.serving import PartitionRequest, PartitionService
+
+    svc = PartitionService("default")
+    recs = svc.serve([
+        PartitionRequest(
+            "gen:rgg2d;n=1024;avg_degree=8;seed=3", k=K,
+            kind="register", session="s1", epsilon=0.2, seed=1,
+            request_id="reg"),
+        PartitionRequest("", k=0, kind="repartition", session="s1",
+                         epsilon=None, seed=1, request_id="rep"),
+    ])
+    assert [r.verdict for r in recs] == ["served", "served"]
+    assert svc._sessions["s1"].epsilon == 0.2
+
+
+def test_serving_process_isolation_rejects_sessions():
+    from kaminpar_tpu.serving import (
+        PartitionRequest,
+        PartitionService,
+        ServiceConfig,
+    )
+
+    svc = PartitionService("default", ServiceConfig(isolation="process"))
+    try:
+        rec = svc.submit(PartitionRequest(
+            "gen:rgg2d;n=256;avg_degree=4;seed=1", k=2,
+            kind="register", session="s1"))
+        assert rec is not None and rec.reason == "session-isolation"
+    finally:
+        svc.close()
+
+
+# ---------------------------------------------------------------------------
+# report surface (schema v11) + pad slack (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(_REPO, "scripts", "check_report_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dynamic_section_schema_valid():
+    s = _partitioned_session()
+    s.apply(random_delta_batch(s.graph, seed=21, edge_churn=0.005))
+    out = repartition(s, create_context_by_preset_name("default"),
+                      k=K, seed=1)
+    section = summarize([s], [
+        {"session": s.id, "step": 0, "mode": "cold", "drift": None,
+         "cut_before": None, "cut": 10, "feasible": True,
+         "stable": None, "escalated": False, "seeded": 0,
+         "wall_s": 0.1, "warm_wall_s": None, "cold_wall_s": 0.1},
+        {**out.to_row(s.id, step=1), "in_place": True},
+    ])
+    telemetry.annotate(dynamic=section)
+    checker = _checker()
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH, build_run_report
+
+    report = build_run_report()
+    assert report["schema_version"] == 11
+    assert report["dynamic"]["enabled"]
+    schema = json.load(open(SCHEMA_PATH))
+    errors = (checker.validate_instance(report["dynamic"],
+                                        schema["properties"]["dynamic"])
+              + checker.version_checks(report))
+    assert errors == [], errors
+
+
+def test_report_dynamic_disabled_default():
+    from kaminpar_tpu.telemetry.report import build_run_report
+
+    telemetry.annotate(result={"cut": 0, "imbalance": 0.0,
+                               "feasible": True})
+    report = build_run_report()
+    assert report["dynamic"] == {"enabled": False}
+
+
+def test_pad_slack_rows_and_totals():
+    from kaminpar_tpu.telemetry import perf
+
+    perf.record_padding(n=100, n_pad=256, m=400, m_pad=512, k=4, k_pad=4)
+    snap = perf.snapshot()
+    row = snap["pad_waste"][0]
+    assert row["n_slack"] == 156 and row["m_slack"] == 112
+    assert row["k_slack"] == 0
+    assert snap["totals"]["pad_slack_axes"] == {"n": 156, "m": 112,
+                                                "k": 0}

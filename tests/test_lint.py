@@ -38,6 +38,10 @@ BAD_EXPECT = {
     # the PR-13 streaming hook shape: chunk decode + moved-count pulls
     # lexically inside a driver's stream span
     "r1_stream_bad.py": [("R1", 19), ("R1", 21)],
+    # the PR-15 dynamic delta-apply hook shape: the host CSR patch
+    # pull + cut readback lexically inside a driver's dynamic-apply
+    # span
+    "r1_dynamic_bad.py": [("R1", 19), ("R1", 21)],
     # the PR-14 supervision hook shape: liveness "proof" pulls device
     # state lexically inside the guarded driver span (the watchdog/
     # heartbeat hooks are host-side bookkeeping and read no device
@@ -59,6 +63,7 @@ def test_rule_fires_on_bad_fixture(name):
 
 @pytest.mark.parametrize(
     "name", ["r1_good.py", "r1_quality_good.py", "r1_stream_good.py",
+             "r1_dynamic_good.py",
              "r1_supervisor_good.py", "r2_good.py",
              "r3_good.py", "r4_good.py", "r5_good.py", "r6_good.py"]
 )

@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 10
+    assert loaded["schema_version"] == 11
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -675,13 +675,20 @@ def test_schema_accepts_v1_through_v7(tmp_path):
     v10_missing = dict(v9, schema_version=10)
     assert any("supervision" in e
                for e in checker.version_checks(v10_missing))
-    v10 = dict(v10_missing, supervision={"enabled": False})
+    v10 = checker._minimal_v10_report()
     assert checker.validate_instance(v10, schema) == []
     assert checker.version_checks(v10) == []
-    # v11 is not a known version
-    v11 = dict(v1, schema_version=11)
+    # v11 additionally requires the dynamic section
+    v11_missing = dict(v10, schema_version=11)
+    assert any("dynamic" in e
+               for e in checker.version_checks(v11_missing))
+    v11 = dict(v11_missing, dynamic={"enabled": False})
+    assert checker.validate_instance(v11, schema) == []
+    assert checker.version_checks(v11) == []
+    # v12 is not a known version
+    v12 = dict(v1, schema_version=12)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v11, schema))
+               for e in checker.validate_instance(v12, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
