@@ -47,6 +47,13 @@ EXTERNAL_KEYS = ("external_seconds", "stream_overlap")
 #: ABSENCE = silent coverage loss, gated by bench_trend from r06 on).
 SUPERVISED_KEYS = ("supervised_p95_ms",)
 
+#: Serving-throughput keys (round 16, fleet observatory): sliding-window
+#: requests/second and mean padded-executable occupancy of the SAME
+#: supervised batch the p95 comes from — same never-vanish contract
+#: (null = skipped/failed, ABSENCE = silent coverage loss, gated by
+#: bench_trend from r06 on).
+THROUGHPUT_KEYS = ("requests_per_second", "batch_occupancy")
+
 
 def supervised_key(p95_ms=None) -> dict:
     """The BENCH line's supervised-serving key; always present, null
@@ -54,11 +61,19 @@ def supervised_key(p95_ms=None) -> dict:
     return {"supervised_p95_ms": p95_ms}
 
 
+def throughput_keys(rps=None, occupancy=None) -> dict:
+    """The BENCH line's serving-throughput keys; always present, null
+    when the supervised measurement was skipped or failed."""
+    return {"requests_per_second": rps, "batch_occupancy": occupancy}
+
+
 def _measure_supervised():
-    """p95 total-latency (ms) of a 3-request supervised batch: compute
-    runs in a spawned worker under the hard wall-clock watchdog, so
-    the figure prices the containment boundary (npz exchange + worker
-    supervision) against the same graphs served inproc."""
+    """(p95_ms, rps, occupancy) of a 3-request supervised batch: compute
+    runs in a spawned worker under the hard wall-clock watchdog, so the
+    p95 prices the containment boundary (npz exchange + worker
+    supervision) against the same graphs served inproc; rps/occupancy
+    are the service's own throughput figures for the batch
+    (summary()["throughput"], the fleet observatory's live pair)."""
     from kaminpar_tpu.serving import (
         PartitionRequest,
         PartitionService,
@@ -80,7 +95,12 @@ def _measure_supervised():
         bad = [r.verdict for r in recs if r.verdict != "served"]
         assert not bad, f"supervised batch verdicts: {bad}"
         lat = svc.latency_summary()["phases"]["total"]
-        return lat["p95_ms"]
+        throughput = svc.throughput_summary()
+        return (
+            lat["p95_ms"],
+            throughput["requests_per_second"],
+            throughput["batch_occupancy"],
+        )
     finally:
         svc.close()
 
@@ -632,16 +652,20 @@ def _bench_line() -> dict:
     # supervised-serving latency (round 14): the containment boundary's
     # p95 — always-present key (null = skipped/failed), same r05-class
     # presence contract as the 10M/external blocks
-    sup_p95 = None
+    sup_p95 = sup_rps = sup_occ = None
     if os.environ.get("KAMINPAR_TPU_BENCH_SKIP_LARGE", "") != "1":
         try:
-            sup_p95 = _measure_supervised()
+            sup_p95, sup_rps, sup_occ = _measure_supervised()
         except Exception as e:
             import sys
 
             print(f"bench: supervised measurement failed: {e}",
                   file=sys.stderr)
     line.update(supervised_key(sup_p95))
+    # serving-throughput coverage (round 16, fleet observatory): the
+    # same batch's rps + mean executable occupancy — always-present
+    # keys (null = skipped/failed), same r05-class presence contract
+    line.update(throughput_keys(sup_rps, sup_occ))
     # dynamic-repartitioning coverage (round 15): warm-vs-cold speedup
     # and cut drift over a short delta chain — always-present keys
     # (null = skipped/failed), same r05-class presence contract

@@ -254,6 +254,13 @@ def build_parser() -> argparse.ArgumentParser:
         "via KAMINPAR_TPU_HEARTBEAT_FILE; docs/robustness.md)",
     )
     p.add_argument(
+        "--metrics-file", default=None, metavar="PATH",
+        help="export live metrics (request verdicts, rps, queue depth, "
+        "cache hit rate, comm bytes) to PATH in Prometheus text "
+        "format, rewritten atomically on a cadence (also via "
+        "KAMINPAR_TPU_METRICS_FILE; docs/observability.md)",
+    )
+    p.add_argument(
         "-T", "--timers", action="store_true", help="print the timer tree"
     )
     p.add_argument(
@@ -442,6 +449,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .resilience import supervisor as supervisor_mod
 
         supervisor_mod.set_heartbeat(args.heartbeat_file)
+
+    # live metrics export (telemetry/metrics.py): armed before the run
+    # so the cadence thread publishes scrapes while work is in flight
+    # (configure() also folds in KAMINPAR_TPU_METRICS_FILE; no-op when
+    # neither names a file — the registry stays dormant)
+    from .telemetry import metrics as metrics_mod
+
+    metrics_mod.configure(args.metrics_file)
 
     from . import telemetry
     from .utils import heap_profiler, statistics

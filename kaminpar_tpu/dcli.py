@@ -166,6 +166,13 @@ def build_parser() -> argparse.ArgumentParser:
         "supervisors can tell slow-but-alive from hung — the shm CLI's "
         "flag, honored here too (resilience/supervisor.py)",
     )
+    p.add_argument(
+        "--metrics-file", default=None, metavar="PATH",
+        help="export live metrics (per-phase collective bytes/calls "
+        "among them) to PATH in Prometheus text format on a cadence — "
+        "the shm CLI's flag, honored here too (also via "
+        "KAMINPAR_TPU_METRICS_FILE; telemetry/metrics.py)",
+    )
     from . import telemetry
 
     telemetry.add_cli_args(p)
@@ -212,6 +219,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .resilience import supervisor as supervisor_mod
 
         supervisor_mod.set_heartbeat(args.heartbeat_file)
+    from .telemetry import metrics as metrics_mod
+
+    metrics_mod.configure(args.metrics_file)
     if args.graph is None:
         print("error: no graph file given", file=sys.stderr)
         return 1

@@ -87,6 +87,9 @@ class ExternalPartitioner:
         if current is None:
             current = graph
 
+        import time as _time
+
+        t_stream0 = _time.perf_counter()
         levels_meta: List[dict] = []
         level = start_level
         stop_requested = False
@@ -143,6 +146,16 @@ class ExternalPartitioner:
             levels_meta, cmaps, graph, handoff_n=h_n, handoff_m=h_m,
             streamed=len(cmaps), resumed=start_level, k=k,
         ))
+        # request tracing: a serving request routed to the external
+        # scheme gets the stream phase as its own span (streamed level
+        # count + handoff size next to the in-core compute that follows)
+        from ..telemetry import tracing
+
+        tracing.span(
+            tracing.current(), "external-stream", start=t_stream0,
+            duration_s=_time.perf_counter() - t_stream0,
+            origin="external", streamed=len(cmaps), handoff_n=h_n,
+        )
         log_progress(
             f"external: streamed {len(cmaps)} level(s) down to "
             f"n={h_n} m={h_m}; handing off to the in-core deep pipeline"

@@ -167,6 +167,9 @@ class dKaMinPar:
         from .. import telemetry
         from ..utils.logger import output_level, set_output_level
 
+        import time as _time
+
+        t_run0 = _time.perf_counter()
         owns_stream = timer.GLOBAL_TIMER.idle()
         if owns_stream:
             from .mesh import reset_comm_log
@@ -418,6 +421,23 @@ class dKaMinPar:
                 f"RESULT cut={cut} imbalance={imbalance:.6f} "
                 f"k={k} devices={self.mesh.devices.size}"
             )
+            # request tracing (telemetry/tracing.py): when a serving
+            # request drove this compute, attach a rank-annotated span
+            # to its trace — the agreement rollup's rank model
+            # (agreement.py rank() = process_index, 0 without a live
+            # multi-process backend) so multi-rank timelines stay
+            # attributable per process
+            from ..telemetry import tracing
+            from ..utils.platform import process_index
+
+            tid = tracing.current()
+            if tid:
+                tracing.span(
+                    tid, "dist-compute", start=t_run0,
+                    duration_s=_time.perf_counter() - t_run0,
+                    origin="dist", rank=int(process_index()),
+                    devices=int(self.mesh.devices.size), k=int(k),
+                )
         finally:
             set_output_level(prior_level)
             if owns_stream:

@@ -101,25 +101,49 @@ COMM_CAVEAT = (
     "figures inside round loops are per round per device"
 )
 
-_comm_log: Dict[Tuple[str, str, tuple], List[int]] = {}
-_comm_phase: List[str] = []
-# phase name -> number of times its scope was OPENED.  A phase opened
-# more often than it traced ran (at least partly) on cached executables;
-# a phase opened with ZERO traced keys is a pure cache hit — its traffic
-# happened, but trace-time accounting cannot see it.  comm_table() marks
-# those rows explicitly (ADVICE round 5 low #4).
-_phase_opens: Dict[str, int] = {}
+class CommLog:
+    """One run's collective-traffic account, held on
+    ``runstate.current().comm`` (the PR-6 thread-local idiom): a fresh
+    RunState per run — the facades' ``deadline.begin_run`` — scopes
+    per-request comm attribution structurally, fixing the serving-layer
+    aggregation bug where one batch's requests shared a module-global
+    log (``reset_comm_log`` was never called between requests)."""
+
+    __slots__ = ("log", "phase_stack", "opens")
+
+    def __init__(self) -> None:
+        # (phase, op, traced shape) -> [traced calls, payload bytes]
+        self.log: Dict[Tuple[str, str, tuple], List[int]] = {}
+        self.phase_stack: List[str] = []
+        # phase name -> number of times its scope was OPENED.  A phase
+        # opened more often than it traced ran (at least partly) on
+        # cached executables; a phase opened with ZERO traced keys is a
+        # pure cache hit — its traffic happened, but trace-time
+        # accounting cannot see it.  comm_table() marks those rows
+        # explicitly (ADVICE round 5 low #4).
+        self.opens: Dict[str, int] = {}
+
+
+def _comm() -> CommLog:
+    """This thread's run-scoped account (created on first touch)."""
+    from ..resilience import runstate
+
+    run = runstate.current()
+    if run.comm is None:
+        run.comm = CommLog()
+    return run.comm
 
 
 @contextmanager
 def comm_phase(name: str):
     """Attribute collective traffic registered inside to phase `name`."""
-    _comm_phase.append(name)
+    c = _comm()
+    c.phase_stack.append(name)
     try:
         yield
     finally:
-        _comm_phase.pop()
-        _phase_opens[name] = _phase_opens.get(name, 0) + 1
+        c.phase_stack.pop()
+        c.opens[name] = c.opens.get(name, 0) + 1
 
 
 def account_collective(op: str, nbytes: int, shape=None) -> None:
@@ -128,13 +152,14 @@ def account_collective(op: str, nbytes: int, shape=None) -> None:
     `shape` is the traced payload shape (static at trace time); passing
     it keys the account by (phase, op, shape) so a shape-bucket retrace
     lands in its own row."""
-    if not _comm_phase:
+    c = _comm()
+    if not c.phase_stack:
         return
-    phase = _comm_phase[-1]
+    phase = c.phase_stack[-1]
     key = (phase, op, tuple(int(d) for d in shape) if shape else ())
-    entry = _comm_log.get(key)
+    entry = c.log.get(key)
     if entry is None:
-        entry = _comm_log[key] = [0, 0]
+        entry = c.log[key] = [0, 0]
         from .. import telemetry
 
         telemetry.event(
@@ -144,31 +169,49 @@ def account_collective(op: str, nbytes: int, shape=None) -> None:
             shape=list(key[2]),
             retrace=any(
                 k[0] == phase and k[1] == op and k is not key
-                for k in _comm_log
+                for k in c.log
             ),
         )
     entry[0] += 1
     entry[1] += int(nbytes)
+    from ..telemetry import metrics
+
+    if metrics.enabled():
+        metrics.inc(
+            "kmp_comm_bytes_total",
+            "Traced collective payload bytes per device, by phase "
+            "(trace-time account; see COMM_CAVEAT).",
+            value=int(nbytes), phase=phase,
+        )
+        metrics.inc(
+            "kmp_comm_calls_total",
+            "Traced collective calls, by phase (trace-time account).",
+            phase=phase,
+        )
 
 
 def reset_comm_log() -> None:
-    _comm_log.clear()
-    _phase_opens.clear()
+    """Clear THIS run's account (kept for callers that re-measure
+    within one run; a new run gets a fresh log via its RunState)."""
+    c = _comm()
+    c.log.clear()
+    c.opens.clear()
 
 
 def phase_opens() -> Dict[str, int]:
     """How many times each comm_phase scope was opened (run-report
     `comm.phase_opens`; compare against per-phase traced_calls to spot
     executable-cache reuse)."""
-    return dict(_phase_opens)
+    return dict(_comm().opens)
 
 
 def cache_hit_phases() -> List[str]:
     """Phases that were opened but traced NO collective: their programs
     were executable-cache hits, so the account shows zero bytes for
     traffic that really happened."""
-    traced = {phase for (phase, _op, _shape) in _comm_log}
-    return sorted(p for p in _phase_opens if p not in traced)
+    c = _comm()
+    traced = {phase for (phase, _op, _shape) in c.log}
+    return sorted(p for p in c.opens if p not in traced)
 
 
 def comm_records() -> List[dict]:
@@ -181,8 +224,23 @@ def comm_records() -> List[dict]:
             "traced_calls": calls,
             "payload_bytes_per_device": nbytes,
         }
-        for (phase, op, shape), (calls, nbytes) in sorted(_comm_log.items())
+        for (phase, op, shape), (calls, nbytes)
+        in sorted(_comm().log.items())
     ]
+
+
+def comm_phase_totals() -> Dict[str, Dict[str, int]]:
+    """Per-phase rollup of the account ({phase: {bytes_total, calls}})
+    — the run report's `comm.phases` rows and the MULTICHIP bench
+    line's per-phase keys."""
+    totals: Dict[str, Dict[str, int]] = {}
+    for (phase, _op, _shape), (calls, nbytes) in sorted(
+        _comm().log.items()
+    ):
+        t = totals.setdefault(phase, {"bytes_total": 0, "calls": 0})
+        t["bytes_total"] += int(nbytes)
+        t["calls"] += int(calls)
+    return totals
 
 
 def comm_table() -> str:
@@ -190,8 +248,9 @@ def comm_table() -> str:
     inside round loops the figures are per round per device).  Phases
     whose scope was opened but traced nothing are listed explicitly as
     cache hits instead of being indistinguishable from silent phases."""
+    c = _comm()
     hit_phases = cache_hit_phases()
-    if not _comm_log and not hit_phases:
+    if not c.log and not hit_phases:
         return "(comm accounting: no collectives traced)"
     lines = [
         f"(caveat: {COMM_CAVEAT})",
@@ -199,7 +258,7 @@ def comm_table() -> str:
         "payload bytes/device",
     ]
     phase_calls: Dict[str, int] = {}
-    for (phase, op, shape), (calls, nbytes) in sorted(_comm_log.items()):
+    for (phase, op, shape), (calls, nbytes) in sorted(c.log.items()):
         shp = "x".join(str(d) for d in shape) if shape else "-"
         lines.append(f"{phase} | {op} | {shp} | {calls} | {nbytes}")
         phase_calls[phase] = phase_calls.get(phase, 0) + calls
@@ -207,7 +266,7 @@ def comm_table() -> str:
     # nothing (per-row comparison would mislabel a phase that traces a
     # different shape on each opening); one summary line per such phase
     for phase, total in sorted(phase_calls.items()):
-        opens = _phase_opens.get(phase, 0)
+        opens = c.opens.get(phase, 0)
         if opens > total:
             lines.append(
                 f"{phase} | (partly cache-hit: opened {opens}x, traced "
@@ -217,7 +276,7 @@ def comm_table() -> str:
     for phase in hit_phases:
         lines.append(
             f"{phase} | (cache-hit: executable reused, traffic not "
-            f"re-traced) | - | 0 | 0 (opened {_phase_opens[phase]}x)"
+            f"re-traced) | - | 0 | 0 (opened {c.opens[phase]}x)"
         )
     return "\n".join(lines)
 

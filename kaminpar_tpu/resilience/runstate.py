@@ -48,7 +48,7 @@ class RunState:
     __slots__ = (
         "budget_s", "grace_s", "t0", "deadline", "stop", "reason",
         "stage", "stage_at_stop", "announced", "manager", "suspend",
-        "memory", "dist",
+        "memory", "dist", "comm",
     )
 
     def __init__(self) -> None:
@@ -73,6 +73,11 @@ class RunState:
         # only by the stream-owning dist driver, None for shm runs —
         # the barrier audit piggyback reads this slot and returns
         self.dist = None  # Optional[agreement.AuditState]
+        # collective-traffic accounting (parallel/mesh.py CommLog):
+        # created lazily on the first account_collective/comm_phase
+        # touch, so a fresh RunState per run scopes per-request comm
+        # attribution for free (the serving layer's isolation fix)
+        self.comm = None  # Optional[mesh.CommLog]
 
 
 _tls = threading.local()

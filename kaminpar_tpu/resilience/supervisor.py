@@ -539,13 +539,16 @@ class WorkerPool:
 
     def run_request(self, request_id: str, source, graph, ctx,
                     k: int, epsilon: float, seed: Optional[int],
-                    ceiling_s: Optional[float]):
+                    ceiling_s: Optional[float], trace: bool = False):
         """Run one request in the supervised worker.  Returns
         ``(partition ndarray, info dict)``; raises StageHang (site
         ``worker-hang``) on a hang-kill, WorkerCrash on a worker death,
         and the *re-raised classified type* for marshalled in-worker
         failures (a ladder-retryable DeviceOOM stays a retryable
-        DeviceOOM — it must never read as a crash)."""
+        DeviceOOM — it must never read as a crash).  With ``trace``
+        set, the worker marshals its depth-1 telemetry spans back as
+        ``trace_spans`` rows on the result (telemetry/tracing.py's
+        worker-boundary contract)."""
         from . import faults
         from .errors import StageHang, WorkerCrash
 
@@ -596,6 +599,7 @@ class WorkerPool:
                     "ceiling_s": float(ceiling_s) if ceiling_s else None,
                     "chaos": chaos,
                     "result_path": result_path,
+                    "trace": bool(trace),
                 })
             except (OSError, ValueError, BrokenPipeError):
                 # the worker died between the liveness check and the send
@@ -920,6 +924,23 @@ def _worker_compute(msg: dict, send) -> dict:
         msg["result_path"],
         {"partition": np.asarray(part, dtype=np.int32)},
     )
+    wall_s = _time.perf_counter() - t0
+    # the worker's own span rows for the request trace (fleet
+    # observatory): its depth-1 telemetry scopes plus one whole-compute
+    # row, all worker-relative ms — the parent re-bases them into the
+    # request timeline (tracing.record_worker_reply)
+    trace_spans = None
+    if msg.get("trace"):
+        from ..telemetry import tracing
+
+        trace_spans = tracing.harvest_worker_rows()
+        trace_spans.insert(0, {
+            "name": "worker-compute",
+            "origin": "worker",
+            "start_ms": 0.0,
+            "duration_ms": round(wall_s * 1000.0, 3),
+            "attrs": {"worker_pid": os.getpid()},
+        })
     return {
         "type": "result",
         "path": msg["result_path"],
@@ -933,7 +954,8 @@ def _worker_compute(msg: dict, send) -> dict:
         "degraded_sites": degraded,
         "anytime": solver.last_anytime,
         "rss_bytes": _self_rss_bytes(),
-        "wall_s": _time.perf_counter() - t0,
+        "wall_s": wall_s,
+        "trace_spans": trace_spans,
     }
 
 

@@ -27,8 +27,9 @@ ServiceConfig` fields (``max_queue_depth``, ``max_queued_cost``,
 ``max_request_cost``, ``result_cache_entries``, ``result_cache_bytes``,
 ``default_deadline_s``, and the supervision knobs ``isolation``,
 ``hard_deadline_s``, ``hard_deadline_factor``, ``worker_max_requests``,
-``worker_rss_limit_bytes``, ``heartbeat_file``; the CLI flags
-``--serve-isolation`` / ``--heartbeat-file`` override the spec).
+``worker_rss_limit_bytes``, ``heartbeat_file``, ``metrics_file``; the
+CLI flags ``--serve-isolation`` / ``--heartbeat-file`` /
+``--metrics-file`` override the spec).
 
 Exit-code contract: the PROCESS outcome, not the per-request outcomes —
 isolated request failures and admission rejections still exit 0 (that is
@@ -188,6 +189,8 @@ def run_batch_cli(args, ctx) -> int:
         config.isolation = str(args.serve_isolation)
     if getattr(args, "heartbeat_file", None):
         config.heartbeat_file = str(args.heartbeat_file)
+    if getattr(args, "metrics_file", None):
+        config.metrics_file = str(args.metrics_file)
 
     service = PartitionService(ctx, config, quiet=True)
     t0 = time.perf_counter()
@@ -234,17 +237,20 @@ def run_batch_cli(args, ctx) -> int:
         total_hist = (
             summary.get("latency", {}).get("phases", {}).get("total", {})
         )
+        throughput = summary.get("throughput", {})
         print(
             "SERVING total={} served={} anytime={} degraded={} "
             "rejected={} failed={} worker_hang={} worker_crash={} "
-            "cache_hit_rate={} p50_ms={} p95_ms={} drained={} "
-            "wall={:.3f}s".format(
+            "cache_hit_rate={} p50_ms={} p95_ms={} rps={} "
+            "queue_peak={} drained={} wall={:.3f}s".format(
                 len(records), counts["served"], counts["anytime"],
                 counts["degraded"], counts["rejected"], counts["failed"],
                 counts.get("worker-hang", 0),
                 counts.get("worker-crash", 0),
                 summary["cache"]["hit_rate"],
                 total_hist.get("p50_ms"), total_hist.get("p95_ms"),
+                throughput.get("requests_per_second"),
+                throughput.get("queue_peak"),
                 int(summary["drained"]), wall,
             )
         )

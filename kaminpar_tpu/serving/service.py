@@ -212,6 +212,11 @@ class ServiceConfig:
     #: liveness heartbeat file (also settable via --heartbeat-file /
     #: KAMINPAR_TPU_HEARTBEAT_FILE); "" = disabled
     heartbeat_file: str = ""
+    #: live metrics export file (Prometheus text format, rewritten
+    #: atomically on a cadence; also settable via --metrics-file /
+    #: KAMINPAR_TPU_METRICS_FILE); "" = disabled — the registry stays
+    #: dormant and costs one attribute read per producer call
+    metrics_file: str = ""
 
 
 class PartitionService:
@@ -294,6 +299,24 @@ class PartitionService:
         )
         if self.config.heartbeat_file:
             supervisor_mod.set_heartbeat(self.config.heartbeat_file)
+        # live metrics export (telemetry/metrics.py): dormant unless a
+        # file is configured here or via KAMINPAR_TPU_METRICS_FILE —
+        # configure() resolves arg-then-env and is a no-op otherwise
+        from ..telemetry import metrics as metrics_mod
+
+        metrics_mod.configure(self.config.metrics_file or None)
+        # throughput accounting for summary()["throughput"] and the
+        # SERVING stdout line — service-local (NOT the live registry),
+        # so it works with metrics export dormant
+        self._rate = metrics_mod.WindowRate(
+            "serving_rps", "service-local throughput window")
+        self._queue_peak = 0
+        self._occupancy_sum = 0.0
+        self._occupancy_n = 0
+        # per-request trace ids (telemetry/tracing.py): created at
+        # admission when tracing is active, popped when the verdict's
+        # phase spans are recorded
+        self._trace_ids: Dict[str, str] = {}
 
     # -- admission -----------------------------------------------------
 
@@ -473,12 +496,40 @@ class PartitionService:
                 self._order[req.request_id] = next(self._seq)
                 self._submit_class[req.request_id] = cls
                 self._submit_t[req.request_id] = time.perf_counter()
+                depth = len(self._queue)
+                self._queue_peak = max(self._queue_peak, depth)
                 rec = None
+        from ..telemetry import metrics as metrics_mod
+        from ..telemetry import tracing
+
+        if metrics_mod.enabled():
+            metrics_mod.set_gauge(
+                "kmp_queue_depth", depth,
+                "Requests admitted but not yet executed.")
+            metrics_mod.set_gauge(
+                "kmp_queue_peak", self._queue_peak,
+                "Peak queue depth observed this process.")
         if rec is not None:
             telemetry.event(
                 "serving", action="rejected", request=req.request_id,
                 reason=reason, queue_depth=depth,
             )
+            if metrics_mod.enabled():
+                metrics_mod.inc(
+                    "kmp_requests_total",
+                    "Requests by final verdict.", 1.0,
+                    verdict="rejected")
+        else:
+            tid = tracing.new_trace(
+                req.request_id, k=int(req.k or 0),
+                kind=getattr(req, "kind", "partition"),
+            )
+            if tid:
+                self._trace_ids[req.request_id] = tid
+                tracing.span(
+                    tid, "admission", duration_s=0.0,
+                    cls=cls, queue_depth=depth,
+                )
         return rec
 
     # -- execution -----------------------------------------------------
@@ -511,11 +562,107 @@ class PartitionService:
                     time.perf_counter() - submit_t
                     if submit_t is not None else 0.0
                 )
-                rec = self._execute(req, cls_submit, wait_s)
+                # deep layers (dist rank rollup, dynamic session
+                # commits) attach their spans to the current trace
+                from ..telemetry import tracing
+
+                tracing.set_current(
+                    self._trace_ids.get(req.request_id, "")
+                )
+                try:
+                    rec = self._execute(req, cls_submit, wait_s)
+                finally:
+                    tracing.set_current("")
             with self._lock:
                 self._records.append(rec)
             done.append(rec)
+            self._request_done(rec)
         return done
+
+    def _request_done(self, rec: RequestRecord) -> None:
+        """Per-verdict bookkeeping after one EXECUTED request (drain
+        rejections included; admission rejections are counted at
+        submit): throughput window, batch occupancy, the live metrics
+        registry, and the trace verdict annotation."""
+        from ..telemetry import metrics as metrics_mod
+        from ..telemetry import tracing
+
+        self._rate.mark()
+        occ = None
+        if rec.bucket and rec.n >= 0:
+            try:
+                # bucket_key pads n+1 node slots; occupancy is how much
+                # of the padded executable this request actually filled
+                n_pad = int(rec.bucket.split("/")[0])
+                occ = min(1.0, float(rec.n + 1) / float(n_pad))
+            except (ValueError, ZeroDivisionError):
+                occ = None
+            if occ is not None:
+                self._occupancy_sum += occ
+                self._occupancy_n += 1
+        tid = self._trace_ids.pop(rec.request_id, "")
+        if tid:
+            tracing.annotate(
+                tid, verdict=rec.verdict,
+                **({"reason": rec.reason} if rec.reason else {}),
+            )
+        if not metrics_mod.enabled():
+            return
+        metrics_mod.inc(
+            "kmp_requests_total", "Requests by final verdict.", 1.0,
+            verdict=rec.verdict)
+        metrics_mod.mark(
+            "kmp_requests_per_second",
+            "Requests completed, per second over a sliding window.")
+        with self._lock:
+            depth = len(self._queue)
+        metrics_mod.set_gauge(
+            "kmp_queue_depth", depth,
+            "Requests admitted but not yet executed.")
+        if self._occupancy_n:
+            metrics_mod.set_gauge(
+                "kmp_batch_occupancy",
+                round(self._occupancy_sum / self._occupancy_n, 4),
+                "Mean padded-executable fill fraction of executed "
+                "requests.")
+        metrics_mod.set_gauge(
+            "kmp_cache_hit_rate",
+            float(self._result_cache.stats()["hit_rate"]),
+            "Result-cache hit rate (lifetime).")
+        metrics_mod.set_gauge(
+            "kmp_breaker_open_classes",
+            sum(1 for v in self._class_failures.values()
+                if v >= self.config.breaker_threshold),
+            "Request classes currently rejected by the crash breaker.")
+        if self._pool is not None:
+            for event, v in self._pool.stats.items():
+                metrics_mod.set_gauge(
+                    "kmp_worker_pool", float(v),
+                    "Worker-pool lifecycle counters "
+                    "(spawned/recycled/killed/crashed/requests).",
+                    event=str(event))
+        from ..resilience import runstate as runstate_mod
+
+        gov = runstate_mod.current().memory
+        if gov is not None:
+            metrics_mod.set_gauge(
+                "kmp_governor_rung", float(gov.rung),
+                "Memory-governor degradation rung of the last run.")
+        from ..resilience import supervisor as supervisor_mod
+
+        hb = supervisor_mod.heartbeat_path()
+        if hb:
+            try:
+                import os
+
+                metrics_mod.set_gauge(
+                    "kmp_heartbeat_age_seconds",
+                    round(max(0.0, time.time() - os.path.getmtime(hb)),
+                          3),
+                    "Seconds since the liveness heartbeat file "
+                    "advanced.")
+            except OSError:
+                pass
 
     def serve(self, requests) -> List[RequestRecord]:
         """Drive a whole batch: submit() each request, draining the
@@ -857,6 +1004,20 @@ class PartitionService:
             rec.verdict = "served"
         rec.wall_s = time.perf_counter() - t0
         self._observe_latency(rec, wait_s, resolve_s, compute_s, 0.0)
+        # the trace CARRIES the session identity: every register /
+        # mutate / repartition against one GraphSession is findable by
+        # its session attr (and repartition traces say warm vs cold)
+        from ..telemetry import tracing
+
+        tid = self._trace_ids.get(req.request_id, "")
+        if tid:
+            extra = {}
+            if req.kind == "repartition" and self._dynamic_decisions:
+                extra["mode"] = self._dynamic_decisions[-1].get("mode")
+            tracing.annotate(
+                tid, session=req.session, session_kind=req.kind,
+                **extra,
+            )
         telemetry.event(
             "dynamic", action=req.kind, request=req.request_id,
             session=req.session, verdict=rec.verdict,
@@ -918,14 +1079,27 @@ class PartitionService:
                 # `worker-hang`), a worker death as WorkerCrash — both
                 # land in the isolation boundary below like any other
                 # classified failure, and the queue keeps draining
+                tid = self._trace_ids.get(req.request_id, "")
                 part, winfo = self._pool.run_request(
                     req.request_id, req.graph, graph, ctx,
                     k=int(req.k),
                     epsilon=float(req.epsilon if req.epsilon is not None
                                   else 0.03),
                     seed=req.seed, ceiling_s=rec.hard_ceiling_s,
+                    trace=bool(tid),
                 )
                 gate_s = float(winfo.get("gate_s") or 0.0)
+                if tid and winfo.get("trace_spans"):
+                    # marshal the worker-side spans into this request's
+                    # timeline: the spawn/ship overhead span first, the
+                    # worker's own scopes re-based after it
+                    from ..telemetry import tracing
+
+                    tracing.record_worker_reply(
+                        tid, winfo["trace_spans"], t_c0,
+                        time.perf_counter() - t_c0,
+                        float(winfo.get("wall_s") or 0.0),
+                    )
             else:
                 solver = KaMinPar(ctx)
                 if self.quiet:
@@ -1028,6 +1202,29 @@ class PartitionService:
             f"{name}_ms": round(v * 1000.0, 3)
             for name, v in phases.items()
         }
+        # request-trace phase spans (telemetry/tracing.py): every
+        # execution path funnels through here, so the trace timeline
+        # covers queue-wait/resolve/compute/gate for all verdicts; the
+        # gate phase includes the greedy balance repair (gate.py)
+        tid = self._trace_ids.get(rec.request_id, "")
+        if tid:
+            from ..telemetry import tracing
+
+            t_exec = time.perf_counter() - rec.wall_s
+            tracing.span(tid, "queue-wait", start=t_exec - wait_s,
+                         duration_s=wait_s)
+            tracing.span(tid, "resolve", start=t_exec,
+                         duration_s=resolve_s)
+            tracing.span(tid, "compute", start=t_exec + resolve_s,
+                         duration_s=compute_s)
+            tracing.span(tid, "gate", start=t_exec + resolve_s
+                         + compute_s, duration_s=gate_s)
+        from ..telemetry import metrics as metrics_mod
+
+        if metrics_mod.enabled():
+            metrics_mod.observe(
+                "kmp_request_latency_seconds", total_s,
+                "End-to-end request latency (admission wait included).")
         # cache hits never touch an executable (rec.bucket stays empty)
         # but still belong to their shape class for the rollup
         cls = rec.bucket or self._class_key(rec.n, rec.m, int(rec.k or 0))
@@ -1139,7 +1336,22 @@ class PartitionService:
                 "hit_rate": result_stats["hit_rate"],
             },
             "latency": self.latency_summary(),
+            "throughput": self.throughput_summary(),
             "drained": bool(self._drained),
+        }
+
+    def throughput_summary(self) -> dict:
+        """Live throughput figures (the SERVING stdout line and the
+        bench harness read these): sliding-window requests/second, the
+        peak queue depth this process observed, and the mean padded-
+        executable fill fraction (None until a sized request ran)."""
+        return {
+            "requests_per_second": round(float(self._rate.rate()), 3),
+            "queue_peak": int(self._queue_peak),
+            "batch_occupancy": (
+                round(self._occupancy_sum / self._occupancy_n, 4)
+                if self._occupancy_n else None
+            ),
         }
 
     def dynamic_summary(self) -> dict:
@@ -1166,9 +1378,15 @@ class PartitionService:
 
     def close(self) -> None:
         """Shut down the supervised worker pool (process isolation);
-        a plain inproc service has nothing to release.  Idempotent."""
+        a plain inproc service has nothing to release.  Idempotent.
+        Flushes a final metrics scrape so a scraper never misses the
+        tail of a short-lived service."""
         if self._pool is not None:
             self._pool.shutdown()
+        from ..telemetry import metrics as metrics_mod
+
+        if metrics_mod.enabled():
+            metrics_mod.write_now()
 
     def annotate(self) -> dict:
         """Stamp the serving + supervision sections into the telemetry

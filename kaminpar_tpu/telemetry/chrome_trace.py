@@ -210,7 +210,65 @@ def chrome_trace() -> dict:
                     )
         for series in payload.get("progress", []):
             trace_events.extend(_counter_events(pid, series))
+    trace_events.extend(_request_trace_events())
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+#: pid offset of the per-request trace tracks — far above any real
+#: process index so request timelines never collide with rank tracks.
+_REQUEST_PID_BASE = 1000
+
+
+def _request_trace_events() -> List[dict]:
+    """Per-request trace timelines (telemetry/tracing.py) as their own
+    Perfetto tracks: one pid per request, service spans on tid 0 and
+    worker-origin spans on tid 1 — the spawn/ship overhead span and the
+    worker's re-based scopes read directly against the service-side
+    compute span above them."""
+    from . import tracing as _tracing
+
+    out: List[dict] = []
+    for i, tr in enumerate(_tracing.traces()):
+        spans = tr.get("spans") or []
+        if not spans:
+            continue
+        pid = _REQUEST_PID_BASE + i
+        out.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"request {tr.get('request_id', '?')}"},
+            }
+        )
+        for tid, label in ((0, "service"), (1, "worker")):
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": label},
+                }
+            )
+        for s in spans:
+            out.append(
+                {
+                    "ph": "X",
+                    "cat": "request",
+                    "name": s["name"],
+                    "ts": round(float(s["start_ms"]) * 1e3, 3),
+                    "dur": round(float(s["duration_ms"]) * 1e3, 3),
+                    "pid": pid,
+                    "tid": 1 if s.get("origin") == "worker" else 0,
+                    "args": {
+                        "trace_id": tr.get("trace_id", ""),
+                        **(s.get("attrs") or {}),
+                    },
+                }
+            )
+    return out
 
 
 def write_chrome_trace(path: str) -> None:

@@ -30,6 +30,8 @@ Informational (printed, never gated):
     pad waste)
   * quality attribution deltas (schema v7 `quality` section: per-level
     coarsening_locked / refinement_left movement and verdict flips)
+  * comm-volume deltas (schema v12 `comm` section: bytes_total and
+    per-phase traced collective payload movement)
 
 Exit codes: 0 pass, 1 regression, 2 usage/IO error.  check_all.sh runs
 the self-diff (identical reports, expect 0) and a perturbed diff
@@ -405,6 +407,25 @@ def diff_reports(
             if vb is not None and vc is not None:
                 parts.append(f"{key} {vb} -> {vc}")
         lines.append(", ".join(parts))
+
+    # -- comm volume (schema v12; informational) -------------------------
+    # trace-time per-phase collective payloads: a composition change
+    # that doubles halo traffic shows up here before it shows up in
+    # wall (COMM_CAVEAT: traced bytes per device, not link-level)
+    cb_ = base.get("comm") or {}
+    cc_ = cand.get("comm") or {}
+    phb = cb_.get("phases") or {}
+    phc = cc_.get("phases") or {}
+    if phb or phc:
+        lines.append(
+            f"comm bytes_total: {cb_.get('bytes_total', 0)} -> "
+            f"{cc_.get('bytes_total', 0)}"
+        )
+        for phase in sorted(set(phb) | set(phc)):
+            vb = (phb.get(phase) or {}).get("bytes_total", 0)
+            vc = (phc.get(phase) or {}).get("bytes_total", 0)
+            if vb != vc:
+                lines.append(f"  comm {phase}: {vb} -> {vc} bytes")
     return lines, failures
 
 

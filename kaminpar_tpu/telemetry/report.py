@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -244,15 +244,34 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
     try:
         from ..parallel import mesh
 
+        phase_totals = mesh.comm_phase_totals()
         comm = {
             "caveat": mesh.COMM_CAVEAT,
             "records": mesh.comm_records(),
             # opened-vs-traced lets report consumers spot cache-hit
             # phases (opened but zero traced rows) explicitly
             "phase_opens": mesh.phase_opens(),
+            # schema v12 (additive): the per-phase rollup + grand total
+            # ROADMAP item 4 asks for — "comm bytes per phase" as a
+            # read, next to the raw per-(phase, op, shape) records
+            "phases": phase_totals,
+            "bytes_total": sum(
+                t["bytes_total"] for t in phase_totals.values()
+            ),
         }
     except Exception:  # mesh pulls in jax; stay robust without a backend
         comm = {"caveat": "comm accounting unavailable", "records": []}
+
+    # schema v12: per-request trace timelines (telemetry/tracing.py) —
+    # the serving layer's end-to-end spans (admission wait -> resolve ->
+    # compute -> gate, plus the supervised-worker boundary rows);
+    # non-serving runs carry the well-formed empty default
+    try:
+        from . import tracing as _tracing
+
+        tracing_section = _tracing.snapshot()
+    except Exception:
+        tracing_section = {"enabled": False, "traces": []}
 
     # distributed finalize: per-scope min/avg/max across processes (the
     # kaminpar-dist/timer.cc analog); on one process min == avg == max.
@@ -357,6 +376,11 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # (kaminpar_tpu/dynamic/, docs/robustness.md "Dynamic
         # sessions")
         "dynamic": dynamic,
+        # schema v12: per-request trace timelines — one row per span
+        # (name, origin service/worker, start_ms, duration_ms, attrs),
+        # per trace id; the report half of the fleet observatory
+        # (docs/observability.md "Request tracing")
+        "tracing": tracing_section,
     }
     if agg is not None:
         report["timers_aggregated"] = agg

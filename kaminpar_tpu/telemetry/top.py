@@ -216,12 +216,40 @@ def render_report(report: dict, top_n: int = DEFAULT_TOP_N) -> List[str]:
                 [[r.get("rank"), r.get("live_bytes")] for r in ranks],
             ))
 
+    # -- communication volume (schema v12 per-phase rollup) --------------
+    comm = report.get("comm") or {}
+    comm_phases = comm.get("phases") or {}
+    if comm_phases:
+        lines.append("")
+        lines.append(
+            f"comm volume: {_fmt(comm.get('bytes_total'))} bytes total "
+            "(logical, pre-padding — see comm.caveat):"
+        )
+        lines.extend(_table(
+            ["phase", "bytes", "calls"],
+            [
+                [phase, t.get("bytes_total"), t.get("calls")]
+                for phase, t in sorted(
+                    comm_phases.items(),
+                    key=lambda kv: -kv[1].get("bytes_total", 0),
+                )[:top_n]
+            ],
+        ))
+
     # -- serving latency -------------------------------------------------
     serving = report.get("serving") or {}
     latency = serving.get("latency") or {}
     phases = latency.get("phases") or {}
     if serving.get("enabled") and phases:
         lines.append("")
+        throughput = serving.get("throughput") or {}
+        if throughput:
+            lines.append(
+                "serving throughput: "
+                f"rps={_fmt(throughput.get('requests_per_second'))}, "
+                f"queue_peak={_fmt(throughput.get('queue_peak'))}, "
+                f"batch_occupancy={_fmt(throughput.get('batch_occupancy'))}"
+            )
         lines.append("serving latency (per phase):")
         lines.extend(_table(
             ["phase", "count", "p50_ms", "p95_ms", "p99_ms", "max_ms"],

@@ -84,6 +84,12 @@ SUPERVISED_COVERAGE_KEYS = ("supervised_p95_ms",)
 #: loss of the warm-repartition trend — the r05 regression class).
 DYNAMIC_COVERAGE_KEYS = ("dynamic_warm_speedup", "dynamic_cut_drift")
 
+#: Serving-throughput keys (round 16, fleet observatory): the BENCH
+#: line must always carry them from r06 on (null = the supervised
+#: batch was skipped/failed, absence = silent coverage loss of the
+#: throughput trend — the r05 regression class).
+THROUGHPUT_COVERAGE_KEYS = ("requests_per_second", "batch_occupancy")
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -94,7 +100,11 @@ ACCEL_PLATFORMS = ("tpu", "axon")
 #: kill-and-resume cut-identity probe and the agreed-OOM-ladder probe.
 #: Same presence contract as the 10M block — absence means the dryrun
 #: silently lost the coverage, which is the r05 regression class.
-MULTICHIP_COVERAGE_KEYS = ("dist_resumable=", "dist_ladder=")
+#: The comm-volume key (round 16): the dryrun tail must carry the
+#: machine-readable per-run collective rollup from r06 on.
+MULTICHIP_COVERAGE_KEYS = (
+    "dist_resumable=", "dist_ladder=", "comm_bytes_total=",
+)
 MULTICHIP_COVERAGE_SINCE = 6
 
 
@@ -257,6 +267,16 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         "overlap": overlap,
         "p95_ms": p95_ms,
         "sup_p95": parsed.get("supervised_p95_ms"),
+        # round-16 fleet observatory: promoted throughput keys first,
+        # the embedded report's serving.throughput as the fallback
+        "rps": parsed.get(
+            "requests_per_second",
+            (serving.get("throughput") or {}).get("requests_per_second"),
+        ),
+        "occupancy": parsed.get(
+            "batch_occupancy",
+            (serving.get("throughput") or {}).get("batch_occupancy"),
+        ),
         "dyn_speedup": parsed.get("dynamic_warm_speedup"),
         "dyn_drift": parsed.get("dynamic_cut_drift"),
         "schema": report.get("schema_version"),
@@ -276,8 +296,8 @@ def render(rows: List[Dict[str, Any]]) -> str:
             "coarsening_s", "lp_s", "contract_s", "engines",
             "compile_s", "cache_hit", "hbm_util",
             "pad_waste", "locked", "left", "external_s", "overlap",
-            "p95_ms", "sup_p95", "dyn_speedup", "dyn_drift",
-            "platform", "schema")
+            "p95_ms", "sup_p95", "rps", "occupancy",
+            "dyn_speedup", "dyn_drift", "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -422,6 +442,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{name}: dynamic coverage key {key!r} missing "
                         "(bench.py must emit it every run; null marks a "
                         "skipped/failed dynamic chain measurement)"
+                    )
+            for key in THROUGHPUT_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: throughput coverage key {key!r} "
+                        "missing (bench.py must emit it every run; null "
+                        "marks a skipped/failed supervised batch)"
                     )
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target

@@ -26,7 +26,12 @@
 # resilience smoke (SIGTERM a
 # mesh run mid-pipeline -> resume is CUT-IDENTICAL; a rank-scoped
 # device-oom walks the cross-rank agreed ladder; a rank-1-scoped fault
-# stays inert on rank 0), and the ROADMAP.md tier-1 pytest command.
+# stays inert on rank 0; the report's comm section carries nonzero
+# per-phase collective bytes), a fleet observatory smoke (12-request
+# process-isolated chaos batch with --metrics-file: the Prometheus
+# scrape parses, requests_total matches the verdict counts, rps > 0,
+# and the v12 report carries request traces with worker-side compute
+# spans), and the ROADMAP.md tier-1 pytest command.
 # Exits nonzero on the first failing stage.
 #
 # Usage:  scripts/check_all.sh [--fast]
@@ -38,13 +43,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/12] tpulint (vs scripts/tpulint_baseline.json) =="
+echo "== [1/13] tpulint (vs scripts/tpulint_baseline.json) =="
 python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
 
-echo "== [2/12] run-report schema (producer selftest, v1-v10 fixtures + v11 producer) =="
+echo "== [2/13] run-report schema (producer selftest, v1-v11 fixtures + v12 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
-echo "== [3/12] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
+echo "== [3/13] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
 rm -f /tmp/_kmp_chaos_report.json
 KAMINPAR_TPU_FAULTS=all:nth=1 python -m kaminpar_tpu \
     "gen:rgg2d;n=4096;avg_degree=8;seed=1" -k 4 \
@@ -112,7 +117,7 @@ print(f"quality smoke OK: {len(rows)} attribution row(s), "
       "BENCH quality keys present")
 EOF
 
-echo "== [4/12] telemetry.diff self-test + BENCH trend/kernel gate =="
+echo "== [4/13] telemetry.diff self-test + BENCH trend/kernel gate =="
 # identical reports must pass (rc 0)...
 python -m kaminpar_tpu.telemetry.diff \
     /tmp/_kmp_chaos_report.json /tmp/_kmp_chaos_report.json || exit 1
@@ -136,7 +141,7 @@ fi
 python scripts/bench_trend.py --check || exit 1
 
 
-echo "== [5/12] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
+echo "== [5/13] preempt-and-resume smoke (SIGTERM mid-run + --resume) =="
 CKPT=/tmp/_kmp_ckpt_smoke
 rm -rf "$CKPT" /tmp/_kmp_preempt1.json /tmp/_kmp_preempt2.json
 python -m kaminpar_tpu "gen:rgg2d;n=65536;avg_degree=8;seed=1" -k 8 \
@@ -176,7 +181,7 @@ print(f"resume OK: resumed from {r['checkpoint']['resumed_from']}, "
       f"cut={gate['cut_recomputed']}")
 EOF2
 
-echo "== [6/12] serving smoke (mixed batch + faults + SIGTERM drain) =="
+echo "== [6/13] serving smoke (mixed batch + faults + SIGTERM drain) =="
 SERVE_DIR=/tmp/_kmp_serve_smoke
 rm -rf "$SERVE_DIR"; mkdir -p "$SERVE_DIR"
 python - <<'EOF3' || exit 1
@@ -273,7 +278,7 @@ print(f"drain OK: counts={c} ({len(drained)} drained)")
 EOF3
 
 
-echo "== [7/12] supervision smoke (worker hang/crash containment) =="
+echo "== [7/13] supervision smoke (worker hang/crash containment) =="
 SUP_DIR=/tmp/_kmp_sup_smoke
 rm -rf "$SUP_DIR"; mkdir -p "$SUP_DIR"
 SUP_START_NS=$(python -c "import time; print(time.time_ns())")
@@ -305,7 +310,7 @@ SUP_START_NS=$SUP_START_NS python - <<'EOF7' || exit 1
 import json, os
 
 r = json.load(open("/tmp/_kmp_sup_smoke/report.json"))
-assert r["schema_version"] == 10, r["schema_version"]
+assert r["schema_version"] == 12, r["schema_version"]
 s = r["serving"]
 by_id = {q["request_id"]: q for q in s["requests"]}
 assert len(by_id) == 10, len(by_id)
@@ -343,7 +348,7 @@ print(f"supervision smoke OK: counts={s['counts']}, workers={w}, "
       f"{len(sup['hangs'])} hang(s), heartbeat={hb['count']} touch(es)")
 EOF7
 
-echo "== [8/12] memory-governor smoke (tiny budget + forced spill + serving) =="
+echo "== [8/13] memory-governor smoke (tiny budget + forced spill + serving) =="
 MEM_DIR=/tmp/_kmp_mem_smoke
 rm -rf "$MEM_DIR"; mkdir -p "$MEM_DIR"
 # an artificially small budget: 25% of the rung-0 estimate for the shape
@@ -414,7 +419,7 @@ assert by_id["oversized"]["reason"] == "insufficient-memory", by_id
 print("serving insufficient-memory OK")
 PYEOF
 
-echo "== [9/12] out-of-core streaming smoke (--scheme external) =="
+echo "== [9/13] out-of-core streaming smoke (--scheme external) =="
 EXT_DIR=/tmp/_kmp_ext_smoke
 rm -rf "$EXT_DIR"; mkdir -p "$EXT_DIR"
 # a budget at 25% of the in-core estimate: the external scheme must
@@ -432,7 +437,7 @@ python scripts/check_report_schema.py "$EXT_DIR/ref.json" || exit 1
 python - <<'PYEOF' || exit 1
 import json
 r = json.load(open("/tmp/_kmp_ext_smoke/ref.json"))
-assert r["schema_version"] == 10, r["schema_version"]
+assert r["schema_version"] == 12, r["schema_version"]
 ext = r["external"]
 # the out-of-core contract: >= 1 streamed level, the fine level NEVER
 # device-resident, and the chunk pipeline actually overlapped
@@ -476,7 +481,7 @@ print(f"external resume OK: resumed from "
       "(identical to the reference)")
 PYEOF
 
-echo "== [10/12] dynamic repartition smoke (8-delta chain + chaos + bucket crossing) =="
+echo "== [10/13] dynamic repartition smoke (8-delta chain + chaos + bucket crossing) =="
 DYN_DIR=/tmp/_kmp_dynamic_smoke
 rm -rf "$DYN_DIR"; mkdir -p "$DYN_DIR"
 # synthesize the chain OUTSIDE the fault plan (the generator applies
@@ -552,7 +557,7 @@ print(f"dynamic smoke OK: warm={counts['warm']} cold={counts['cold']} "
       f"trajectory={traj}")
 PYEOF
 
-echo "== [11/12] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
+echo "== [11/13] dist resilience smoke (preempt+resume, rank-scoped chaos) =="
 DIST_DIR=/tmp/_kmp_dist_smoke
 rm -rf "$DIST_DIR"; mkdir -p "$DIST_DIR"
 DIST_XLA="--xla_force_host_platform_device_count=8"
@@ -560,6 +565,25 @@ DGRAPH="gen:rgg2d;n=65536;avg_degree=8;seed=1"
 # reference (uninterrupted) run: the cut-identity anchor
 XLA_FLAGS="$DIST_XLA" python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
     --report-json "$DIST_DIR/ref.json" -q || exit 1
+python - <<'EOF8' || exit 1
+# v12 comm promotion: a fresh dist process traces every phase, so the
+# per-phase rollup must be populated with nonzero bytes and internally
+# consistent (headline == sum of phases == sum of records)
+import json
+r = json.load(open("/tmp/_kmp_dist_smoke/ref.json"))
+comm = r["comm"]
+phases = comm["phases"]
+assert phases, "dist run rolled up no comm phases"
+assert comm["bytes_total"] > 0, comm["bytes_total"]
+assert any(p["bytes_total"] > 0 for p in phases.values()), phases
+assert comm["bytes_total"] == sum(
+    p["bytes_total"] for p in phases.values()), comm["bytes_total"]
+rec_total = sum(
+    rec["payload_bytes_per_device"] for rec in comm["records"])
+assert comm["bytes_total"] == rec_total, (comm["bytes_total"], rec_total)
+print(f"dist comm OK: {len(phases)} phase(s), "
+      f"bytes_total={comm['bytes_total']}")
+EOF8
 # preempt: SIGTERM as soon as the first dist barrier checkpoint lands
 XLA_FLAGS="$DIST_XLA" python -m kaminpar_tpu.dcli "$DGRAPH" -k 4 -n 4 \
     --checkpoint-dir "$DIST_DIR/ckpt" \
@@ -670,12 +694,84 @@ assert r["memory_budget"] == {"enabled": False} or \
 print("rank-scope inert OK: rank=1 plan fired nothing on rank 0")
 EOF8
 
+echo "== [12/13] fleet observatory smoke (live metrics + request traces) =="
+OBS_DIR=/tmp/_kmp_obs_smoke
+rm -rf "$OBS_DIR"; mkdir -p "$OBS_DIR"
+python - <<'EOF9' || exit 1
+# 12 requests with distinct seeds (every one a real pool execution);
+# chaos: #5 crashes its worker — the batch keeps serving and the live
+# counters must account the failure next to the successes
+import json
+reqs = [{"graph": f"gen:rgg2d;n=4096;avg_degree=8;seed={i}", "k": 4,
+         "seed": 1, "id": f"o{i}"} for i in range(1, 13)]
+json.dump({"requests": reqs}, open("/tmp/_kmp_obs_smoke/batch.json", "w"))
+EOF9
+KAMINPAR_TPU_FAULTS=worker-crash:nth=5 python -m kaminpar_tpu \
+    --serve-batch "$OBS_DIR/batch.json" --serve-isolation process \
+    --metrics-file "$OBS_DIR/metrics.prom" \
+    --report-json "$OBS_DIR/report.json" \
+    | tee "$OBS_DIR/stdout.log" \
+    || { echo "ERROR: observed batch exited nonzero" >&2; exit 1; }
+grep -E "^SERVING .* rps=" "$OBS_DIR/stdout.log" > /dev/null \
+    || { echo "ERROR: SERVING line carries no rps= field" >&2; exit 1; }
+python scripts/check_report_schema.py "$OBS_DIR/report.json" || exit 1
+python - <<'EOF9' || exit 1
+import json, re
+
+# -- the scrape: well-formed Prometheus text exposition (0.0.4)
+lines = open("/tmp/_kmp_obs_smoke/metrics.prom").read().splitlines()
+assert lines, "empty metrics scrape"
+sample_re = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9eE+.]+$')
+samples = {}
+for ln in lines:
+    if not ln or ln.startswith("#"):
+        continue
+    assert sample_re.match(ln), f"unparseable sample line: {ln!r}"
+    name_labels, value = ln.rsplit(" ", 1)
+    samples[name_labels] = float(value)
+r = json.load(open("/tmp/_kmp_obs_smoke/report.json"))
+assert r["schema_version"] == 12, r["schema_version"]
+counts = r["serving"]["counts"]
+# the live counter and the post-mortem report agree on every verdict
+# (counts also carries reason sub-keys like worker-crash — sum the
+# five verdicts only)
+VERDICTS = ("served", "anytime", "degraded", "rejected", "failed")
+req_total = sum(v for k, v in samples.items()
+                if k.startswith("kmp_requests_total{"))
+assert req_total == sum(counts[v] for v in VERDICTS) == 12, (
+    req_total, counts)
+assert samples.get('kmp_requests_total{verdict="failed"}', 0) \
+    == counts.get("failed", 0) == 1, (samples, counts)
+assert samples.get("kmp_requests_per_second", 0) > 0, samples
+assert samples.get('kmp_worker_pool{event="crashed"}', 0) >= 1, samples
+assert samples.get('kmp_worker_pool{event="spawned"}', 0) >= 2, samples
+# -- the traces: v12 tracing section populated, worker boundary
+# visible (spawn/ship overhead span + the worker's own compute scopes)
+tr = r["tracing"]
+assert tr["enabled"] and tr["traces"], tr.get("enabled")
+spans = [(s["name"], s["origin"])
+         for t in tr["traces"] for s in t["spans"]]
+assert ("worker-compute", "worker") in spans, sorted(set(spans))
+assert any(n == "worker-spawn-ship" for n, _ in spans), sorted(set(spans))
+# the service-side phase taxonomy is complete on >= 1 trace
+need = {"admission", "queue-wait", "resolve", "compute", "gate"}
+assert any(need <= {s["name"] for s in t["spans"]}
+           for t in tr["traces"]), sorted(set(spans))
+# throughput rides the serving summary too (the SERVING line's rps=)
+thr = r["serving"]["throughput"]
+assert thr["requests_per_second"] > 0 and thr["queue_peak"] >= 1, thr
+print(f"fleet observatory OK: {len(samples)} sample(s), "
+      f"rps={samples['kmp_requests_per_second']}, "
+      f"{len(tr['traces'])} trace(s), counts={counts}")
+EOF9
+
 if [ "${1:-}" = "--fast" ]; then
-    echo "== [12/12] tier-1 pytest: SKIPPED (--fast) =="
+    echo "== [13/13] tier-1 pytest: SKIPPED (--fast) =="
     exit 0
 fi
 
-echo "== [12/12] tier-1 pytest (ROADMAP.md) =="
+echo "== [13/13] tier-1 pytest (ROADMAP.md) =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

@@ -92,8 +92,9 @@ def version_checks(report: Any) -> List[str]:
     `memory_budget` section, v7+ additionally the `quality` section,
     v8+ additionally the `dist_resilience` section, v9+ additionally
     the `external` section, v10+ additionally the `supervision`
-    section, v11+ additionally the `dynamic` section; older reports
-    remain valid without them during the transition."""
+    section, v11+ additionally the `dynamic` section, v12+ additionally
+    the `tracing` section; older reports remain valid without them
+    during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -111,6 +112,7 @@ def version_checks(report: Any) -> List[str]:
         (9, ("external",)),
         (10, ("supervision",)),
         (11, ("dynamic",)),
+        (12, ("tracing",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -228,6 +230,15 @@ def _minimal_v10_report() -> dict:
     r = _minimal_v9_report()
     r["schema_version"] = 10
     r["supervision"] = {"enabled": False}
+    return r
+
+
+def _minimal_v11_report() -> dict:
+    """A minimal schema_version-11 report (dynamic present, no
+    tracing section) — the eleventh transition fixture."""
+    r = _minimal_v10_report()
+    r["schema_version"] = 11
+    r["dynamic"] = {"enabled": False}
     return r
 
 
@@ -378,7 +389,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v11) and validate it plus the embedded v1-v10 transition "
+        "v12) and validate it plus the embedded v1-v11 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -402,21 +413,21 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v11 (progress/compile +
+        # live producer must emit v12 (progress/compile +
         # checkpoint/anytime + serving + perf + memory_budget +
         # quality + dist_resilience + external + supervision +
-        # dynamic)
-        if report.get("schema_version") != 11:
+        # dynamic + tracing)
+        if report.get("schema_version") != 12:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 11",
+                f"expected 12",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
                     "memory_budget", "quality", "dist_resilience",
-                    "external", "supervision", "dynamic"):
+                    "external", "supervision", "dynamic", "tracing"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -448,13 +459,14 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v10 layouts must STILL validate
+        # transition coverage: the v1-v11 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
             ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
             ("v7", _minimal_v7_report()), ("v8", _minimal_v8_report()),
             ("v9", _minimal_v9_report()), ("v10", _minimal_v10_report()),
+            ("v11", _minimal_v11_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

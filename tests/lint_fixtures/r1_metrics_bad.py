@@ -1,0 +1,26 @@
+"""R1 bad fixture: the fleet-observatory hook shape done WRONG — the
+serving loop feeds the live gauges by pulling device values to the
+host lexically inside the measured compute span (the PR-16 metrics
+hazard: every request would host-sync mid-span just to publish a
+number to the scrape file, serializing the async dispatch queue —
+metrics producers are host-side request bookkeeping and must never
+read device values).
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.telemetry import metrics
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def serve_with_inline_gauge_pulls(requests, kernel, labels):
+    with scoped_timer("compute"):
+        for req in requests:
+            labels = kernel(labels, req)
+            metrics.set_gauge("kmp_cut", float(jnp.sum(labels)))
+            metrics.inc("kmp_moved", value=int(jnp.max(labels)))
+            metrics.set_gauge("kmp_last", np.asarray(labels)[-1])
+    return labels

@@ -1,0 +1,369 @@
+"""Fleet-observatory tests: the live metrics registry + Prometheus
+exporter (telemetry/metrics.py), end-to-end request tracing across the
+supervised-worker boundary (telemetry/tracing.py), and the promoted
+communication-volume accounting (schema-v12 ``comm`` section + live
+``kmp_comm_*`` counters), per docs/observability.md.
+
+The worker round-trip test spawns a REAL supervised worker subprocess
+(the boundary under test is the marshal of worker-side spans back to
+the parent), so the graphs are tiny — same discipline as
+tests/test_supervision.py.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from kaminpar_tpu import resilience, telemetry
+from kaminpar_tpu.telemetry import metrics as metrics_mod
+from kaminpar_tpu.telemetry import tracing
+from kaminpar_tpu.serving import (
+    PartitionRequest,
+    PartitionService,
+    ServiceConfig,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Prometheus text-format sample line (metric, optional labels, value).
+SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? "
+    r"([+-]?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|NaN|[+-]?Inf)$"
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(metrics_mod.ENV_VAR, raising=False)
+    monkeypatch.delenv(metrics_mod.ENV_CADENCE, raising=False)
+    monkeypatch.delenv(resilience.FAULTS_ENV_VAR, raising=False)
+    resilience.reset()
+    metrics_mod.reset()
+    telemetry.reset()
+    tracing.reset_traces()
+    telemetry.enable()
+    yield
+    resilience.reset()
+    metrics_mod.reset()
+    telemetry.disable()
+    telemetry.reset()
+    tracing.reset_traces()
+
+
+def _gen(n=600, seed=3):
+    return f"gen:rgg2d;n={n};avg_degree=8;seed={seed}"
+
+
+def _load_checker():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(REPO, "scripts", "check_report_schema.py"),
+    )
+    checker = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(checker)
+    return checker
+
+
+# ---------------------------------------------------------------------------
+# registry math
+# ---------------------------------------------------------------------------
+
+
+def test_counter_and_gauge_registry_math(tmp_path):
+    metrics_mod.configure(str(tmp_path / "m.prom"))
+    metrics_mod.inc("kmp_x_total", "x", value=2.0, phase="a")
+    metrics_mod.inc("kmp_x_total", value=3.0, phase="a")
+    metrics_mod.inc("kmp_x_total", phase="b")
+    assert metrics_mod.gauge_value("kmp_x_total", phase="a") == 5.0
+    assert metrics_mod.gauge_value("kmp_x_total", phase="b") == 1.0
+    # gauges overwrite, counters accumulate
+    metrics_mod.set_gauge("kmp_g", 7.5)
+    metrics_mod.set_gauge("kmp_g", 2.5)
+    assert metrics_mod.gauge_value("kmp_g") == 2.5
+    metrics_mod.observe("kmp_lat_seconds", 0.25)
+    snap = metrics_mod.snapshot()
+    assert snap["kmp_x_total"] == {"a": 5.0, "b": 1.0}
+    assert "kmp_lat_seconds" in snap
+
+
+def test_window_rate_math_with_injected_clock():
+    t = [0.0]
+    wr = metrics_mod.WindowRate(
+        "kmp_r", "rate", window_s=10.0, clock=lambda: t[0]
+    )
+    assert wr.rate() == 0.0
+    wr.mark()
+    wr.mark(n=4)  # 5 marks in the first instant
+    # covered window floors at 1 s: a burst reads events/s, not events/eps
+    assert wr.rate() == 5.0
+    t[0] = 5.0
+    assert wr.rate() == 1.0  # 5 marks / 5 s covered
+    t[0] = 9.0
+    assert wr.rate() == pytest.approx(5.0 / 9.0)
+    # past the window the old marks are pruned
+    t[0] = 10.5
+    assert wr.rate() == 0.0
+    # a new burst divides by the FULL window once runtime exceeds it
+    t[0] = 11.0
+    wr.mark(n=3)
+    assert wr.rate() == pytest.approx(3.0 / 10.0)
+
+
+def test_producers_noop_while_dormant():
+    assert not metrics_mod.enabled()
+    metrics_mod.inc("kmp_x_total")
+    metrics_mod.set_gauge("kmp_g", 1.0)
+    metrics_mod.observe("kmp_l_seconds", 0.1)
+    metrics_mod.mark("kmp_r")
+    assert metrics_mod.snapshot() == {}
+    assert metrics_mod.write_now() is None
+    assert metrics_mod.rate("kmp_r") == 0.0
+    assert metrics_mod.gauge_value("kmp_g") is None
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering + atomic scrape file
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_escaping(tmp_path):
+    metrics_mod.configure(str(tmp_path / "m.prom"))
+    metrics_mod.inc(
+        "kmp_esc_total", "help with \\ slash\nand newline",
+        cls='he said "hi"\nover\\there',
+    )
+    text = metrics_mod.render()
+    assert "# HELP kmp_esc_total help with \\\\ slash\\nand newline" in text
+    assert 'cls="he said \\"hi\\"\\nover\\\\there"' in text
+    # the escaped sample still parses as ONE line
+    sample = [
+        l for l in text.splitlines()
+        if l.startswith("kmp_esc_total")
+    ]
+    assert len(sample) == 1 and SAMPLE_RE.match(sample[0]), sample
+
+
+def test_scrape_file_atomic_and_parseable(tmp_path):
+    path = tmp_path / "metrics.prom"
+    metrics_mod.configure(str(path))
+    metrics_mod.inc("kmp_requests_total", "Requests.", verdict="served")
+    metrics_mod.mark("kmp_requests_per_second", "rps")
+    metrics_mod.observe("kmp_latency_seconds", 0.1)
+    out = metrics_mod.write_now()
+    assert out == str(path) and path.exists()
+    # atomic publish: no torn tmp file left next to the scrape target
+    assert list(tmp_path.glob("*.tmp.*")) == []
+    text = path.read_text()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) kmp_", line), line
+        else:
+            assert SAMPLE_RE.match(line), line
+    assert 'kmp_requests_total{verdict="served"} 1' in text
+    # summary family renders _sum/_count
+    assert "kmp_latency_seconds_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# dormancy: the exporter must never perturb traced computations
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_dormancy_jaxpr(tmp_path):
+    """The kill switch off => bitwise-identical jaxprs.  The probe runs
+    the exact producer that executes at trace time inside jitted dist
+    code (mesh.account_collective -> metrics.inc when armed); arming
+    the exporter must not change what gets traced."""
+    import jax
+    import jax.numpy as jnp
+
+    from kaminpar_tpu.parallel import mesh
+    from kaminpar_tpu.resilience import runstate
+
+    x = jnp.arange(16, dtype=jnp.int32)
+
+    def trace():
+        # a fresh function object per pass: jax caches traces per
+        # callable, and the producer must run on BOTH passes
+        def probe(v):
+            mesh.account_collective(
+                "psum(probe)", int(v.size) * 4, shape=v.shape
+            )
+            return jnp.sum(v * 2)
+
+        runstate.begin()  # fresh comm log either way
+        with mesh.comm_phase("probe"):
+            return str(jax.make_jaxpr(probe)(x))
+
+    assert not metrics_mod.enabled()
+    off = trace()
+    metrics_mod.configure(str(tmp_path / "m.prom"))
+    assert metrics_mod.enabled()
+    on = trace()
+    assert off == on
+    # ... while the live counter really did fire on the armed pass
+    assert metrics_mod.gauge_value(
+        "kmp_comm_bytes_total", phase="probe"
+    ) == 64.0
+    assert metrics_mod.gauge_value(
+        "kmp_comm_calls_total", phase="probe"
+    ) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# request tracing across a REAL supervised worker
+# ---------------------------------------------------------------------------
+
+
+def test_trace_roundtrip_real_worker(tmp_path):
+    """Two process-isolated requests: each trace carries the service
+    lifecycle spans, the worker-spawn-ship overhead row, and the
+    worker's OWN compute scopes marshalled back and re-based into the
+    parent timeline (pid-stamped, after the ship overhead)."""
+    path = tmp_path / "metrics.prom"
+    svc = PartitionService(
+        "default",
+        ServiceConfig(isolation="process", metrics_file=str(path)),
+    )
+    try:
+        recs = svc.serve([
+            PartitionRequest(_gen(seed=1), k=4, seed=1, request_id="t1"),
+            PartitionRequest(_gen(seed=2), k=4, seed=1, request_id="t2"),
+        ])
+        assert [r.verdict for r in recs] == ["served", "served"]
+    finally:
+        svc.close()
+
+    snap = tracing.snapshot()
+    assert snap["enabled"] and len(snap["traces"]) == 2
+    by_req = {t["request_id"]: t for t in snap["traces"]}
+    for rid in ("t1", "t2"):
+        tr = by_req[rid]
+        names = {(s["name"], s["origin"]) for s in tr["spans"]}
+        for name in ("admission", "queue-wait", "resolve", "compute",
+                     "gate"):
+            assert (name, "service") in names, (rid, sorted(names))
+        assert ("worker-spawn-ship", "service") in names
+        workers = [s for s in tr["spans"] if s["origin"] == "worker"]
+        assert "worker-compute" in {s["name"] for s in workers}
+        assert all(s["attrs"].get("worker_pid") for s in workers)
+        # ship overhead is attributed BEFORE the worker's own window
+        ship = next(
+            s for s in tr["spans"] if s["name"] == "worker-spawn-ship"
+        )
+        wc = next(s for s in workers if s["name"] == "worker-compute")
+        assert wc["start_ms"] >= ship["start_ms"]
+        assert tr["attrs"].get("verdict") == "served"
+
+    # close() left a final scrape: the batch is fully accounted
+    text = path.read_text()
+    assert 'kmp_requests_total{verdict="served"} 2' in text
+    assert "kmp_requests_per_second" in text
+
+
+# ---------------------------------------------------------------------------
+# comm promotion: run-scoped log, v12 section, live counters
+# ---------------------------------------------------------------------------
+
+
+def test_comm_log_scoped_per_run_two_requests():
+    """Satellite pin: the collective account lives on the RunState, so
+    request N+1 (a fresh run, as the serving facade installs one per
+    request) never reports request N's traffic — reset_comm_log() needs
+    no per-request call site."""
+    from kaminpar_tpu.parallel import mesh
+    from kaminpar_tpu.resilience import runstate
+
+    runstate.begin()
+    with mesh.comm_phase("coarsening"):
+        mesh.account_collective("psum(x)", 1024, shape=(256,))
+    assert mesh.comm_phase_totals()["coarsening"]["bytes_total"] == 1024
+
+    runstate.begin()  # request 2: fresh run, fresh log
+    assert mesh.comm_records() == []
+    with mesh.comm_phase("refinement"):
+        mesh.account_collective("all_gather(y)", 512, shape=(128,))
+    totals = mesh.comm_phase_totals()
+    assert "coarsening" not in totals
+    assert totals["refinement"] == {"bytes_total": 512, "calls": 1}
+
+
+def test_comm_section_schema_valid_on_dist_smoke(tmp_path):
+    """A real multi-device run populates the promoted v12 ``comm``
+    section (per-phase rollup summing to bytes_total summing to the
+    records), the whole report stays schema-valid, and the live
+    kmp_comm_* counters mirror the account exactly."""
+    from kaminpar_tpu.graphs.factories import make_rgg2d
+    from kaminpar_tpu.parallel import dKaMinPar, make_mesh
+    from kaminpar_tpu.resilience import runstate
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH, build_run_report
+
+    metrics_mod.configure(str(tmp_path / "m.prom"))
+    runstate.begin()
+    g = make_rgg2d(4096, avg_degree=8, seed=7)
+    solver = dKaMinPar("default", mesh=make_mesh(4)).set_graph(g)
+    part = solver.compute_partition(k=6, epsilon=0.03, seed=1)
+    assert part.shape == (g.n,)
+
+    report = build_run_report()
+    comm = report["comm"]
+    assert comm["phases"], "per-phase rollup empty on a dist run"
+    assert comm["bytes_total"] > 0
+    assert comm["bytes_total"] == sum(
+        t["bytes_total"] for t in comm["phases"].values()
+    )
+    assert comm["bytes_total"] == sum(
+        r["payload_bytes_per_device"] for r in comm["records"]
+    )
+    for totals in comm["phases"].values():
+        assert totals["bytes_total"] > 0 and totals["calls"] > 0
+
+    checker = _load_checker()
+    schema = json.load(open(SCHEMA_PATH))
+    errors = checker.validate_instance(report, schema)
+    errors += checker.version_checks(report)
+    assert errors == [], errors
+
+    for phase, totals in comm["phases"].items():
+        assert metrics_mod.gauge_value(
+            "kmp_comm_bytes_total", phase=phase
+        ) == float(totals["bytes_total"])
+        assert metrics_mod.gauge_value(
+            "kmp_comm_calls_total", phase=phase
+        ) == float(totals["calls"])
+
+
+# ---------------------------------------------------------------------------
+# schema version pins
+# ---------------------------------------------------------------------------
+
+
+def test_schema_version_pins():
+    from kaminpar_tpu.telemetry.report import SCHEMA_PATH, SCHEMA_VERSION
+
+    assert SCHEMA_VERSION == 12
+    checker = _load_checker()
+    schema = json.load(open(SCHEMA_PATH))
+    # the v11 fixture (pre-tracing) still validates untouched
+    v11 = checker._minimal_v11_report()
+    assert checker.validate_instance(v11, schema) == []
+    assert checker.version_checks(v11) == []
+    # claiming v12 without a tracing section is flagged
+    v12_missing = dict(v11, schema_version=12)
+    assert any(
+        "tracing" in e for e in checker.version_checks(v12_missing)
+    )
+    v12 = dict(v12_missing, tracing={"enabled": False, "traces": []})
+    assert checker.validate_instance(v12, schema) == []
+    assert checker.version_checks(v12) == []
+    # an unknown future version is rejected, not silently accepted
+    v13 = dict(v12, schema_version=13)
+    assert any(
+        "schema_version" in e
+        for e in checker.validate_instance(v13, schema)
+    )

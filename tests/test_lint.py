@@ -47,6 +47,10 @@ BAD_EXPECT = {
     # heartbeat hooks are host-side bookkeeping and read no device
     # values)
     "r1_supervisor_bad.py": [("R1", 22), ("R1", 23)],
+    # the PR-16 fleet-observatory hook shape: live-gauge pulls of
+    # device values lexically inside the measured compute span (the
+    # metrics producers are host-side request bookkeeping)
+    "r1_metrics_bad.py": [("R1", 23), ("R1", 24), ("R1", 25)],
     "r2_bad.py": [("R2", 5), ("R2", 9)],
     "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16), ("R3", 21)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
@@ -64,7 +68,7 @@ def test_rule_fires_on_bad_fixture(name):
 @pytest.mark.parametrize(
     "name", ["r1_good.py", "r1_quality_good.py", "r1_stream_good.py",
              "r1_dynamic_good.py",
-             "r1_supervisor_good.py", "r2_good.py",
+             "r1_supervisor_good.py", "r1_metrics_good.py", "r2_good.py",
              "r3_good.py", "r4_good.py", "r5_good.py", "r6_good.py"]
 )
 def test_rule_silent_on_good_fixture(name):

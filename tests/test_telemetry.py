@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 11
+    assert loaded["schema_version"] == 12
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -682,13 +682,20 @@ def test_schema_accepts_v1_through_v7(tmp_path):
     v11_missing = dict(v10, schema_version=11)
     assert any("dynamic" in e
                for e in checker.version_checks(v11_missing))
-    v11 = dict(v11_missing, dynamic={"enabled": False})
+    v11 = checker._minimal_v11_report()
     assert checker.validate_instance(v11, schema) == []
     assert checker.version_checks(v11) == []
-    # v12 is not a known version
-    v12 = dict(v1, schema_version=12)
+    # v12 additionally requires the tracing section
+    v12_missing = dict(v11, schema_version=12)
+    assert any("tracing" in e
+               for e in checker.version_checks(v12_missing))
+    v12 = dict(v12_missing, tracing={"enabled": False, "traces": []})
+    assert checker.validate_instance(v12, schema) == []
+    assert checker.version_checks(v12) == []
+    # v13 is not a known version
+    v13 = dict(v1, schema_version=13)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v12, schema))
+               for e in checker.validate_instance(v13, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
