@@ -166,6 +166,32 @@ def _measure_dynamic():
     return round(speedup, 2), round(max(drifts), 4)
 
 
+def lint_keys(seconds=None) -> dict:
+    """The BENCH line's static-analysis key (round 17, tpulint v2):
+    wall seconds of a full-package `lint_paths` run with every rule
+    (call graph + R9 schema pins included) — the analysis itself is a
+    commit-gate stage, so its cost is a trend worth watching.  Always
+    present, null when the lint run errored."""
+    return {"tpulint_seconds": seconds}
+
+
+def _measure_lint():
+    """Wall seconds of one full-rule tpulint pass over the package."""
+    import time
+
+    from kaminpar_tpu.lint import LintConfig, lint_paths
+
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "kaminpar_tpu")
+    t0 = time.perf_counter()
+    findings = lint_paths([pkg], LintConfig())
+    seconds = time.perf_counter() - t0
+    assert findings == [], (
+        f"bench lint pass found {len(findings)} finding(s); the package "
+        "must stay clean")
+    return round(seconds, 2)
+
+
 def quality_keys(report) -> dict:
     """The BENCH line's quality-attribution keys from an embedded run
     report (telemetry/quality.py totals); every key present, null when
@@ -679,6 +705,18 @@ def _bench_line() -> dict:
             print(f"bench: dynamic measurement failed: {e}",
                   file=sys.stderr)
     line.update(dynamic_keys(dyn_speedup, dyn_drift))
+    # static-analysis coverage (round 17, tpulint v2): the commit gate's
+    # own wall — always-present key (null = errored), same r05-class
+    # presence contract; also re-asserts the zero-finding state from
+    # inside the bench
+    lint_s = None
+    try:
+        lint_s = _measure_lint()
+    except Exception as e:
+        import sys
+
+        print(f"bench: lint measurement failed: {e}", file=sys.stderr)
+    line.update(lint_keys(lint_s))
     if best_report is not None:
         # rating-engine choices of the best run (ops/rating.py
         # selection, from the embedded report's `rating` section):

@@ -359,7 +359,9 @@ def _sizes(graph) -> Tuple[int, int]:
 
 
 def _project(part: np.ndarray, cmaps: List[np.ndarray]) -> np.ndarray:
-    part = np.asarray(part, dtype=np.int32)
+    # `part` is already host (np.ndarray contract) — a dtype cast, not a
+    # device pull
+    part = part.astype(np.int32, copy=False)
     for cmap in reversed(cmaps):
         part = part[cmap]
     return part

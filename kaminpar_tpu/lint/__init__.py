@@ -23,13 +23,32 @@ class as a rule so future perf PRs cannot silently reintroduce them:
   R4  retrace hygiene — jit wrappers constructed inside loops or around
       fresh lambdas retrace/recompile per evaluation;
   R5  routed-gather plan builders must check the plan against a slot cap
-      (``plan_within_cap`` / ``num_slots``) before keeping it.
+      (``plan_within_cap`` / ``num_slots``) before keeping it;
+  R6  eager device-memory/cost introspection must stay behind the gated
+      perf helpers (``telemetry.perf`` / ``utils.heap_profiler``);
+  R7  SPMD collective symmetry — rank-dependent control flow
+      (``agreement.rank()``, ``jax.process_index()``, ``*RANK*`` env
+      reads) must not guard a collective: ranks that skip a ``psum``
+      deadlock the ranks that entered it;
+  R8  exception hygiene — broad ``except Exception`` around the
+      degradation/fault surface must route through
+      ``policy.with_fallback``/``classify`` or re-raise, never swallow;
+  R9  schema-pin consistency (cross-file) — the run-report
+      ``SCHEMA_VERSION``, the schema enum, the checker conditional and
+      the highest transition fixture must agree.
+
+Since PR 17 the engine carries an intra-package call graph: span-scope
+and rank-guard analysis follows factored helpers ONE call deep, so a
+host pull hidden inside a small helper invoked under ``Timer.scope``
+still fires (docs/static_analysis.md#call-graph has the semantics and
+the blind spots).
 
 Usage:  ``python -m kaminpar_tpu.lint [paths...]`` — see ``--help`` and
 docs/static_analysis.md.  Findings are suppressible per line with
 ``# tpulint: disable=R1[,R2...]`` (or per file with ``disable-file=``)
 and ratcheted via the checked-in baseline
-``scripts/tpulint_baseline.json``.
+``scripts/tpulint_baseline.json`` (empty since PR 17; the CLI refuses
+``--write-baseline`` runs that would grow it).
 """
 
 from __future__ import annotations

@@ -18,13 +18,68 @@ _REPO_ROOT = os.path.dirname(
 )
 DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "scripts", "tpulint_baseline.json")
 
+_SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _sarif_report(new, total: int, baseline_entries: int) -> dict:
+    """Minimal SARIF 2.1.0 run: one tool with the rule table, one result
+    per NEW finding (baselined findings are suppressed by definition)."""
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": _SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "tpulint",
+                        "informationUri": "docs/static_analysis.md",
+                        "rules": [
+                            {
+                                "id": rid,
+                                "shortDescription": {"text": desc},
+                            }
+                            for rid, desc in RULES.items()
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule,
+                        "level": "error",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.path},
+                                    "region": {
+                                        "startLine": max(f.line, 1),
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                    }
+                    for f in new
+                ],
+                "properties": {
+                    "totalFindings": total,
+                    "baselineEntries": baseline_entries,
+                },
+            }
+        ],
+    }
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kaminpar_tpu.lint",
         description=(
             "tpulint: AST hot-path hazard checker for the kaminpar-tpu "
-            "JAX pipeline (rules R1-R5; see docs/static_analysis.md)"
+            "JAX pipeline (rules R1-R9; see docs/static_analysis.md)"
         ),
     )
     ap.add_argument(
@@ -44,14 +99,14 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--write-baseline", action="store_true",
         help="accept the current findings as the new baseline (use only "
-        "to SHRINK the file — the ratchet policy)",
+        "to SHRINK the file — the ratchet policy refuses growth)",
     )
     ap.add_argument(
-        "--select", default=None, metavar="RULES",
+        "--select", "--rules", dest="select", default=None, metavar="RULES",
         help="comma-separated rule subset, e.g. R2,R3",
     )
     ap.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--format", choices=("text", "json", "sarif"), default="text",
         help="output format",
     )
     ap.add_argument(
@@ -107,10 +162,30 @@ def main(argv=None) -> int:
             )
             return 2
         out = args.baseline or DEFAULT_BASELINE
+        # the ratchet only turns one way: a baseline rewrite may shrink
+        # or re-key the accepted set, never grow it.  New findings must
+        # be FIXED (or suppressed with an inline justification), not
+        # absorbed into the baseline.
+        if os.path.exists(out):
+            try:
+                existing = load_baseline(out)
+            except (OSError, ValueError, json.JSONDecodeError):
+                existing = None
+            if existing is not None and len(findings) > len(existing):
+                print(
+                    f"tpulint: refusing --write-baseline: {len(findings)} "
+                    f"findings would GROW the baseline from "
+                    f"{len(existing)} entries (the ratchet only shrinks); "
+                    "fix the new findings or suppress them inline with a "
+                    "justification",
+                    file=sys.stderr,
+                )
+                return 2
         write_baseline(out, findings)
         print(f"tpulint: wrote {len(findings)} entries to {out}")
         return 0
 
+    baseline_entries = 0
     if args.no_baseline or baseline_path is None:
         new, stale = findings, []
     else:
@@ -120,6 +195,7 @@ def main(argv=None) -> int:
             print(f"tpulint: bad baseline {baseline_path}: {e}",
                   file=sys.stderr)
             return 2
+        baseline_entries = len(entries)
         diff = diff_against_baseline(findings, entries)
         new, stale = diff.new, diff.stale
 
@@ -129,11 +205,16 @@ def main(argv=None) -> int:
                 {
                     "new": [f.to_dict() for f in new],
                     "total": len(findings),
+                    "baseline_entries": baseline_entries,
                     "stale_baseline_entries": len(stale),
                 },
                 indent=2,
             )
         )
+    elif args.format == "sarif":
+        print(json.dumps(
+            _sarif_report(new, len(findings), baseline_entries), indent=2
+        ))
     else:
         for f in new:
             print(f.render())

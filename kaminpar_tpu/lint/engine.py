@@ -1,19 +1,23 @@
-"""tpulint core: per-module AST analysis, suppressions, file walking.
+"""tpulint core: AST analysis, suppressions, file walking.
 
 One analyzer instance handles one module.  The rule logic lives in
-``rules.py``; this module owns the shared machinery every rule needs:
+``rules.py`` (R1-R6) and ``spmd.py`` (R7/R8); ``schema_pins.py`` owns
+the cross-file R9 check and ``callgraph.py`` the package index.  This
+module owns the shared machinery every rule needs:
 
   * import alias resolution (``jnp`` -> ``jax.numpy``) so rules match
     fully-qualified names regardless of local import style;
   * the module-local jit call graph (which functions are
     ``jax.jit``-decorated or transitively called from one) for R1;
+  * the cross-module :class:`callgraph.PackageIndex` (one-level helper
+    inlining) so span-scope analysis follows factored helpers;
   * lexical context stacks (function nesting, loop depth, span-scope
     ``with`` blocks) maintained during a single AST walk;
   * ``# tpulint: disable=``/``disable-file=`` suppression parsing.
 
-The analysis is intentionally module-local (no cross-file call graph):
-it trades recall for zero-setup speed and deterministic findings, and
-the baseline absorbs the difference.
+Per-module analysis stays deterministic and dependency-free; the call
+graph adds exactly one level of inlining (a pull two calls deep is a
+documented blind spot, docs/static_analysis.md#call-graph).
 """
 
 from __future__ import annotations
@@ -24,14 +28,23 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from . import callgraph as cg
+
 RULES: Dict[str, str] = {
-    "R1": "host-sync primitive in jit-reachable code or a span scope",
+    "R1": "host-sync primitive in jit-reachable code or a span scope "
+          "(lexically or one helper call deep)",
     "R2": "eager/ungated device or backend query (use utils.platform)",
     "R3": "32-bit accumulation where the dtypes.py 64-bit policy applies",
     "R4": "jit wrapper constructed per iteration/evaluation (retrace)",
     "R5": "routed-gather plan built without a slot cap check",
     "R6": "eager device-memory/cost introspection outside the gated "
           "perf helpers (telemetry.perf / utils.heap_profiler)",
+    "R7": "rank-dependent control flow guarding an SPMD collective "
+          "(the static half of the divergence sentinel)",
+    "R8": "broad except around the degradation/fault surface without "
+          "routing through with_fallback/classify",
+    "R9": "run-report schema-version pin skew across producer/schema/"
+          "checker/fixtures (cross-file)",
 }
 
 _SUPPRESS_RE = re.compile(
@@ -83,6 +96,30 @@ class LintConfig:
     )
     # R3 fires only under these directory names (plus lint fixtures)
     r3_dirs: Tuple[str, ...] = ("ops", "graphs", "parallel", "lint_fixtures")
+    # R7: the deliberate rank-0-writes idiom — checkpointing and report
+    # emission are DOCUMENTED single-writer surfaces (every rank agrees
+    # on the data first, rank 0 alone touches the filesystem), and the
+    # agreement layer itself implements the collectives it guards
+    r7_allow_suffixes: Tuple[str, ...] = (
+        "resilience/checkpoint.py",
+        "resilience/agreement.py",
+        "telemetry/report.py",
+    )
+    # R8: legitimate broad-except boundaries — processes/layers whose
+    # CONTRACT is "never let any exception cross" (serving isolation
+    # marshals verdicts, the supervisor marshals worker death, telemetry
+    # is best-effort by design).  Substring match on the posix path.
+    r8_boundary_parts: Tuple[str, ...] = (
+        "serving/service.py",
+        "resilience/supervisor.py",
+        "telemetry/",
+    )
+    # R9: the four schema-version pin sites (relative to r9_root; None
+    # root = the repo that holds this package)
+    r9_root: Optional[str] = None
+    r9_producer_rel: str = "kaminpar_tpu/telemetry/report.py"
+    r9_schema_rel: str = "kaminpar_tpu/telemetry/run_report.schema.json"
+    r9_checker_rel: str = "scripts/check_report_schema.py"
     # rules to run (all by default)
     rules: Tuple[str, ...] = tuple(RULES)
 
@@ -119,7 +156,8 @@ class ModuleContext:
     """Everything rules need to know about one parsed module."""
 
     def __init__(self, path: str, source: str, tree: ast.Module,
-                 config: LintConfig) -> None:
+                 config: LintConfig,
+                 index: Optional[cg.PackageIndex] = None) -> None:
         self.path = path
         self.source_lines = source.splitlines()
         self.tree = tree
@@ -134,6 +172,25 @@ class ModuleContext:
         )
         parts = set(path.replace("\\", "/").split("/"))
         self.r3_applies = bool(parts & set(config.r3_dirs))
+        # cross-module call graph; a single-module index is built on the
+        # fly so same-file helpers resolve even in snippet/fixture runs
+        if index is None:
+            index = cg.PackageIndex()
+            index.add(path, source, tree)
+        self.index = index
+        self.module_info = index.by_path.get(path)
+
+    def resolve_call(self, node: ast.Call,
+                     enclosing_class: Optional[str] = None
+                     ) -> Optional[cg.FunctionInfo]:
+        """The package-defined function a call names (same or cross
+        module, ``self.method`` within the enclosing class), else None."""
+        if self.module_info is None:
+            return None
+        return self.index.resolve(self.module_info, node, enclosing_class)
+
+    def helper_summary(self, fn: cg.FunctionInfo) -> cg.HelperSummary:
+        return self.index.summary(fn)
 
     def qualname(self, node: ast.AST) -> Optional[str]:
         """Dotted name of a Name/Attribute chain with aliases resolved;
@@ -154,18 +211,7 @@ class ModuleContext:
         return ""
 
 
-def _collect_aliases(tree: ast.Module) -> Dict[str, str]:
-    aliases: Dict[str, str] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                aliases[a.asname or a.name.split(".")[0]] = (
-                    a.name if a.asname else a.name.split(".")[0]
-                )
-        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
-            for a in node.names:
-                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
-    return aliases
+_collect_aliases = cg.collect_aliases
 
 
 _JIT_WRAPPERS = ("jax.jit", "jax.pmap")
@@ -273,10 +319,13 @@ def _repo_relative(path: str) -> str:
 
 
 def lint_source(source: str, path: str,
-                config: Optional[LintConfig] = None) -> List[Finding]:
+                config: Optional[LintConfig] = None,
+                index: Optional[cg.PackageIndex] = None) -> List[Finding]:
     """Lint one module's source text (path is used for reporting and
-    path-scoped rules only)."""
+    path-scoped rules only; without an explicit package index a
+    single-module one is built so same-file helpers still resolve)."""
     from . import rules as rules_mod
+    from . import spmd as spmd_mod
 
     config = config or LintConfig()
     rel = _repo_relative(path)
@@ -291,10 +340,10 @@ def lint_source(source: str, path: str,
                 code="",
             )
         ]
-    ctx = ModuleContext(rel, source, tree, config)
+    ctx = ModuleContext(rel, source, tree, config, index=index)
     per_line, per_file = _parse_suppressions(source)
 
-    raw = rules_mod.run_rules(ctx)
+    raw = rules_mod.run_rules(ctx) + spmd_mod.run_spmd_rules(ctx)
     findings: List[Finding] = []
     for f in raw:
         # E0 (syntax error) always passes the rule filter
@@ -310,9 +359,10 @@ def lint_source(source: str, path: str,
     return findings
 
 
-def lint_file(path: str, config: Optional[LintConfig] = None) -> List[Finding]:
+def lint_file(path: str, config: Optional[LintConfig] = None,
+              index: Optional[cg.PackageIndex] = None) -> List[Finding]:
     with open(path, encoding="utf-8") as fh:
-        return lint_source(fh.read(), path, config)
+        return lint_source(fh.read(), path, config, index=index)
 
 
 def _iter_py_files(paths: Sequence[str]) -> List[str]:
@@ -334,9 +384,35 @@ def _iter_py_files(paths: Sequence[str]) -> List[str]:
 
 def lint_paths(paths: Sequence[str],
                config: Optional[LintConfig] = None) -> List[Finding]:
-    """Lint every .py file under the given paths (files or directories)."""
-    findings: List[Finding] = []
+    """Lint every .py file under the given paths (files or directories).
+
+    Two passes: the first parses every file into one PackageIndex (the
+    cross-module call graph), the second runs the rules with that index
+    so span/guard analysis follows helpers across files.  When R9 is
+    selected the cross-file schema-pin check runs once per invocation
+    on top (it reads the repo's pin sites, not the linted paths)."""
+    config = config or LintConfig()
+    index = cg.PackageIndex()
+    sources: List[Tuple[str, str]] = []
     for path in _iter_py_files(paths):
-        findings.extend(lint_file(path, config))
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        sources.append((path, source))
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue  # lint_source re-parses and reports E0
+        index.add(_repo_relative(path), source, tree)
+
+    findings: List[Finding] = []
+    for path, source in sources:
+        findings.extend(lint_source(source, path, config, index=index))
+    if "R9" in config.rules:
+        from . import schema_pins
+
+        findings.extend(schema_pins.check_schema_pins(config))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -12,52 +12,18 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from .engine import Finding, ModuleContext, _is_jit_decorator
-
-# R2: the device/backend discovery surface that must stay behind the
-# utils.platform gate (eager discovery is what initialized the axon
-# tunnel despite JAX_PLATFORMS=cpu and hung test_capi 600 s).
-DEVICE_QUERIES = frozenset(
-    {
-        "jax.devices",
-        "jax.local_devices",
-        "jax.device_count",
-        "jax.local_device_count",
-        "jax.default_backend",
-        "jax.process_index",
-        "jax.process_count",
-        "jax.lib.xla_bridge.get_backend",
-        "jax.extend.backend.get_backend",
-    }
+from .callgraph import (  # shared hazard surfaces (bottom layer)
+    DEVICE_QUERIES,
+    R6_METHODS,
+    R6_QUERIES,
 )
+from .engine import Finding, ModuleContext, _is_jit_decorator
 
 # R3: reductions whose accumulator width the dtypes.py policy owns.
 ACC_CALLS = frozenset(
     {"cumsum", "sum", "segment_sum", "bincount", "prod", "dot", "einsum"}
 )
 INT32_NAMES = frozenset({"jax.numpy.int32", "numpy.int32"})
-
-# R6: the memory/cost introspection surface that must stay behind the
-# gated perf helpers (telemetry/perf.py samples at barriers,
-# utils/heap_profiler.py behind profiling_enabled()).  Same hazard
-# class as R2's eager device queries: jax.live_arrays walks every live
-# buffer, device_memory_profile serializes a pprof proto, and
-# cost-analyzing an executable walks its HLO — all fine at a gated
-# barrier, pathological inside a hot loop or at import time.
-R6_QUERIES = frozenset(
-    {
-        "jax.live_arrays",
-        "jax.profiler.device_memory_profile",
-    }
-)
-R6_METHODS = frozenset(
-    {
-        "cost_analysis",
-        "memory_analysis",
-        "get_compiled_memory_stats",
-        "device_memory_profile",
-    }
-)
 
 
 def _terminal_name(func: ast.AST) -> Optional[str]:
@@ -103,6 +69,7 @@ class _RuleWalker(ast.NodeVisitor):
         self.ctx = ctx
         self.findings: List[Finding] = []
         self.func_stack: List[ast.AST] = []
+        self.class_stack: List[str] = []
         self.loop_depth = 0
         self.span_depth = 0
 
@@ -164,6 +131,22 @@ class _RuleWalker(ast.NodeVisitor):
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # a lambda is a deferred thunk: the checkpoint barrier's
+        # `payload=` and dist_lp's `materialize=` hooks run it outside
+        # the hot path (or never), so its body is not part of the
+        # enclosing span.  A lambda invoked in place escapes — a
+        # documented blind spot (docs/static_analysis.md#call-graph).
+        saved = self.span_depth
+        self.span_depth = 0
+        self.generic_visit(node)
+        self.span_depth = saved
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
 
     def visit_With(self, node: ast.With) -> None:
         spans = sum(
@@ -359,6 +342,58 @@ class _RuleWalker(ast.NodeVisitor):
                     "(telemetry/perf.py) captures this at the compile "
                     "boundary — use its snapshot instead",
                 )
+
+        # call-graph pass (one-level inlining): a factored helper is no
+        # longer assumed clean — the hazard fires AT THE CALL SITE,
+        # where the staging fix belongs
+        resolved = ctx.resolve_call(
+            node, self.class_stack[-1] if self.class_stack else None
+        )
+        if resolved is not None and resolved.node not in self.func_stack:
+            summary = ctx.helper_summary(resolved)
+            # R1d: a call inside a span scope to a helper whose body
+            # host-syncs distorts the span exactly like the inline pull
+            # (the "factored into a helper" idiom, now verified).  Only
+            # SAME-MODULE helpers are inlined here: a cross-module call
+            # from a phase span lands on one of the package's
+            # host-boundary APIs (host_graph_from_device, the host
+            # refiners, quality notes), whose hostness is the hybrid
+            # architecture's contract, not a hidden refactor artifact —
+            # the documented blind spot (docs/static_analysis.md).
+            if (
+                self.span_depth > 0
+                and summary.host_syncs
+                and resolved.module is ctx.module_info
+            ):
+                hline, hdesc = summary.host_syncs[0]
+                self._emit(
+                    "R1", node,
+                    f"call to '{resolved.qualname}' inside a telemetry "
+                    f"span scope reaches a host sync ({hdesc} at "
+                    f"{resolved.module.path}:{hline}); stage the pull "
+                    "outside the span",
+                )
+            if not self.func_stack:
+                # R2b/R6b: import-time reach — the helper may live in a
+                # gate module (platform/perf), where the def site is
+                # exempt, but CALLING it at import time still eagerly
+                # initializes the backend (the test_capi hang class)
+                if summary.device_queries:
+                    qline, qdesc = summary.device_queries[0]
+                    self._emit(
+                        "R2", node,
+                        f"import-time call to '{resolved.qualname}' "
+                        f"reaches {qdesc} ({resolved.module.path}:"
+                        f"{qline}); defer it into a function",
+                    )
+                if summary.perf_introspections:
+                    pline, pdesc = summary.perf_introspections[0]
+                    self._emit(
+                        "R6", node,
+                        f"import-time call to '{resolved.qualname}' "
+                        f"reaches {pdesc} ({resolved.module.path}:"
+                        f"{pline}); defer it behind the perf gate",
+                    )
 
         # R5: gather plans must be checked against the slot cap
         if _terminal_name(node.func) == "build_gather_plan":

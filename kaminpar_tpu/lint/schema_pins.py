@@ -1,0 +1,237 @@
+"""tpulint R9: run-report schema-version pin consistency (cross-file).
+
+The run-report schema version is pinned in FOUR places that have been
+hand-synced v7 -> v12 across six PRs, each bump a chance for silent
+drift:
+
+  1. the producer: ``SCHEMA_VERSION = N`` in
+     ``kaminpar_tpu/telemetry/report.py`` — what live runs emit;
+  2. the schema: the ``schema_version`` enum in
+     ``kaminpar_tpu/telemetry/run_report.schema.json`` — its max must
+     be N or the producer's own output fails validation;
+  3. the checker: the selftest conditional in
+     ``scripts/check_report_schema.py`` (``schema_version != N``) —
+     stale, and the gate accepts an old producer;
+  4. the transition fixtures: the highest ``_minimal_vK_report`` in the
+     same script must be K = N-1 — every historical layout up to the
+     previous version must still validate, and a missing fixture means
+     the new transition is never covered.
+
+Unlike R1-R8 this is not a per-file AST rule: it parses all four sites
+in one pass and emits an R9 finding AT EACH SITE that disagrees with
+the producer pin (so a single-site bump points at the site to fix).
+All sites agreeing — including fixtures at exactly N-1 — is the only
+clean state.
+
+The pin locations are configurable (``LintConfig.r9_*``) so the fixture
+pairs under ``tests/lint_fixtures/r9_{bad,good}/`` exercise the checker
+against miniature site quads without touching the real ones.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import List, Optional, Tuple
+
+from .engine import Finding, LintConfig, _repo_relative
+
+_FIXTURE_RE = re.compile(r"^_minimal_v(\d+)_report$")
+
+
+def _default_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _finding(path: str, line: int, message: str,
+             code: str = "") -> Finding:
+    return Finding(
+        path=_repo_relative(path), rule="R9", line=line, col=0,
+        symbol="<schema-pins>", message=message, code=code,
+    )
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+def _producer_pin(source: str) -> Optional[Tuple[int, int]]:
+    """(value, line) of ``SCHEMA_VERSION = <int>``."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "SCHEMA_VERSION"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)
+                ):
+                    return int(node.value.value), node.lineno
+    return None
+
+
+def _schema_enum_max(source: str) -> Optional[int]:
+    try:
+        schema = json.loads(source)
+    except json.JSONDecodeError:
+        return None
+    enum = (
+        schema.get("properties", {})
+        .get("schema_version", {})
+        .get("enum")
+    )
+    if not isinstance(enum, list) or not enum:
+        return None
+    vals = [v for v in enum if isinstance(v, int)]
+    return max(vals) if vals else None
+
+
+def _checker_pins(source: str) -> Tuple[Optional[Tuple[int, int]],
+                                        Optional[Tuple[int, int]]]:
+    """((conditional value, line), (max fixture K, line)) from the
+    check script: the ``.get("schema_version") != N`` selftest
+    conditional (max when several) and the highest
+    ``_minimal_vK_report`` def."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None, None
+    cond: Optional[Tuple[int, int]] = None
+    fixture: Optional[Tuple[int, int]] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and (
+            isinstance(node.ops[0], ast.NotEq)
+        ):
+            left, right = node.left, node.comparators[0]
+            if not (isinstance(right, ast.Constant)
+                    and isinstance(right.value, int)):
+                continue
+            is_version_read = (
+                isinstance(left, ast.Call)
+                and isinstance(left.func, ast.Attribute)
+                and left.func.attr == "get"
+                and left.args
+                and isinstance(left.args[0], ast.Constant)
+                and left.args[0].value == "schema_version"
+            ) or (
+                isinstance(left, ast.Subscript)
+                and isinstance(left.slice, ast.Constant)
+                and left.slice.value == "schema_version"
+            )
+            if is_version_read and (
+                cond is None or right.value > cond[0]
+            ):
+                cond = (int(right.value), node.lineno)
+        elif isinstance(node, ast.FunctionDef):
+            m = _FIXTURE_RE.match(node.name)
+            if m:
+                k = int(m.group(1))
+                if fixture is None or k > fixture[0]:
+                    fixture = (k, node.lineno)
+    return cond, fixture
+
+
+def check_schema_pins(config: Optional[LintConfig] = None) -> List[Finding]:
+    config = config or LintConfig()
+    root = config.r9_root or _default_root()
+    producer_path = os.path.join(root, config.r9_producer_rel)
+    schema_path = os.path.join(root, config.r9_schema_rel)
+    checker_path = os.path.join(root, config.r9_checker_rel)
+
+    findings: List[Finding] = []
+
+    producer_src = _read(producer_path)
+    schema_src = _read(schema_path)
+    checker_src = _read(checker_path)
+    if producer_src is None or schema_src is None or checker_src is None:
+        # a repo without the report stack (path-subset runs, foreign
+        # trees) has no pins to keep consistent — R9 is vacuous there
+        return findings
+
+    producer = _producer_pin(producer_src)
+    enum_max = _schema_enum_max(schema_src)
+    cond, fixture = _checker_pins(checker_src)
+
+    if producer is None:
+        findings.append(_finding(
+            producer_path, 0,
+            "no `SCHEMA_VERSION = <int>` pin found in the report "
+            "producer — R9 cannot verify the schema quad",
+        ))
+        return findings
+    pin, pin_line = producer
+    quad = (
+        f"producer={pin}, schema enum max={enum_max}, "
+        f"checker conditional={cond[0] if cond else None}, "
+        f"highest fixture=v{fixture[0] if fixture else None}"
+    )
+
+    if enum_max is None:
+        findings.append(_finding(
+            schema_path, 0,
+            "schema_version enum missing/empty in run_report.schema.json",
+        ))
+    elif enum_max != pin:
+        findings.append(_finding(
+            schema_path, 0,
+            f"schema enum tops out at {enum_max} but the producer emits "
+            f"{pin} ({quad}); every pin site must be bumped together",
+        ))
+
+    if cond is None:
+        findings.append(_finding(
+            checker_path, 0,
+            "no `schema_version != <int>` selftest conditional found in "
+            "the schema checker",
+        ))
+    elif cond[0] != pin:
+        findings.append(_finding(
+            checker_path, cond[1],
+            f"selftest conditional pins {cond[0]} but the producer emits "
+            f"{pin} ({quad}); every pin site must be bumped together",
+        ))
+
+    if fixture is None:
+        findings.append(_finding(
+            checker_path, 0,
+            "no `_minimal_v*_report` transition fixture found in the "
+            "schema checker",
+        ))
+    elif fixture[0] != pin - 1:
+        findings.append(_finding(
+            checker_path, fixture[1],
+            f"highest transition fixture is _minimal_v{fixture[0]}_report "
+            f"but the producer emits {pin} — expected v{pin - 1} "
+            f"({quad}); add the fixture for the PREVIOUS version when "
+            "bumping",
+        ))
+
+    # the producer itself is only "wrong" relative to the majority: when
+    # all three other sites agree with each other but not with it, point
+    # at the producer line
+    others = [
+        v for v in (
+            enum_max,
+            cond[0] if cond else None,
+            (fixture[0] + 1) if fixture else None,
+        ) if v is not None
+    ]
+    if others and all(v == others[0] for v in others) and others[0] != pin:
+        findings.append(_finding(
+            producer_path, pin_line,
+            f"SCHEMA_VERSION = {pin} disagrees with the other three pin "
+            f"sites, which all say {others[0]} ({quad})",
+        ))
+    return findings

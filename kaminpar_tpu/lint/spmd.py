@@ -1,0 +1,268 @@
+"""tpulint SPMD rules: R7 collective symmetry, R8 exception hygiene.
+
+R7 is the static half of the PR-12 divergence sentinel.  The dynamic
+sentinel catches a fleet whose ranks disagree *after* the fact; R7 flags
+the code shape that causes it before it ships: control flow that
+branches on a rank-dependent value (``agreement.rank()``,
+``jax.process_index()``, ``is_primary_process()``, ``*RANK*`` env
+reads) and reaches an SPMD collective inside the guarded branch —
+lexically or one helper call deep via the package call graph.  On an
+8-chip mesh the rank that skips a ``psum`` does not fail loudly; the
+seven that entered it hang until the watchdog fires (Tera-Scale
+composition, PAPERS.md arXiv 2410.19119).  The deliberate single-writer
+idiom (every rank agrees on the data, rank 0 alone writes the
+checkpoint/report) stays allowlisted via
+``LintConfig.r7_allow_suffixes`` — those branches do host I/O, not
+collectives.
+
+R8 is the documented "candidate rule" from docs/static_analysis.md,
+promoted.  The degradation contract (resilience/policy.py) requires
+every optional-fast-path failure to be *classified*: structured
+``DegradationError``s degrade visibly, anything else propagates because
+an unclassified exception is a bug.  A bare/broad ``except Exception``
+wrapped around the fault surface (``with_fallback``, ``maybe_inject``,
+any ``site=`` call) defeats exactly that — it swallows both the
+degradation and real bugs, and hides the failure from the chaos suite.
+A broad handler is fine when it ROUTES: re-raises, raises a structured
+error, or calls ``classify``.  Boundary layers whose contract is
+"never let any exception cross" (serving isolation, supervisor marshal,
+telemetry best-effort) are allowlisted via
+``LintConfig.r8_boundary_parts``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .callgraph import (
+    COLLECTIVE_CALLS,
+    FAULT_SURFACE_CALLS,
+    RANK_SOURCE_CALLS,
+    RANK_SOURCE_QUALNAMES,
+    _is_env_rank_read,
+    terminal_name,
+)
+from .engine import Finding, ModuleContext
+
+_BROAD_EXC_NAMES = frozenset({"Exception", "BaseException"})
+
+#: handler-body calls that count as routing the exception into the
+#: degradation contract rather than swallowing it
+_ROUTING_CALLS = frozenset({"classify", "with_fallback"})
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    """``except:``, ``except Exception``, ``except BaseException`` or a
+    tuple containing one of them."""
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for item in types:
+        name = terminal_name(item)
+        if name in _BROAD_EXC_NAMES:
+            return True
+    return False
+
+
+def _handler_routes(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises (bare or structured) or calls
+    into the classification machinery — the contract's escape hatches."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call) and (
+            terminal_name(node.func) in _ROUTING_CALLS
+        ):
+            return True
+    return False
+
+
+def _own_statements(body):
+    """Walk statements pruning nested function bodies (a closure's
+    hazards belong to its own call sites)."""
+    work = list(body)
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+class _SpmdWalker(ast.NodeVisitor):
+    def __init__(self, ctx: ModuleContext) -> None:
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self.func_stack: List[ast.AST] = []
+        self.class_stack: List[str] = []
+        self.rank_guard_depth = 0
+        path = ctx.path.replace("\\", "/")
+        self.r7_allowed = any(
+            path.endswith(sfx) for sfx in ctx.config.r7_allow_suffixes
+        )
+        self.r8_allowed = any(
+            part in path for part in ctx.config.r8_boundary_parts
+        )
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _symbol(self) -> str:
+        if self.func_stack:
+            return ".".join(
+                f.name for f in self.func_stack
+                if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+            )
+        return "<module>"
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        self.findings.append(
+            Finding(
+                path=self.ctx.path,
+                rule=rule,
+                line=line,
+                col=getattr(node, "col_offset", 0),
+                symbol=self._symbol(),
+                message=message,
+                code=self.ctx.line_text(line),
+            )
+        )
+
+    def _resolve(self, call: ast.Call):
+        return self.ctx.resolve_call(
+            call, self.class_stack[-1] if self.class_stack else None
+        )
+
+    # -- structure ---------------------------------------------------------
+
+    def _visit_function(self, node) -> None:
+        self.func_stack.append(node)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    # -- R7: rank-dependent guards around collectives ----------------------
+
+    def _is_rank_dependent(self, test: ast.AST) -> bool:
+        ctx = self.ctx
+        for sub in ast.walk(test):
+            if not isinstance(sub, ast.Call):
+                continue
+            name = terminal_name(sub.func)
+            if name in RANK_SOURCE_CALLS:
+                return True
+            if ctx.qualname(sub.func) in RANK_SOURCE_QUALNAMES:
+                return True
+            if _is_env_rank_read(sub, ctx.aliases):
+                return True
+            resolved = self._resolve(sub)
+            if resolved is not None and ctx.helper_summary(
+                resolved
+            ).rank_dependent:
+                return True
+        return False
+
+    def _visit_guarded(self, node) -> None:
+        rank_dep = self._is_rank_dependent(node.test)
+        self.visit(node.test)
+        self.rank_guard_depth += 1 if rank_dep else 0
+        # BOTH branches of a rank-dependent if are asymmetric: whichever
+        # side carries the collective, some ranks take the other one
+        for stmt in list(node.body) + list(getattr(node, "orelse", [])):
+            self.visit(stmt)
+        self.rank_guard_depth -= 1 if rank_dep else 0
+
+    visit_If = _visit_guarded
+    visit_While = _visit_guarded
+
+    # -- R8: broad handlers around the fault surface -----------------------
+
+    def _fault_surface_reach(self, body) -> Optional[str]:
+        """Description of the first degradation/fault-surface call the
+        try body reaches (lexically or one helper call deep), or None."""
+        for node in _own_statements(body):
+            if not isinstance(node, ast.Call):
+                continue
+            name = terminal_name(node.func)
+            if name in FAULT_SURFACE_CALLS:
+                return f"{name}()"
+            if any(kw.arg == "site" for kw in node.keywords):
+                return f"{name or '<call>'}(site=...)"
+            resolved = self._resolve(node)
+            if resolved is not None:
+                summary = self.ctx.helper_summary(resolved)
+                if summary.fault_surface:
+                    fline, fdesc = summary.fault_surface[0]
+                    return (
+                        f"{fdesc} via '{resolved.qualname}' "
+                        f"({resolved.module.path}:{fline})"
+                    )
+        return None
+
+    def visit_Try(self, node: ast.Try) -> None:
+        if not self.r8_allowed:
+            reach = None
+            for handler in node.handlers:
+                if not _is_broad_handler(handler):
+                    continue
+                if _handler_routes(handler):
+                    continue
+                if reach is None:
+                    reach = self._fault_surface_reach(node.body)
+                if reach is None:
+                    break  # try body never touches the fault surface
+                self._emit(
+                    "R8", handler,
+                    f"broad except swallows failures of the degradation "
+                    f"contract (try body reaches {reach}); raise a "
+                    "structured error, call classify(), or let it "
+                    "propagate — with_fallback owns the catch",
+                )
+        self.generic_visit(node)
+
+    # -- calls -------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.rank_guard_depth > 0 and not self.r7_allowed:
+            name = terminal_name(node.func)
+            if name in COLLECTIVE_CALLS:
+                self._emit(
+                    "R7", node,
+                    f"collective {name}() under rank-dependent control "
+                    "flow: ranks that skip it deadlock the ranks that "
+                    "entered it — hoist the collective out of the guard "
+                    "(every rank must reach it)",
+                )
+            else:
+                resolved = self._resolve(node)
+                if resolved is not None and resolved.node not in (
+                    self.func_stack
+                ):
+                    summary = self.ctx.helper_summary(resolved)
+                    if summary.collectives:
+                        cline, cdesc = summary.collectives[0]
+                        self._emit(
+                            "R7", node,
+                            f"call to '{resolved.qualname}' under "
+                            f"rank-dependent control flow reaches "
+                            f"collective {cdesc} "
+                            f"({resolved.module.path}:{cline}); every "
+                            "rank must reach it",
+                        )
+        self.generic_visit(node)
+
+
+def run_spmd_rules(ctx: ModuleContext) -> List[Finding]:
+    walker = _SpmdWalker(ctx)
+    walker.visit(ctx.tree)
+    return walker.findings

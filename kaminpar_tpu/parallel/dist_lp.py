@@ -573,7 +573,7 @@ def dist_lp_refine(
 
 def dist_singleton_postpasses(
     host_graph,
-    labels: "np.ndarray",
+    labels,
     max_cluster_weight: int,
     threshold: float = 0.5,
     materialize=None,
@@ -601,6 +601,10 @@ def dist_singleton_postpasses(
     supplies the plain-CSR graph lazily the first time the passes
     actually fire — the compressed dist ingestion path
     (dist_partitioner) uses this so a non-firing level never decodes.
+
+    `labels` may be the device array straight off the clusterer: this
+    function owns the device->host pull (the staged host boundary), so
+    callers inside timed spans never carry a bare np.asarray.
     """
     import numpy as np
 

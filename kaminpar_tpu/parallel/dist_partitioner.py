@@ -588,8 +588,11 @@ class dKaMinPar:
                     from .dist_lp import dist_singleton_postpasses
 
                     fine = current  # may be compressed; _plain caches
+                    # the device labels go in raw: the post-pass owns its
+                    # own pull (the staged host boundary), so the span
+                    # never carries a caller-side np.asarray
                     labels = dist_singleton_postpasses(
-                        current, np.asarray(labels), min(mcw, WMAX),
+                        current, labels, min(mcw, WMAX),
                         materialize=lambda: self._plain(fine),
                     )
                     contracted = self._contract_level(current, dg, labels)
@@ -907,6 +910,10 @@ class dKaMinPar:
             int(getattr(self.ctx, "replication_min_nodes_per_device", 0)),
         )
 
+    # host-boundary contract: contraction hands the coarse graph and its
+    # cmap back to the host to re-shard the next level — the pulls ARE
+    # the phase the dist-coarsening span times
+    # tpulint: disable=R1
     def _contract_level(self, current: HostGraph, dg, labels):
         """Contract one coarsening level; returns (coarse, cmap) or None
         when the clustering converged (coarse nearly as big as fine)."""
@@ -943,6 +950,10 @@ class dKaMinPar:
                 return None
         return coarse, cmap
 
+    # host-boundary contract: the replica phase selects + pulls the best
+    # replica's partition to host for the main uncoarsening — the
+    # dist-replicated-coarsening span times this hybrid phase
+    # tpulint: disable=R1
     def _replicated_phase(
         self, split_host: HostGraph, k: int, clusterer, threshold: int,
     ):
@@ -1125,6 +1136,10 @@ class dKaMinPar:
         )
         return jnp.asarray(np.minimum(caps, WMAX), dtype=WEIGHT_DTYPE)
 
+    # host-boundary contract: distributed refinement returns the refined
+    # partition to host per level (the caller projects it up host-side)
+    # — the readback is the handoff the dist-uncoarsening span times
+    # tpulint: disable=R1
     def _refine_dist(
         self, refiner, dg, fine_host, partition, current_k, spans, seed,
         level,

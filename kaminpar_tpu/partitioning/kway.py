@@ -176,6 +176,10 @@ class KWayMultilevelPartitioner:
         refiner = RefinerPipeline(ctx, k)
         if num_levels is None:
             num_levels = coarsener.level + 1
+        # debug hierarchy dumps are STAGED: collected by reference during
+        # the span, pulled to host only after it closes, so the
+        # uncoarsening span never carries the readback (tpulint R1)
+        pending_dumps = []
         with timer.scoped_timer("uncoarsening"):
             level = coarsener.level
             if stage != "uncoarsen":
@@ -215,10 +219,8 @@ class KWayMultilevelPartitioner:
                 )
                 quality_mod.note_refined(level, fine_graph, partition, k=k)
                 if ctx.debug.dump_partition_hierarchy:
-                    debug.dump_partition_hierarchy(
-                        ctx,
-                        np.asarray(partition)[: coarsener.current_n],
-                        level,
+                    pending_dumps.append(
+                        (level, partition, coarsener.current_n)
                     )
                 part_now = partition
                 ckpt.barrier(
@@ -229,6 +231,10 @@ class KWayMultilevelPartitioner:
                     keep=[f"level-{j}" for j in range(level)],
                     meta={"num_levels": num_levels},
                 )
+        for dump_level, dump_part, dump_n in pending_dumps:
+            debug.dump_partition_hierarchy(
+                ctx, np.asarray(dump_part)[:dump_n], dump_level
+            )
 
         # strict balance backstop on the finest level
         partition = refiner.enforce_balance_host(

@@ -61,9 +61,13 @@ class RBMultilevelPartitioner:
                 refined = refiner.refine(
                     dgraph, jnp.asarray(padded), max_bw, min_bw, seed=ctx.seed
                 )
-                refined = refiner.enforce_balance_host(
-                    dgraph, refined,
-                    np.asarray(ctx.partition.max_block_weights), where="rb",
-                )
-                part = np.asarray(refined)[: graph.n]
+            # the balance backstop and final readback live OUTSIDE the
+            # refinement span: both are host-phase work, and keeping the
+            # device->host pull out of the timed region keeps the span
+            # honest about refinement cost (tpulint R1)
+            refined = refiner.enforce_balance_host(
+                dgraph, refined,
+                np.asarray(ctx.partition.max_block_weights), where="rb",
+            )
+            part = np.asarray(refined)[: graph.n]
         return part

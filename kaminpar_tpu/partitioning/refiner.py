@@ -224,17 +224,19 @@ class RefinerPipeline:
             from ..refinement.mtkahypar import mtkahypar_refine_host
 
             def step(partition):
+                # the host pulls happen BEFORE the span opens: the
+                # mtkahypar span times the external refiner, not the
+                # device->host transfer (tpulint R1)
+                host = host_graph_from_device(graph)
+                part_h = np.asarray(partition)[: host.n]
+                caps_h = np.asarray(max_block_weights)[: self.k]
                 with timer.scoped_timer("mtkahypar"):
-                    host = host_graph_from_device(graph)
-                    part_h = np.asarray(partition)[: host.n]
                     # host refiners see the real k, not the padded bucket
                     refined = mtkahypar_refine_host(
                         host,
                         part_h,
                         self.k,
-                        max_block_weights=np.asarray(max_block_weights)[
-                            : self.k
-                        ],
+                        max_block_weights=caps_h,
                         epsilon=self.ctx.partition.epsilon,
                         seed=seed,
                         threads=self.ctx.parallel.num_workers,

@@ -910,6 +910,10 @@ def _node_chunks(graph: Any, chunk_nodes: int):
             )
 
 
+# pure-host numpy kernel: the np.asarray casts view host-resident chunk
+# arrays (the semi-external graph never touches the device), so calling
+# this inside a timed span introduces no hidden device sync.
+# tpulint: disable=R1
 def _host_lp_cluster(graph: Any, max_cluster_weight: int,
                      num_iterations: int = 2,
                      chunk_nodes: int = 1 << 17) -> np.ndarray:
@@ -986,6 +990,8 @@ def _host_lp_cluster(graph: Any, max_cluster_weight: int,
     return compact.astype(np.int64)
 
 
+# pure-host numpy kernel, same contract as _host_lp_cluster above.
+# tpulint: disable=R1
 def _host_contract(graph: Any, labels: np.ndarray,
                    chunk_nodes: int = 1 << 17):
     """Chunked host contraction: aggregate inter-cluster edges block by

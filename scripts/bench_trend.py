@@ -90,6 +90,12 @@ DYNAMIC_COVERAGE_KEYS = ("dynamic_warm_speedup", "dynamic_cut_drift")
 #: throughput trend — the r05 regression class).
 THROUGHPUT_COVERAGE_KEYS = ("requests_per_second", "batch_occupancy")
 
+#: Static-analysis key (round 17, tpulint v2): the BENCH line must
+#: always carry the full-rule lint pass's wall from r06 on (null = the
+#: lint run errored, absence = silent coverage loss of the commit
+#: gate's own cost trend — the r05 regression class).
+LINT_COVERAGE_KEYS = ("tpulint_seconds",)
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -449,6 +455,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{name}: throughput coverage key {key!r} "
                         "missing (bench.py must emit it every run; null "
                         "marks a skipped/failed supervised batch)"
+                    )
+            for key in LINT_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: lint coverage key {key!r} missing "
+                        "(bench.py must emit it every run; null marks "
+                        "an errored lint pass)"
                     )
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target

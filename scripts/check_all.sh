@@ -43,8 +43,22 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-echo "== [1/13] tpulint (vs scripts/tpulint_baseline.json) =="
-python -m kaminpar_tpu.lint kaminpar_tpu/ || exit 1
+echo "== [1/13] tpulint (zero findings, EMPTY baseline, standalone R9) =="
+# full rule set, machine-readable: the gate is zero NEW findings AND an
+# empty baseline — the ratchet finished shrinking in PR 17 and
+# --write-baseline refuses to grow it back
+python -m kaminpar_tpu.lint kaminpar_tpu/ --format json \
+    > /tmp/_kmp_lint.json || { cat /tmp/_kmp_lint.json; exit 1; }
+python - <<'EOF' || exit 1
+import json
+r = json.load(open("/tmp/_kmp_lint.json"))
+assert r["new"] == [], r["new"]
+assert r["baseline_entries"] == 0, (
+    f"baseline regrew to {r['baseline_entries']} entries — it must stay empty")
+print(f"tpulint OK: 0 new finding(s), empty baseline")
+EOF
+# the cross-file schema-pin quad, standalone (R9 needs no file list)
+python -m kaminpar_tpu.lint --select R9 --no-baseline || exit 1
 
 echo "== [2/13] run-report schema (producer selftest, v1-v11 fixtures + v12 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1

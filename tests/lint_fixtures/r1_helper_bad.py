@@ -1,0 +1,26 @@
+"""R1 call-graph bad fixture: the helper-hidden host pull.  The span
+body only makes a function call — pre-PR-17 tpulint saw nothing — but
+`_pull_labels` is a same-module helper whose body syncs the device,
+so the one-level call-graph inlining flags the CALL SITE inside the
+span (and a second shape: a helper hiding a scalar .item())."""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def _pull_labels(labels, n):
+    # host sync hidden one call deep
+    return np.asarray(labels)[:n]
+
+
+def _read_cut(cut):
+    return cut.item()
+
+
+def refine_with_hidden_pulls(graph, labels, kernel, n, out):
+    with scoped_timer("refinement"):
+        labels = kernel(graph, labels)
+        out.append(_pull_labels(labels, n))
+        out.append(_read_cut(jnp.sum(labels)))
+    return out

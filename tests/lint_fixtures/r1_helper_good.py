@@ -1,0 +1,25 @@
+"""R1 call-graph good fixture: the same helpers as r1_helper_bad.py,
+but every call site sits OUTSIDE the span — the device work is
+dispatched in the timed region, the staged boundary pulls after it
+closes.  The helpers themselves are clean: hostness is a property of
+WHERE they are called, not of the def."""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def _pull_labels(labels, n):
+    return np.asarray(labels)[:n]
+
+
+def _read_cut(cut):
+    return cut.item()
+
+
+def refine_with_staged_pulls(graph, labels, kernel, n, out):
+    with scoped_timer("refinement"):
+        labels = kernel(graph, labels)
+    out.append(_pull_labels(labels, n))
+    out.append(_read_cut(jnp.sum(labels)))
+    return out

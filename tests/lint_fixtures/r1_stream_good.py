@@ -1,30 +1,35 @@
-"""R1 good fixture: the out-of-core streaming hook shape done RIGHT —
-chunk decode and the round's scalar readback live in chunkstore-style
-helpers OUTSIDE the driver's timer span (external/chunkstore.py's
-upload/pull_moved pattern: the span body only makes function calls, so
-the host syncs sit in plain module code tpulint's span tracking does
-not cover and the async dispatch queue stays full)."""
+"""R1 good fixture: the out-of-core streaming hook shape done RIGHT.
+Two legitimate idioms under the PR-17 call-graph engine:
+
+* `_upload_chunk` carries a def-line suppression: the chunk decode is
+  a HOST-BOUNDARY function by contract (the chunkstore owns the staged
+  transfer; the asarray views host bytes, not device memory), so the
+  suppression on the def clears every call site at once.
+* the round's scalar readback `_pull_moved` moves OUTSIDE the span —
+  factoring it into a helper no longer hides it from span analysis.
+"""
 import jax.numpy as jnp
 import numpy as np
 
 from kaminpar_tpu.utils.timer import scoped_timer
 
 
+# host-boundary by contract: decodes a HOST chunk for upload; the
+# asarray never touches device memory
+# tpulint: disable=R1
 def _upload_chunk(store, c):
-    # plain helper, not jit-reachable, not lexically inside a span:
-    # the decode/copy is fine here (the chunkstore.upload hook shape)
     return np.asarray(store.chunk(c))
 
 
 def _pull_moved(labels):
-    # the round boundary's single scalar readback, factored out like
-    # chunkstore.pull_moved
+    # the round boundary's single scalar readback — call sites must sit
+    # outside the span
     return int(jnp.sum(labels))
 
 
-def stream_level_with_hooked_pulls(store, labels, kernel, out):
+def stream_level_with_staged_pulls(store, labels, kernel, out):
     with scoped_timer("stream-lp"):
         for c in range(store.num_chunks):
             labels = kernel(labels, _upload_chunk(store, c))
-        out.append(_pull_moved(labels))
+    out.append(_pull_moved(labels))
     return out

@@ -1,0 +1,31 @@
+# tpulint: disable-file=R2  (rank reads are the shape under test)
+"""R7 bad fixture: collectives under rank-dependent control flow —
+the SPMD deadlock shape.  Three firings: a direct psum under a rank
+guard, a collective reached one helper call deep, and a while-loop
+whose trip count is rank-dependent."""
+import os
+
+import jax
+
+
+def _all_reduce(x):
+    # collective hidden one call deep
+    return jax.lax.psum(x, "mesh")
+
+
+def broken_report(x):
+    if jax.process_index() == 0:
+        x = jax.lax.psum(x, "mesh")  # rank 0 enters; 1..7 hang
+    return x
+
+
+def broken_helper_reach(x):
+    if int(os.environ.get("TPU_WORKER_RANK", "0")) == 0:
+        x = _all_reduce(x)
+    return x
+
+
+def broken_loop(x, agreement):
+    while agreement.rank() < 2:
+        x = jax.lax.pmean(x, "mesh")
+    return x

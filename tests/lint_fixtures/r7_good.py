@@ -1,0 +1,27 @@
+# tpulint: disable-file=R2  (rank reads are the shape under test)
+"""R7 good fixture: symmetric collectives and the allowlisted
+single-writer idiom.  Every rank reaches every collective; the
+rank-dependent branch only does host I/O (the checkpoint/report
+rank-0-writes shape), never a collective."""
+import jax
+
+
+def symmetric_reduce(x):
+    # every rank enters: no guard
+    return jax.lax.psum(x, "mesh")
+
+
+def reduce_then_write(x, path):
+    # collective FIRST, symmetric; only the host write is guarded
+    total = jax.lax.psum(x, "mesh")
+    if jax.process_index() == 0:
+        with open(path, "w") as fh:
+            fh.write(str(total))
+    return total
+
+
+def guarded_host_only(flag, log):
+    # rank-dependent branch with no collective anywhere in reach
+    if jax.process_index() == 0:
+        log.append(flag)
+    return log

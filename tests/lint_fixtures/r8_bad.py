@@ -1,0 +1,31 @@
+"""R8 bad fixture: broad except handlers that swallow the degradation
+contract.  Three firings: a bare except around with_fallback, an
+`except Exception` around a site= call, and a broad handler around a
+helper that reaches the fault surface one call deep."""
+from kaminpar_tpu.resilience.policy import with_fallback
+
+
+def _guarded_step(fn, x):
+    # fault surface reached one call deep
+    return with_fallback("lp-refine", fn, x)
+
+
+def swallow_fallback(fn, x):
+    try:
+        return with_fallback("coarsen", fn, x)
+    except:  # noqa: E722
+        return x
+
+
+def swallow_site(inject, x):
+    try:
+        return inject(site="refine-step", value=x)
+    except Exception:
+        return None
+
+
+def swallow_helper_reach(fn, x):
+    try:
+        return _guarded_step(fn, x)
+    except Exception:
+        return x

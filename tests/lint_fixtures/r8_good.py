@@ -1,0 +1,40 @@
+"""R8 good fixture: broad handlers that ROUTE instead of swallow —
+re-raise, raise a structured error, or hand the exception to
+classify() — plus a narrow handler and a try body that never touches
+the fault surface."""
+from kaminpar_tpu.resilience.errors import classify
+from kaminpar_tpu.resilience.policy import with_fallback
+
+
+class DegradationError(RuntimeError):
+    pass
+
+
+def routes_via_classify(fn, x):
+    try:
+        return with_fallback("coarsen", fn, x)
+    except Exception as exc:
+        return classify(exc, site="coarsen")
+
+
+def routes_via_raise(fn, x):
+    try:
+        return with_fallback("refine", fn, x)
+    except Exception as exc:
+        raise DegradationError("refine failed") from exc
+
+
+def narrow_handler(fn, x):
+    try:
+        return with_fallback("lp", fn, x)
+    except ValueError:
+        # narrow: catches one specific, understood failure
+        return x
+
+
+def broad_but_no_fault_surface(values):
+    try:
+        return sum(values) / len(values)
+    except Exception:
+        # try body never reaches the degradation machinery
+        return 0.0

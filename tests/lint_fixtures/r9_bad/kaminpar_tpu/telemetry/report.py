@@ -1,0 +1,10 @@
+"""Miniature report producer for the R9 bad quad: the producer was
+bumped to 3 but the schema enum (max 2), checker conditional (2) and
+fixtures (highest v0) were all left behind — three findings, one per
+stale site."""
+
+SCHEMA_VERSION = 3
+
+
+def build_report():
+    return {"schema_version": SCHEMA_VERSION}

@@ -1,0 +1,12 @@
+"""Miniature schema checker for the R9 bad quad: conditional still
+pins 2 and the only transition fixture is v0 — both stale against the
+producer's 3."""
+
+
+def selftest(report):
+    if report.get("schema_version") != 2:
+        raise SystemExit("stale report")
+
+
+def _minimal_v0_report():
+    return {"schema_version": 0}

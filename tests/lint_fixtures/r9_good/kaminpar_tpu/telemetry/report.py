@@ -1,0 +1,8 @@
+"""Miniature report producer for the R9 good quad: all four pin sites
+agree (producer 3, enum max 3, conditional 3, highest fixture v2)."""
+
+SCHEMA_VERSION = 3
+
+
+def build_report():
+    return {"schema_version": SCHEMA_VERSION}

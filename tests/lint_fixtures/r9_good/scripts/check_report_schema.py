@@ -1,0 +1,15 @@
+"""Miniature schema checker for the R9 good quad: conditional pins 3,
+highest transition fixture is v2 = 3 - 1."""
+
+
+def selftest(report):
+    if report.get("schema_version") != 3:
+        raise SystemExit("stale report")
+
+
+def _minimal_v1_report():
+    return {"schema_version": 1}
+
+
+def _minimal_v2_report():
+    return {"schema_version": 2}
