@@ -132,6 +132,19 @@ def record_padding(n=None, n_pad=None, m=None, m_pad=None,
         )
 
 
+def record_transfer(direction, nbytes, kind="") -> None:
+    """Report one host<->device transfer (direction "h2d" or "d2h",
+    payload size, chokepoint kind) to the execution ledger's transfer
+    leg — the same lazy-import forwarding contract as record_padding,
+    so import-light callers (graphs/csr upload, chunk stores) meter
+    their boundary traffic without importing telemetry eagerly."""
+    try:
+        from .telemetry import ledger
+    except Exception:
+        return
+    ledger.transfer(direction, nbytes, kind=kind)
+
+
 class BoundedCache:
     """A thread-safe LRU cache with an entry cap and a byte budget.
 

@@ -276,10 +276,14 @@ class ChunkStore:
         import jax.numpy as jnp
 
         block = self.chunk_host(c)
-        self.uploaded_bytes += (
+        nbytes = (
             int(block.src_local.nbytes) + int(block.dst.nbytes)
             + int(block.w.nbytes)
         )
+        self.uploaded_bytes += nbytes
+        from ..caching import record_transfer
+
+        record_transfer("h2d", nbytes, kind="chunk-upload")
         return (
             jax.device_put(block.src_local),
             jax.device_put(block.dst),
@@ -466,13 +470,20 @@ def pull_moved(moved) -> int:
     """One scalar readback at a round boundary (the stream's only
     per-round host sync — this is where the async chunk pipeline
     drains)."""
+    from ..caching import record_transfer
+
+    record_transfer("d2h", getattr(moved, "nbytes", 8), kind="stat-pull")
     return int(moved)
 
 
 def pull_labels(labels, n: int) -> np.ndarray:
     """The converged label vector, host-side (one n-sized pull per
     streamed level, at the LP -> contraction boundary)."""
-    return np.asarray(labels[:n], dtype=np.int64)
+    out = np.asarray(labels[:n], dtype=np.int64)
+    from ..caching import record_transfer
+
+    record_transfer("d2h", out.nbytes, kind="chunk-pull")
+    return out
 
 
 def pull_coarse_groups(cu_g, cv_g, w_g) -> Tuple[np.ndarray, np.ndarray,
@@ -480,9 +491,16 @@ def pull_coarse_groups(cu_g, cv_g, w_g) -> Tuple[np.ndarray, np.ndarray,
     """One chunk's deduplicated coarse edges, host-side, compacted to
     the valid groups."""
     cu = np.asarray(cu_g)
+    cv = np.asarray(cv_g)
+    w = np.asarray(w_g)
+    from ..caching import record_transfer
+
+    record_transfer(
+        "d2h", cu.nbytes + cv.nbytes + w.nbytes, kind="chunk-pull"
+    )
     keep = cu >= 0
     return (
         cu[keep].astype(np.int64),
-        np.asarray(cv_g)[keep].astype(np.int64),
-        np.asarray(w_g)[keep].astype(np.int64),
+        cv[keep].astype(np.int64),
+        w[keep].astype(np.int64),
     )

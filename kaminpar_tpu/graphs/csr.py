@@ -146,6 +146,14 @@ def device_graph_from_host(
     node_w = np.zeros(n_pad, dtype=np.dtype(WEIGHT_DTYPE))
     node_w[:n] = graph.node_weight_array().astype(np.dtype(WEIGHT_DTYPE))
 
+    from ..caching import record_transfer
+
+    record_transfer(
+        "h2d",
+        row_ptr.nbytes + src.nbytes + dst.nbytes + edge_w.nbytes
+        + node_w.nbytes,
+        kind="csr-upload",
+    )
     put = partial(jax.device_put, device=device)
     return DeviceGraph(
         row_ptr=put(row_ptr),
@@ -203,12 +211,17 @@ def device_graph_from_compressed(
     node_w[:n] = cgraph.node_weight_array().astype(np.dtype(WEIGHT_DTYPE))
 
     src_parts, dst_parts, w_parts = [], [], []
+    uploaded_bytes = row_ptr.nbytes + node_w.nbytes
     for v0 in range(0, n, chunk_nodes):
         v1 = min(n, v0 + chunk_nodes)
         xr, adj, ew = cgraph.decode_range(v0, v1)
         deg = np.diff(np.asarray(xr, dtype=np.int64))
         src_c = np.repeat(
             np.arange(v0, v1, dtype=np.int32), deg
+        )
+        uploaded_bytes += 2 * src_c.nbytes + (
+            0 if ew is None
+            else len(src_c) * np.dtype(WEIGHT_DTYPE).itemsize
         )
         src_parts.append(jax.device_put(src_c))
         dst_parts.append(jax.device_put(np.asarray(adj, dtype=np.int32)))
@@ -233,6 +246,9 @@ def device_graph_from_compressed(
     src = assemble(src_parts, pad_node, jnp.int32)
     dst = assemble(dst_parts, pad_node, jnp.int32)
     edge_w = assemble(w_parts, 0, np.dtype(WEIGHT_DTYPE))
+    from ..caching import record_transfer
+
+    record_transfer("h2d", uploaded_bytes, kind="csr-upload")
     return DeviceGraph(
         row_ptr=jax.device_put(row_ptr),
         src=src,
@@ -254,6 +270,13 @@ def host_graph_from_device(graph: DeviceGraph) -> HostGraph:
     adjncy = np.asarray(graph.dst[:m], dtype=np.int32)
     edge_w = np.asarray(graph.edge_w[:m], dtype=np.int64)
     node_w = np.asarray(graph.node_w[:n], dtype=np.int64)
+    from ..caching import record_transfer
+
+    record_transfer(
+        "d2h",
+        xadj.nbytes + adjncy.nbytes + edge_w.nbytes + node_w.nbytes,
+        kind="csr-download",
+    )
     return HostGraph(
         xadj=xadj,
         adjncy=adjncy,

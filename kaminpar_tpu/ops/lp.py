@@ -645,9 +645,17 @@ def _lp_cluster_chunked(
     the python salt masked to 31 bits equals the traced int32-wraparound
     product (bit 31 of an addend cannot reach lower sum bits), and all
     state is integer, so results are bitwise-equal (tested)."""
+    from ..caching import record_transfer
+    from ..telemetry import ledger
+
     n_pad = graph.n_pad
     labels = jnp.arange(n_pad, dtype=jnp.int32)
     weights = graph.node_w.astype(ACC_DTYPE)
+    if weights is graph.node_w:
+        # astype was a no-op alias (node weights already ACC_DTYPE);
+        # round 0 donates the carry, so an aliased buffer would delete
+        # the graph's own node weights — force a fresh copy
+        weights = jnp.array(weights, copy=True)
     active = jnp.ones(n_pad, dtype=bool)
     # progress capture, host-side: the chunked driver already reads the
     # convergence scalar back every round, so the series costs one more
@@ -658,10 +666,15 @@ def _lp_cluster_chunked(
     for i in range(iters):
         off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
         salt = (jnp.asarray(seed, jnp.int32) * 131071 + off) & 0x7FFFFFFF
+        tok = ledger.donation_begin((labels, weights, active),
+                                    kind="lp-round")
         labels, weights, active, moved = _lp_cluster_round_launch(
             graph, labels, weights, max_cluster_weight, active,
             salt, jnp.int32(i), cfg, comm, plans,
         )
+        ledger.donation_end(tok)
+        record_transfer("d2h", getattr(moved, "nbytes", 8),
+                        kind="stat-pull")
         if rec:
             moved_series.append(int(moved))
             active_series.append(int(jnp.sum(active)))
@@ -678,7 +691,12 @@ def _lp_cluster_chunked(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg", "has_comm"))
+# the round carry (labels, weights, active) is donated: each chunked
+# round's outputs alias the previous round's buffers instead of
+# doubling the carry footprint per launch.  The execution ledger's
+# donation audit verifies the aliasing was honored (telemetry/ledger.py)
+@partial(jax.jit, static_argnames=("cfg", "has_comm"),
+         donate_argnums=(1, 2, 4))
 def _lp_cluster_round_launch_jit(
     graph, labels, weights, max_cluster_weight, active, salt, i,
     cfg: LPConfig, communities, has_comm: bool, plans=None,
@@ -795,7 +813,8 @@ def lp_cluster(
     )
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+# round carry (part, bw, active) donated — see _lp_cluster_round_launch_jit
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1, 2, 4))
 def _lp_refine_round_launch(graph, part, bw, max_block_weights, active,
                             salt, i, cfg: LPConfig, plans=None):
     return _round_with_delta(
@@ -830,6 +849,9 @@ def lp_refine(
         cfg = replace(cfg, allow_tie_moves=False, refinement=True)
     plans = maybe_edge_plans(graph)  # eager: host readbacks (see lp_cluster)
     if graph.src.shape[0] > MAX_FUSED_EDGE_SLOTS and iters > 1:
+        from ..caching import record_transfer
+        from ..telemetry import ledger
+
         rec = progress_mod.capture()
         t0 = progress_mod.now()
         part = jnp.clip(partition, 0, k - 1).astype(jnp.int32)
@@ -845,10 +867,15 @@ def lp_refine(
             # the python product to 31 bits visits identical states
             off = jnp.int32((i * 1566083941) & 0x7FFFFFFF)
             salt = (jnp.asarray(seed, jnp.int32) * 92821 + off) & 0x7FFFFFFF
+            tok = ledger.donation_begin((part, bw, active),
+                                        kind="lp-round")
             part, bw, active, moved = _lp_refine_round_launch(
                 graph, part, bw, max_block_weights, active, salt,
                 jnp.int32(i), cfg, plans
             )
+            ledger.donation_end(tok)
+            record_transfer("d2h", getattr(moved, "nbytes", 8),
+                            kind="stat-pull")
             if rec:
                 moved_series.append(int(moved))
                 active_series.append(int(jnp.sum(active)))

@@ -15,11 +15,26 @@ from typing import List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from functools import partial
+
+import jax
+
 from ..context import Context
 from ..graphs.csr import DeviceGraph, WEIGHT_DTYPE
 from ..ops.contraction import CoarseGraph, contract_clustering
 from ..ops.lp import LPConfig, lp_cluster
 from ..utils import timer
+
+
+# level-handoff projection with the coarse partition donated: when fine
+# and coarse levels share a pad bucket (same n_pad), the projected fine
+# partition aliases the dead coarse buffer instead of allocating a new
+# one.  Only dispatched when shapes actually permit aliasing (the
+# caller checks), so XLA never warns about unusable donations; the
+# execution ledger's donation audit verifies it was honored.
+@partial(jax.jit, donate_argnums=(0,))
+def _project_partition_donated(partition, cmap):
+    return partition[cmap]
 
 
 @dataclass
@@ -372,7 +387,20 @@ class Coarsener:
         quality_mod.note_cmap(
             level=len(self.levels) + 1, cmap=cmap, fine_n=level.fine_n
         )
-        fine_part = partition[cmap]
+        if (
+            partition.shape == cmap.shape
+            and not isinstance(partition, jax.core.Tracer)
+        ):
+            # same pad bucket: the dead coarse partition's buffer can
+            # back the projected fine partition (donation audited)
+            from ..telemetry import ledger
+
+            tok = ledger.donation_begin((partition,),
+                                        kind="level-handoff")
+            fine_part = _project_partition_donated(partition, cmap)
+            ledger.donation_end(tok)
+        else:
+            fine_part = partition[cmap]
         self.current = fine
         self.current_n = level.fine_n
         return fine, fine_part

@@ -447,6 +447,9 @@ class CheckpointManager:
         self.stats["writes"] += 1
         self.stats["bytes"] += wrote_bytes
         self.stats["wall_s"] += wall
+        from ..telemetry import ledger
+
+        ledger.transfer("d2h", wrote_bytes, kind="checkpoint-spill")
         telemetry.event(
             "checkpoint",
             stage=stage,
@@ -587,7 +590,17 @@ class CheckpointManager:
         self.generation = int(state["generation"])
         self._snapshots = dict(state["snapshot_entries"])
         from .. import telemetry
+        from ..telemetry import ledger
 
+        ledger.transfer(
+            "h2d",
+            sum(
+                int(a.nbytes)
+                for arrs in state.get("arrays", {}).values()
+                for a in arrs.values()
+            ),
+            kind="checkpoint-reload",
+        )
         telemetry.event(
             "checkpoint",
             action="resumed",

@@ -941,6 +941,15 @@ def _worker_compute(msg: dict, send) -> dict:
             "duration_ms": round(wall_s * 1000.0, 3),
             "attrs": {"worker_pid": os.getpid()},
         })
+    # the worker's execution-ledger headline (launch/transfer totals,
+    # pickle-safe) rides the reply so the parent's serving layer can
+    # absorb the request's h2d/d2h bytes (telemetry/ledger.absorb)
+    try:
+        from ..telemetry import ledger
+
+        ledger_summary = ledger.marshal_summary()
+    except Exception:
+        ledger_summary = None
     return {
         "type": "result",
         "path": msg["result_path"],
@@ -956,6 +965,7 @@ def _worker_compute(msg: dict, send) -> dict:
         "rss_bytes": _self_rss_bytes(),
         "wall_s": wall_s,
         "trace_spans": trace_spans,
+        "ledger": ledger_summary,
     }
 
 

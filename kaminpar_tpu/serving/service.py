@@ -1089,6 +1089,14 @@ class PartitionService:
                     trace=bool(tid),
                 )
                 gate_s = float(winfo.get("gate_s") or 0.0)
+                if winfo.get("ledger"):
+                    # fold the worker's h2d/d2h bytes into this
+                    # process's ledger: the transfers happened on the
+                    # request's behalf, just across the containment
+                    # boundary (telemetry/ledger marshal contract)
+                    from ..telemetry import ledger
+
+                    ledger.absorb(winfo["ledger"])
                 if tid and winfo.get("trace_spans"):
                     # marshal the worker-side spans into this request's
                     # timeline: the spawn/ship overhead span first, the

@@ -129,6 +129,14 @@ def enable() -> None:
         perf.install()
     except Exception:
         pass
+    # the execution ledger hooks the executable-call boundary
+    # (idempotent, no-op while disabled / KAMINPAR_TPU_LEDGER=0)
+    try:
+        from . import ledger
+
+        ledger.install()
+    except Exception:
+        pass
 
 
 def disable() -> None:
@@ -161,6 +169,12 @@ def reset() -> None:
         from . import perf
 
         perf.reset()
+    except Exception:
+        pass
+    try:
+        from . import ledger
+
+        ledger.reset()
     except Exception:
         pass
     try:

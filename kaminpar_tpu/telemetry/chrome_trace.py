@@ -208,6 +208,29 @@ def chrome_trace() -> dict:
                             "args": counters,
                         }
                     )
+            if e["name"] == "ledger-transfer":
+                # host<->device transfer bytes render as cumulative
+                # counter tracks (telemetry/ledger.py): each event
+                # carries the running h2d/d2h totals, so the curve's
+                # slope is the transfer rate and steps mark chokepoints
+                attrs = e.get("attrs", {})
+                counters = {
+                    key: attrs[key]
+                    for key in ("h2d_total", "d2h_total")
+                    if key in attrs
+                }
+                if counters:
+                    trace_events.append(
+                        {
+                            "ph": "C",
+                            "cat": "ledger",
+                            "name": "transfer-bytes",
+                            "ts": round(e["t"] * 1e6, 3),
+                            "pid": pid,
+                            "tid": 0,
+                            "args": counters,
+                        }
+                    )
         for series in payload.get("progress", []):
             trace_events.extend(_counter_events(pid, series))
     trace_events.extend(_request_trace_events())

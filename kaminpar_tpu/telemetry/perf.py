@@ -43,11 +43,13 @@ barriers, pad accounting at host-side pad computations — NEVER inside
 jitted code, so the traced jaxprs are identical whether the layer is on,
 off (`KAMINPAR_TPU_PERF=0`), or telemetry is disabled entirely.
 
-Known meter caveats (stamped on the snapshot): cost is captured once per
-*backend compile*, so a warm executable cache registers nothing and a
-scope that re-executes a compiled program many times under-counts bytes
-and FLOPs — utilization figures are lower bounds, strongest on cold
-single-pass runs (bench.py's methodology).
+Meter honesty (stamped per roofline row since PR 19): cost is captured
+once per *backend compile* and joined with the execution ledger's
+per-launch counts (telemetry/ledger.py) — a row whose every launch ran a
+costed executable carries ``honest: true`` and launch-multiplied bytes/
+FLOPs; a row that saw a launch whose cost was never captured (e.g. a
+persistent-cache warm start) carries ``honest: false`` and falls back to
+the compile-time lower bound.
 """
 
 from __future__ import annotations
@@ -75,12 +77,13 @@ DEFAULT_PEAKS: Dict[str, Tuple[float, float]] = {
 FALLBACK_PEAK: Tuple[float, float] = (100.0, 1_000.0)
 
 CAVEAT = (
-    "costs are captured once per backend compile and attributed to the "
-    "open timer scope; executable-cache hits register nothing and "
-    "repeated executions of one compiled program are not multiplied, so "
-    "achieved-vs-peak figures are lower bounds (strongest on cold "
-    "single-pass runs); peaks are configurable via "
-    "KAMINPAR_TPU_PEAK_GBPS / KAMINPAR_TPU_PEAK_GFLOPS"
+    "costs are captured once per backend compile, attributed to the "
+    "open timer scope, and joined with the execution ledger's "
+    "per-launch counts (KAMINPAR_TPU_LEDGER); rows with honest=true "
+    "multiply cost by measured launches, rows with honest=false saw a "
+    "launch whose cost was never captured (e.g. persistent-cache warm "
+    "start) and fall back to the compile-time lower bound; peaks are "
+    "configurable via KAMINPAR_TPU_PEAK_GBPS / KAMINPAR_TPU_PEAK_GFLOPS"
 )
 
 #: Per-scope executable detail kept for triage; aggregates are unbounded
@@ -173,6 +176,15 @@ def _record_executable(exe: Any) -> None:
     name = ""
     try:
         name = exe.hlo_modules()[0].name
+    except Exception:
+        pass
+    try:
+        # the execution ledger joins launches back to this compile's
+        # cost by executable identity (telemetry/ledger.py)
+        from . import ledger
+
+        ledger.register_executable(exe, flops=flops, nbytes=nbytes,
+                                   name=name)
     except Exception:
         pass
     from . import current_scope_path
@@ -320,6 +332,12 @@ def rank_memory_rollup() -> List[dict]:
             np.array([local], dtype=np.int64)
         )
     ).reshape(-1)
+    try:
+        from . import ledger
+
+        ledger.transfer("d2h", gathered.nbytes, "dist-gather")
+    except Exception:
+        pass
     return [
         {"rank": p, "live_bytes": int(gathered[p])} for p in range(nproc)
     ]
@@ -503,25 +521,55 @@ def snapshot() -> dict:
         scopes = {p: dict(e) for p, e in _scopes.items()}
         pad_items = [(key, dict(e)) for key, e in _pad.items()]
 
+    try:
+        from . import ledger as _ledger
+
+        launch_map = _ledger.launch_totals()
+    except Exception:
+        launch_map = {}
+
     walls = _timer_walls()
     roofline: Dict[str, Any] = {}
     tot_flops = tot_bytes = 0.0
-    for path, e in scopes.items():
+    tot_eff_flops = tot_eff_bytes = 0.0
+    tot_launches = tot_uncosted = 0
+    empty = {"flops": 0.0, "bytes": 0.0, "output_bytes": 0,
+             "temp_bytes": 0, "arg_bytes": 0, "compiles": 0,
+             "executables": []}
+    for path in sorted(set(scopes) | set(launch_map)):
+        # a scope can launch without compiling (warm cache under a
+        # fresh scope path) — it still gets a roofline row
+        e = scopes.get(path, empty)
+        lm = launch_map.get(
+            path, {"launches": 0, "uncosted": 0, "bytes": 0.0,
+                   "flops": 0.0},
+        )
         wall, self_wall, calls = walls.get(path, (0.0, 0.0, 0))
+        # honest: every launch in this scope ran a costed executable,
+        # so the ledger figures are the true moved bytes/FLOPs; stale
+        # (honest=false) rows fall back to the compile-time lower bound
+        honest = lm["launches"] > 0 and lm["uncosted"] == 0
+        eff_bytes = lm["bytes"] if honest else max(e["bytes"], lm["bytes"])
+        eff_flops = lm["flops"] if honest else max(e["flops"], lm["flops"])
         row: Dict[str, Any] = {
             "flops": round(e["flops"], 1),
             "bytes": round(e["bytes"], 1),
             "output_bytes": int(e["output_bytes"]),
             "temp_bytes": int(e["temp_bytes"]),
             "compiles": int(e["compiles"]),
+            "launches": int(lm["launches"]),
+            "uncosted_launches": int(lm["uncosted"]),
+            "ledger_bytes": round(lm["bytes"], 1),
+            "ledger_flops": round(lm["flops"], 1),
+            "honest": honest,
             "wall_s": round(wall, 6),
             "self_s": round(self_wall, 6),
             "calls": int(calls),
             "executables": e["executables"],
         }
         if wall > 0:
-            achieved_gbps = e["bytes"] / wall / 1e9
-            achieved_gflops = e["flops"] / wall / 1e9
+            achieved_gbps = eff_bytes / wall / 1e9
+            achieved_gflops = eff_flops / wall / 1e9
             hbm_util = achieved_gbps / pk["gbps"] if pk["gbps"] else 0.0
             flops_util = (
                 achieved_gflops / pk["gflops"] if pk["gflops"] else 0.0
@@ -544,6 +592,10 @@ def snapshot() -> dict:
         roofline[path] = row
         tot_flops += e["flops"]
         tot_bytes += e["bytes"]
+        tot_eff_flops += eff_flops
+        tot_eff_bytes += eff_bytes
+        tot_launches += lm["launches"]
+        tot_uncosted += lm["uncosted"]
 
     pad_rows: List[dict] = []
     pad_real = pad_padded = 0
@@ -596,6 +648,12 @@ def snapshot() -> dict:
     totals: Dict[str, Any] = {
         "flops": round(tot_flops, 1),
         "bytes": round(tot_bytes, 1),
+        # launch-honest twins (execution ledger): compile-time figures
+        # above stay flat across re-launches, these scale with them
+        "ledger_flops": round(tot_eff_flops, 1),
+        "ledger_bytes": round(tot_eff_bytes, 1),
+        "launches": int(tot_launches),
+        "util_honest": bool(tot_launches > 0 and tot_uncosted == 0),
         "compiles": sum(e["compiles"] for e in scopes.values()),
         "wall_s": round(total_wall, 6),
         "pad_waste": _waste(pad_real, pad_padded),
@@ -617,11 +675,14 @@ def snapshot() -> dict:
         },
     }
     if total_wall > 0:
+        # launch-honest: the effective (ledger-joined) byte/FLOP totals
+        # drive the headline utilization; totals["bytes"]/["flops"]
+        # remain the flat compile-time figures for comparison
         totals["hbm_util"] = round(
-            tot_bytes / total_wall / 1e9 / pk["gbps"], 4
+            tot_eff_bytes / total_wall / 1e9 / pk["gbps"], 4
         ) if pk["gbps"] else 0.0
         totals["flops_util"] = round(
-            tot_flops / total_wall / 1e9 / pk["gflops"], 4
+            tot_eff_flops / total_wall / 1e9 / pk["gflops"], 4
         ) if pk["gflops"] else 0.0
 
     return {

@@ -126,6 +126,9 @@ def emit(kind: str, names: Sequence[str], buf, t0: float | None = None,
     import numpy as np
 
     arr = np.asarray(buf)
+    from . import ledger
+
+    ledger.transfer("d2h", arr.nbytes, kind="progress-pull")
     # select written rows (loop order is preserved): buffers indexed by
     # a global counter across rounds legitimately leave sentinel gaps
     # when a round early-exits, so compress rather than prefix-slice

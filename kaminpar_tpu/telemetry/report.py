@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 12
+SCHEMA_VERSION = 13
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -95,6 +95,21 @@ def _perf_section(levels, perf_ranks=None) -> dict:
     if perf_ranks:
         mem["ranks"] = perf_ranks
     return section
+
+
+def _ledger_section() -> dict:
+    """Schema v13 ``ledger`` section: per-scope launch counts joined
+    with executable costs, the host<->device transfer ledger (per
+    scope/kind, per phase, totals), and the donation audit
+    (telemetry/ledger.py).  Well-formed disabled default when the
+    ledger is unavailable."""
+    try:
+        from . import ledger
+
+        return ledger.snapshot()
+    except Exception:
+        return {"enabled": False,
+                "caveat": "execution ledger unavailable"}
 
 
 def _quality_section(ranks=None) -> dict:
@@ -381,6 +396,13 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # per trace id; the report half of the fleet observatory
         # (docs/observability.md "Request tracing")
         "tracing": tracing_section,
+        # schema v13: the execution ledger — per-scope launch counts
+        # (the launch-honest half of the perf roofline), the
+        # host<->device transfer ledger aggregated per scope/kind and
+        # per phase, and the donation audit {requested, honored,
+        # bytes_saved} per scope (telemetry/ledger.py,
+        # docs/observability.md "Execution ledger")
+        "ledger": _ledger_section(),
     }
     if agg is not None:
         report["timers_aggregated"] = agg

@@ -105,37 +105,52 @@ def render_report(report: dict, top_n: int = DEFAULT_TOP_N) -> List[str]:
     # -- roofline: by bytes and by utilization deficit -------------------
     roof = roofline_rows(report)
     if roof:
+        def honest_mark(e: dict) -> str:
+            if "honest" not in e:
+                return "-"  # pre-v13 report: no launch ledger
+            return "yes" if e.get("honest") else "no"
+
         by_bytes = sorted(
             roof.items(), key=lambda kv: -kv[1].get("bytes", 0)
         )[:top_n]
         lines.append("")
         lines.append(f"top {len(by_bytes)} scopes by bytes accessed:")
         lines.extend(_table(
-            ["scope", "bytes", "flops", "wall_s", "GB/s", "hbm_util"],
+            ["scope", "bytes", "flops", "wall_s", "GB/s", "hbm_util",
+             "launches", "honest"],
             [
                 [p, e.get("bytes"), e.get("flops"), e.get("wall_s"),
-                 e.get("achieved_gbps"), e.get("hbm_util")]
+                 e.get("achieved_gbps"), e.get("hbm_util"),
+                 e.get("launches"), honest_mark(e)]
                 for p, e in by_bytes
             ],
         ))
         with_deficit = [
             (p, e) for p, e in roof.items() if e.get("deficit_s")
         ]
+        # honest rows first: their deficit is computed from measured
+        # launch-joined bytes/flops, so they are trustworthy fusion
+        # targets; compile-time-only rows (honest=false / pre-v13) rank
+        # after them regardless of deficit magnitude
         by_deficit = sorted(
-            with_deficit, key=lambda kv: -kv[1]["deficit_s"]
+            with_deficit,
+            key=lambda kv: (not kv[1].get("honest", False),
+                            -kv[1]["deficit_s"]),
         )[:top_n]
         if by_deficit:
             lines.append("")
             lines.append(
                 f"top {len(by_deficit)} scopes by utilization deficit "
-                "(wall below the roofline — fusion-target ranking):"
+                "(wall below the roofline — fusion-target ranking; "
+                "honest rows first):"
             )
             lines.extend(_table(
                 ["scope", "deficit_s", "hbm_util", "flops_util",
-                 "compiles"],
+                 "compiles", "launches", "honest"],
                 [
                     [p, e.get("deficit_s"), e.get("hbm_util"),
-                     e.get("flops_util"), e.get("compiles")]
+                     e.get("flops_util"), e.get("compiles"),
+                     e.get("launches"), honest_mark(e)]
                     for p, e in by_deficit
                 ],
             ))
@@ -236,6 +251,59 @@ def render_report(report: dict, top_n: int = DEFAULT_TOP_N) -> List[str]:
             ],
         ))
 
+    # -- host<->device transfers (schema v13 execution ledger) -----------
+    ledger = report.get("ledger") or {}
+    xfers = ledger.get("transfers") or {}
+    xfer_totals = xfers.get("totals") or {}
+    if xfer_totals.get("h2d_bytes") or xfer_totals.get("d2h_bytes"):
+        lines.append("")
+        lines.append(
+            "host<->device transfers: "
+            f"h2d {_fmt(xfer_totals.get('h2d_bytes'))} B "
+            f"({_fmt(xfer_totals.get('h2d_count'))} xfers), "
+            f"d2h {_fmt(xfer_totals.get('d2h_bytes'))} B "
+            f"({_fmt(xfer_totals.get('d2h_count'))} xfers):"
+        )
+        rows = xfers.get("rows") or []
+        lines.extend(_table(
+            ["scope", "dir", "kind", "bytes", "count"],
+            [
+                [r.get("scope"), r.get("direction"), r.get("kind"),
+                 r.get("bytes"), r.get("count")]
+                for r in rows[:top_n]
+            ],
+        ))
+        by_phase = xfers.get("by_phase") or {}
+        if by_phase:
+            lines.append("transfer bytes by phase:")
+            lines.extend(_table(
+                ["phase", "h2d_bytes", "d2h_bytes"],
+                [
+                    [phase, t.get("h2d_bytes"), t.get("d2h_bytes")]
+                    for phase, t in sorted(
+                        by_phase.items(),
+                        key=lambda kv: -(kv[1].get("h2d_bytes", 0)
+                                         + kv[1].get("d2h_bytes", 0)),
+                    )[:top_n]
+                ],
+            ))
+    donation = ledger.get("donation") or {}
+    don_rows = [
+        (p, d) for p, d in sorted(donation.items())
+        if d.get("requested")
+    ]
+    if don_rows:
+        lines.append("")
+        lines.append("donated-buffer audit (aliasing honored by XLA):")
+        lines.extend(_table(
+            ["scope", "requested", "honored", "bytes_saved"],
+            [
+                [p, d.get("requested"), d.get("honored"),
+                 d.get("bytes_saved")]
+                for p, d in don_rows[:top_n]
+            ],
+        ))
+
     # -- serving latency -------------------------------------------------
     serving = report.get("serving") or {}
     latency = serving.get("latency") or {}
@@ -308,6 +376,19 @@ def render_diff(base: dict, cand: dict,
             f"{_fmt(tc.get('hbm_util'))}, pad_waste "
             f"{_fmt(tb.get('pad_waste'))} -> "
             f"{_fmt(tc.get('pad_waste'))}"
+        )
+    # v13 ledger delta (informational — never a gate): transfer bytes
+    # drifting up between runs is the first sign of a new host sync
+    xb = ((base.get("ledger") or {}).get("transfers") or {}) \
+        .get("totals") or {}
+    xc = ((cand.get("ledger") or {}).get("transfers") or {}) \
+        .get("totals") or {}
+    if xb or xc:
+        lines.append(
+            f"transfers: h2d {_fmt(xb.get('h2d_bytes'))} -> "
+            f"{_fmt(xc.get('h2d_bytes'))} B, d2h "
+            f"{_fmt(xb.get('d2h_bytes'))} -> "
+            f"{_fmt(xc.get('d2h_bytes'))} B"
         )
     return lines
 

@@ -96,6 +96,15 @@ THROUGHPUT_COVERAGE_KEYS = ("requests_per_second", "batch_occupancy")
 #: gate's own cost trend — the r05 regression class).
 LINT_COVERAGE_KEYS = ("tpulint_seconds",)
 
+#: Execution-ledger keys (round 19, telemetry/ledger.py): the BENCH
+#: line must always carry them from r06 on (null = the report had no
+#: ledger, absence = silent coverage loss of the launch-honesty and
+#: transfer-bytes trends — the r05 regression class).  The transfer
+#: VALUES are advisory (printed as a column, never gated); the honesty
+#: of accelerator rounds IS gated — see _roofline_honesty_errors.
+LEDGER_COVERAGE_KEYS = ("util_honest", "launches_total",
+                        "transfer_bytes_per_phase")
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -144,6 +153,37 @@ def check_multichip_round(path: str, entry: Any) -> List[str]:
                 "dryrun_multichip must emit it every round)"
             )
     return errors
+
+
+def _roofline_honesty_errors(name: str, parsed: dict) -> List[str]:
+    """Accelerator rounds with a v13+ embedded report must have a LIVE
+    launch ledger: when every roofline row that reports hbm_util
+    carries honest=false, the ledger recorded nothing and the recorded
+    utilization trend silently degraded to compile-time lower bounds
+    (KAMINPAR_TPU_LEDGER=0 on a recorded round, or the executable-call
+    interception died).  Pre-v13 reports (no `honest` stamps) and
+    CPU-fallback rounds are exempt."""
+    report = parsed.get("report") or {}
+    if not isinstance(report, dict):
+        return []
+    version = report.get("schema_version")
+    if not isinstance(version, int) or version < 13:
+        return []
+    if parsed.get("platform") not in ACCEL_PLATFORMS:
+        return []
+    roof = (report.get("perf") or {}).get("roofline") or {}
+    rows = [
+        e for e in roof.values()
+        if isinstance(e, dict) and e.get("hbm_util") is not None
+    ]
+    if rows and all(not e.get("honest") for e in rows):
+        return [
+            f"{name}: every roofline row is honest=false on an "
+            "accelerator round — the launch ledger recorded nothing "
+            "(dead interception or KAMINPAR_TPU_LEDGER=0 on a "
+            "recorded round)"
+        ]
+    return []
 
 
 def _round_number(name: str) -> Optional[int]:
@@ -285,8 +325,32 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         ),
         "dyn_speedup": parsed.get("dynamic_warm_speedup"),
         "dyn_drift": parsed.get("dynamic_cut_drift"),
+        # round-19 execution ledger (advisory columns): whether the
+        # hbm_util figure is launch-joined truth, and the total
+        # host<->device bytes (promoted key first, embedded report's
+        # ledger totals as the fallback)
+        "honest": parsed.get("util_honest"),
+        "xfer_b": _transfer_bytes(parsed, report),
         "schema": report.get("schema_version"),
     }
+
+
+def _transfer_bytes(parsed: dict, report: dict) -> Optional[int]:
+    totals = (
+        ((report.get("ledger") or {}).get("transfers") or {})
+        .get("totals") or {}
+    )
+    if totals:
+        return (
+            int(totals.get("h2d_bytes", 0)) + int(totals.get("d2h_bytes", 0))
+        ) or None
+    phases = parsed.get("transfer_bytes_per_phase")
+    if isinstance(phases, dict):
+        return sum(
+            int(t.get("h2d_bytes", 0)) + int(t.get("d2h_bytes", 0))
+            for t in phases.values() if isinstance(t, dict)
+        ) or None
+    return None
 
 
 def _fmt(v: Optional[Any]) -> str:
@@ -303,7 +367,8 @@ def render(rows: List[Dict[str, Any]]) -> str:
             "compile_s", "cache_hit", "hbm_util",
             "pad_waste", "locked", "left", "external_s", "overlap",
             "p95_ms", "sup_p95", "rps", "occupancy",
-            "dyn_speedup", "dyn_drift", "platform", "schema")
+            "dyn_speedup", "dyn_drift", "honest", "xfer_b",
+            "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -463,6 +528,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(bench.py must emit it every run; null marks "
                         "an errored lint pass)"
                     )
+            for key in LEDGER_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: ledger coverage key {key!r} missing "
+                        "(bench.py must emit it every run; null marks "
+                        "a report without a ledger section)"
+                    )
+            errors.extend(_roofline_honesty_errors(name, parsed))
     # kernel/cut regression gate on the LATEST parsed round (--check):
     # older rounds ran older code and are history, not a gate target
     latest = None

@@ -60,7 +60,7 @@ EOF
 # the cross-file schema-pin quad, standalone (R9 needs no file list)
 python -m kaminpar_tpu.lint --select R9 --no-baseline || exit 1
 
-echo "== [2/13] run-report schema (producer selftest, v1-v11 fixtures + v12 producer) =="
+echo "== [2/13] run-report schema (producer selftest, v1-v12 fixtures + v13 producer) =="
 python scripts/check_report_schema.py --selftest || exit 1
 
 echo "== [3/13] chaos smoke (KAMINPAR_TPU_FAULTS=all:nth=1) =="
@@ -90,11 +90,31 @@ assert any(
 ), "no roofline scope carries cost"
 assert perf["memory"]["samples"], "no barrier memory samples"
 assert perf["pad_waste"], "no pad-waste rows"
+# v13 execution ledger: a fresh single-process run dispatches every
+# compiled executable with the interception armed, so the launch total
+# is nonzero, the CSR upload metered h2d bytes, and every roofline row
+# that reports hbm_util is launch-honest (launches >= 1, honest=true —
+# the PR-19 acceptance contract; a dishonest row here means the
+# launch/cost join silently died)
+led = r["ledger"]
+assert led["enabled"], led
+assert led["totals"]["launches"] > 0, led["totals"]
+assert led["totals"]["uncosted_launches"] == 0, led["totals"]
+assert led["transfers"]["totals"]["h2d_bytes"] > 0, \
+    led["transfers"]["totals"]
+util_rows = [(p, e) for p, e in perf["roofline"].items()
+             if e.get("hbm_util") is not None]
+assert util_rows, "no roofline row reports hbm_util"
+dishonest = [p for p, e in util_rows
+             if not (e.get("honest") and e.get("launches", 0) >= 1)]
+assert not dishonest, f"launch-dishonest hbm_util rows: {dishonest}"
 print(f"chaos smoke OK: {len(r['degraded'])} degraded event(s), "
       f"gate valid, cut={gate['cut_recomputed']}, "
       f"{len(r['progress'])} progress series, "
       f"{len(perf['roofline'])} roofline scope(s), "
-      f"{len(perf['pad_waste'])} pad-waste row(s)")
+      f"{len(perf['pad_waste'])} pad-waste row(s), "
+      f"{led['totals']['launches']} launches (all costed), "
+      f"h2d={led['transfers']['totals']['h2d_bytes']}B")
 EOF
 # the triage CLI must render the same report and exit 0 (non-empty
 # roofline rows asserted by the flag)
@@ -324,7 +344,7 @@ SUP_START_NS=$SUP_START_NS python - <<'EOF7' || exit 1
 import json, os
 
 r = json.load(open("/tmp/_kmp_sup_smoke/report.json"))
-assert r["schema_version"] == 12, r["schema_version"]
+assert r["schema_version"] == 13, r["schema_version"]
 s = r["serving"]
 by_id = {q["request_id"]: q for q in s["requests"]}
 assert len(by_id) == 10, len(by_id)
@@ -451,7 +471,7 @@ python scripts/check_report_schema.py "$EXT_DIR/ref.json" || exit 1
 python - <<'PYEOF' || exit 1
 import json
 r = json.load(open("/tmp/_kmp_ext_smoke/ref.json"))
-assert r["schema_version"] == 12, r["schema_version"]
+assert r["schema_version"] == 13, r["schema_version"]
 ext = r["external"]
 # the out-of-core contract: >= 1 streamed level, the fine level NEVER
 # device-resident, and the chunk pipeline actually overlapped
@@ -745,7 +765,7 @@ for ln in lines:
     name_labels, value = ln.rsplit(" ", 1)
     samples[name_labels] = float(value)
 r = json.load(open("/tmp/_kmp_obs_smoke/report.json"))
-assert r["schema_version"] == 12, r["schema_version"]
+assert r["schema_version"] == 13, r["schema_version"]
 counts = r["serving"]["counts"]
 # the live counter and the post-mortem report agree on every verdict
 # (counts also carries reason sub-keys like worker-crash — sum the

@@ -93,8 +93,8 @@ def version_checks(report: Any) -> List[str]:
     v8+ additionally the `dist_resilience` section, v9+ additionally
     the `external` section, v10+ additionally the `supervision`
     section, v11+ additionally the `dynamic` section, v12+ additionally
-    the `tracing` section; older reports remain valid without them
-    during the transition."""
+    the `tracing` section, v13+ additionally the `ledger` section;
+    older reports remain valid without them during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -113,6 +113,7 @@ def version_checks(report: Any) -> List[str]:
         (10, ("supervision",)),
         (11, ("dynamic",)),
         (12, ("tracing",)),
+        (13, ("ledger",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -239,6 +240,15 @@ def _minimal_v11_report() -> dict:
     r = _minimal_v10_report()
     r["schema_version"] = 11
     r["dynamic"] = {"enabled": False}
+    return r
+
+
+def _minimal_v12_report() -> dict:
+    """A minimal schema_version-12 report (tracing present, no
+    ledger section) — the twelfth transition fixture."""
+    r = _minimal_v11_report()
+    r["schema_version"] = 12
+    r["tracing"] = {"enabled": False, "traces": []}
     return r
 
 
@@ -389,7 +399,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v12) and validate it plus the embedded v1-v11 transition "
+        "v13) and validate it plus the embedded v1-v12 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -413,21 +423,22 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v12 (progress/compile +
+        # live producer must emit v13 (progress/compile +
         # checkpoint/anytime + serving + perf + memory_budget +
         # quality + dist_resilience + external + supervision +
-        # dynamic + tracing)
-        if report.get("schema_version") != 12:
+        # dynamic + tracing + ledger)
+        if report.get("schema_version") != 13:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 12",
+                f"expected 13",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
                     "memory_budget", "quality", "dist_resilience",
-                    "external", "supervision", "dynamic", "tracing"):
+                    "external", "supervision", "dynamic", "tracing",
+                    "ledger"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -459,14 +470,14 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 1
-        # transition coverage: the v1-v11 layouts must STILL validate
+        # transition coverage: the v1-v12 layouts must STILL validate
         for label, fixture in (
             ("v1", _minimal_v1_report()), ("v2", _minimal_v2_report()),
             ("v3", _minimal_v3_report()), ("v4", _minimal_v4_report()),
             ("v5", _minimal_v5_report()), ("v6", _minimal_v6_report()),
             ("v7", _minimal_v7_report()), ("v8", _minimal_v8_report()),
             ("v9", _minimal_v9_report()), ("v10", _minimal_v10_report()),
-            ("v11", _minimal_v11_report()),
+            ("v11", _minimal_v11_report()), ("v12", _minimal_v12_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

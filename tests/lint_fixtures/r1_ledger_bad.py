@@ -1,0 +1,24 @@
+"""R1 bad fixture: the PR-19 execution-ledger hook shape done WRONG —
+the driver feeds the transfer ledger by pulling device values to the
+host lexically inside the measured upload span (metering a transfer
+must read sizes from host-side metadata, never materialize the
+payload: an np.asarray just to count bytes IS a d2h transfer, and an
+int() of a device scalar host-syncs the dispatch queue mid-span).
+
+Parsed (never executed) by tests/test_lint.py; line numbers are pinned
+there — edit with care.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from kaminpar_tpu.telemetry import ledger
+from kaminpar_tpu.utils.timer import scoped_timer
+
+
+def upload_with_inline_ledger_pulls(chunks, upload, moved):
+    with scoped_timer("device-upload"):
+        for chunk in chunks:
+            buf = upload(chunk)
+            ledger.transfer("h2d", np.asarray(buf).nbytes, "chunk")
+        ledger.transfer("d2h", int(jnp.sum(moved)), "stat-pull")
+    return moved

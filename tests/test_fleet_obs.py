@@ -346,7 +346,7 @@ def test_comm_section_schema_valid_on_dist_smoke(tmp_path):
 def test_schema_version_pins():
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH, SCHEMA_VERSION
 
-    assert SCHEMA_VERSION == 12
+    assert SCHEMA_VERSION == 13
     checker = _load_checker()
     schema = json.load(open(SCHEMA_PATH))
     # the v11 fixture (pre-tracing) still validates untouched
@@ -361,9 +361,17 @@ def test_schema_version_pins():
     v12 = dict(v12_missing, tracing={"enabled": False, "traces": []})
     assert checker.validate_instance(v12, schema) == []
     assert checker.version_checks(v12) == []
+    # claiming v13 without a ledger section is flagged
+    v13_missing = dict(v12, schema_version=13)
+    assert any(
+        "ledger" in e for e in checker.version_checks(v13_missing)
+    )
+    v13 = dict(v13_missing, ledger={"enabled": False})
+    assert checker.validate_instance(v13, schema) == []
+    assert checker.version_checks(v13) == []
     # an unknown future version is rejected, not silently accepted
-    v13 = dict(v12, schema_version=13)
+    v14 = dict(v13, schema_version=14)
     assert any(
         "schema_version" in e
-        for e in checker.validate_instance(v13, schema)
+        for e in checker.validate_instance(v14, schema)
     )

@@ -1,0 +1,435 @@
+"""Execution ledger (telemetry/ledger.py): launch counts pinned against
+a hand-counted round loop, the launch-honest bytes join (2x rounds =>
+2x ledger bytes while the compile-time figure stays flat), transfer
+metering at the chokepoints, the donation audit on a crafted donated
+jit, the supervised-worker marshal, the schema-v13 report section with
+its v12 fixture pin, and the standing dormancy contract
+(KAMINPAR_TPU_LEDGER=0 => bitwise-identical jaxprs, every hook a noop).
+"""
+
+import functools
+import importlib.util
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaminpar_tpu import telemetry
+from kaminpar_tpu.telemetry import ledger
+from kaminpar_tpu.utils.timer import scoped_timer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_report_schema",
+        os.path.join(_REPO, "scripts", "check_report_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# dormancy contract
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_has_zero_jaxpr_impact(monkeypatch):
+    """The standing dormancy pin: every ledger hook is host-side
+    (dispatch boundaries, host pulls, compile results), so the traced
+    jaxpr is bitwise identical whether the ledger is on, killed via
+    KAMINPAR_TPU_LEDGER=0, or telemetry is off entirely."""
+
+    def jaxpr_of_probe():
+        def probe(x):
+            return jnp.cumsum(x) * 2 + jnp.sum(x)
+
+        return str(jax.make_jaxpr(probe)(jnp.arange(64, dtype=jnp.int32)))
+
+    monkeypatch.setenv("KAMINPAR_TPU_PROGRESS", "0")
+    telemetry.disable()
+    j_off = jaxpr_of_probe()
+
+    telemetry.enable()
+    monkeypatch.setenv(ledger.ENV_VAR, "0")
+    assert not ledger.enabled()
+    j_killed = jaxpr_of_probe()
+
+    monkeypatch.delenv(ledger.ENV_VAR)
+    assert ledger.enabled()
+    j_on = jaxpr_of_probe()
+
+    assert j_off == j_killed == j_on
+
+
+def test_disabled_every_entry_point_is_noop(monkeypatch):
+    telemetry.enable()
+    monkeypatch.setenv(ledger.ENV_VAR, "0")
+    ledger.transfer("h2d", 4096, kind="csr-upload")
+    assert ledger.donation_begin((jnp.zeros(4),), kind="x") is None
+    assert ledger.donation_end(None) is None
+    assert ledger.marshal_summary() is None
+    snap = ledger.snapshot()
+    assert snap["enabled"] is False
+    assert snap["transfers"]["totals"]["h2d_bytes"] == 0
+    assert snap["launches"] == {}
+
+
+# ---------------------------------------------------------------------------
+# launch ledger
+# ---------------------------------------------------------------------------
+
+
+def test_launch_counts_match_hand_counted_loop():
+    """Five warm dispatches of one executable inside a scope are five
+    ledger launches, all costed (the fastpath gate routes warm calls
+    through the Python dispatch path while the ledger is armed)."""
+    telemetry.enable()
+
+    @jax.jit
+    def round_fn(x):
+        return x * 2 + 1
+
+    x = jnp.arange(1024, dtype=jnp.int32)
+    ledger.reset()
+    with scoped_timer("ledger-harness"):
+        for _ in range(5):
+            x = round_fn(x)
+        x.block_until_ready()
+
+    totals = ledger.launch_totals()
+    assert totals["ledger-harness"]["launches"] == 5
+    assert totals["ledger-harness"]["uncosted"] == 0
+    assert totals["ledger-harness"]["bytes"] > 0
+
+
+def test_ledger_bytes_scale_with_rounds_compile_stays_flat():
+    """The acceptance pin: 2x rounds => 2x ledger bytes for the scope,
+    while the compile-time cost registry does not grow (no recompile —
+    the extra bytes come from the launch join, not from XLA)."""
+    telemetry.enable()
+
+    @jax.jit
+    def round_fn(x):
+        return x * 3 - 1
+
+    warm = round_fn(jnp.arange(512, dtype=jnp.int32))
+    warm.block_until_ready()
+
+    def run(rounds):
+        ledger.reset()
+        x = jnp.arange(512, dtype=jnp.int32)
+        with scoped_timer("coarsening"):
+            with scoped_timer("lp"):
+                for _ in range(rounds):
+                    x = round_fn(x)
+                x.block_until_ready()
+        snap = ledger.snapshot()
+        entry = snap["launches"]["coarsening.lp"]
+        return entry, snap["totals"]["costed_executables"]
+
+    two, costed_after_two = run(2)
+    four, costed_after_four = run(4)
+
+    assert two["launches"] == 2 and four["launches"] == 4
+    assert two["uncosted_launches"] == four["uncosted_launches"] == 0
+    assert two["bytes"] > 0
+    assert four["bytes"] == pytest.approx(2 * two["bytes"])
+    assert four["flops"] == pytest.approx(2 * two["flops"])
+    # compile-time figure flat: the warm executable was registered once
+    assert costed_after_four == costed_after_two
+
+
+def test_lp_chunked_rounds_are_hand_counted(monkeypatch):
+    """Integration: force the chunked LP clustering path (one round per
+    launch) and pin the ledger's count of the round executable against
+    a hand count of the round-launch calls; the per-round convergence
+    readback shows up as the scope's stat-pull d2h rows."""
+    import kaminpar_tpu.ops.lp as lp_mod
+    import kaminpar_tpu.ops.segments as seg_mod
+    from kaminpar_tpu.graphs import device_graph_from_host, factories
+    from kaminpar_tpu.ops.lp import lp_cluster
+
+    telemetry.enable()
+    monkeypatch.setattr(seg_mod, "MAX_FUSED_EDGE_SLOTS", 512)
+    g = device_graph_from_host(factories.make_rmat(1 << 9, 4_000, seed=3))
+
+    calls = []
+    real = lp_mod._lp_cluster_round_launch
+    monkeypatch.setattr(
+        lp_mod, "_lp_cluster_round_launch",
+        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1],
+    )
+    ledger.reset()
+    with scoped_timer("coarsening"):
+        with scoped_timer("lp"):
+            np.asarray(lp_cluster(g, jnp.int32(40), jnp.int32(4)))
+
+    assert calls, "chunked clustering path never ran"
+    snap = ledger.snapshot()
+    entry = snap["launches"]["coarsening.lp"]
+    round_counts = [
+        c for name, c in entry["executables"].items()
+        if "lp_cluster_round" in name
+    ]
+    assert round_counts == [len(calls)]
+    assert entry["uncosted_launches"] == 0
+    pulls = [
+        r for r in snap["transfers"]["rows"]
+        if r["scope"] == "coarsening.lp" and r["kind"] == "stat-pull"
+    ]
+    assert len(pulls) == 1 and pulls[0]["count"] == len(calls)
+
+
+# ---------------------------------------------------------------------------
+# transfer ledger
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_totals_match_known_sequence():
+    telemetry.enable()
+    ledger.reset()
+    with scoped_timer("partitioning"):
+        with scoped_timer("device-upload"):
+            ledger.transfer("h2d", 1000, kind="csr-upload")
+            ledger.transfer("h2d", 24, kind="csr-upload")
+        with scoped_timer("uncoarsening"):
+            ledger.transfer("d2h", 8, kind="stat-pull")
+            ledger.transfer("d2h", 8, kind="stat-pull")
+            ledger.transfer("d2h", 512, kind="checkpoint-spill")
+    # ignored: bad direction, zero and negative sizes, unintelligible
+    ledger.transfer("sideways", 64, kind="x")
+    ledger.transfer("h2d", 0, kind="x")
+    ledger.transfer("d2h", -5, kind="x")
+    ledger.transfer("d2h", "many", kind="x")
+
+    t = ledger.snapshot()["transfers"]
+    assert t["totals"] == {
+        "h2d_bytes": 1024, "d2h_bytes": 528, "h2d_count": 2,
+        "d2h_count": 3,
+    }
+    by_kind = {(r["scope"], r["direction"], r["kind"]): r for r in t["rows"]}
+    up = by_kind[("partitioning.device-upload", "h2d", "csr-upload")]
+    assert up["bytes"] == 1024 and up["count"] == 2
+    pull = by_kind[("partitioning.uncoarsening", "d2h", "stat-pull")]
+    assert pull["bytes"] == 16 and pull["count"] == 2
+    # rows sorted by descending bytes
+    assert [r["bytes"] for r in t["rows"]] == sorted(
+        (r["bytes"] for r in t["rows"]), reverse=True
+    )
+    # phase rollup: first two dotted segments
+    assert t["by_phase"]["partitioning.device-upload"]["h2d_bytes"] == 1024
+    assert t["by_phase"]["partitioning.uncoarsening"]["d2h_bytes"] == 528
+
+
+def test_device_upload_chokepoint_meters_h2d():
+    from kaminpar_tpu.graphs import device_graph_from_host, factories
+
+    telemetry.enable()
+    ledger.reset()
+    with scoped_timer("partitioning"):
+        with scoped_timer("device-upload"):
+            g = device_graph_from_host(factories.make_grid_graph(8, 8))
+    assert g is not None
+    t = ledger.snapshot()["transfers"]
+    uploads = [
+        r for r in t["rows"]
+        if r["direction"] == "h2d" and "upload" in r["kind"]
+    ]
+    assert uploads and sum(r["bytes"] for r in uploads) > 0
+
+
+def test_transfer_events_render_as_chrome_counter_track(tmp_path):
+    from kaminpar_tpu.telemetry.chrome_trace import write_chrome_trace
+
+    telemetry.enable()
+    ledger.reset()
+    ledger.transfer("h2d", 100, kind="csr-upload")
+    ledger.transfer("d2h", 40, kind="stat-pull")
+    ledger.transfer("h2d", 60, kind="chunk-upload")
+
+    out = tmp_path / "run.trace.json"
+    write_chrome_trace(str(out))
+    trace = json.loads(out.read_text())
+    counters = [
+        e for e in trace["traceEvents"]
+        if e["ph"] == "C" and e["name"] == "transfer-bytes"
+    ]
+    assert len(counters) == 3
+    assert [c["args"]["h2d_total"] for c in counters] == [100, 100, 160]
+    assert [c["args"]["d2h_total"] for c in counters] == [0, 40, 40]
+    # cumulative => monotone: a Perfetto counter track needs no
+    # re-aggregation
+    assert counters == sorted(counters, key=lambda c: c["ts"])
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+
+def test_donation_honored_on_donated_jit():
+    telemetry.enable()
+    ledger.reset()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def bump(x):
+        return x + 1
+
+    x = jnp.arange(2048, dtype=jnp.int32)
+    x.block_until_ready()
+    nbytes = int(x.nbytes)
+    with scoped_timer("coarsening"):
+        with scoped_timer("lp"):
+            tok = ledger.donation_begin((x,), kind="lp-round")
+            y = bump(x)
+            y.block_until_ready()
+            audit = ledger.donation_end(tok)
+    assert audit == {"requested": 1, "honored": 1, "bytes_saved": nbytes}
+    don = ledger.snapshot()["donation"]["coarsening.lp"]
+    assert don["requested"] == 1 and don["honored"] == 1
+    assert don["bytes_saved"] == nbytes == don["requested_bytes"]
+
+
+def test_donation_declined_without_donate_argnums():
+    telemetry.enable()
+    ledger.reset()
+
+    @jax.jit
+    def keep(x):
+        return x + 1
+
+    x = jnp.arange(2048, dtype=jnp.int32)
+    x.block_until_ready()
+    tok = ledger.donation_begin((x,), kind="lp-round")
+    y = keep(x)
+    y.block_until_ready()
+    audit = ledger.donation_end(tok)
+    assert audit == {"requested": 1, "honored": 0, "bytes_saved": 0}
+    # the undonated input is still alive and readable
+    assert int(x[0]) == 0
+
+
+def test_compile_side_alias_metadata_is_parsed():
+    """register_executable's input_output_alias parse — the compile-time
+    half of the audit — sees the donated parameter."""
+    telemetry.enable()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def bump(x):
+        return x * 2
+
+    lowered = bump.lower(jnp.arange(256, dtype=jnp.float32))
+    exe = lowered.compile()
+    runtime_exe = getattr(exe, "runtime_executable", lambda: None)()
+    target = runtime_exe if runtime_exe is not None else exe
+    assert ledger._parse_donated_params(target) >= 1
+
+
+# ---------------------------------------------------------------------------
+# supervised-worker marshal
+# ---------------------------------------------------------------------------
+
+
+def test_marshal_summary_pickles_and_absorbs_transfers_only():
+    telemetry.enable()
+
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    ledger.reset()
+    with scoped_timer("worker"):
+        f(jnp.arange(64, dtype=jnp.int32)).block_until_ready()
+        ledger.transfer("h2d", 300, kind="csr-upload")
+        ledger.transfer("d2h", 70, kind="stat-pull")
+
+    summary = ledger.marshal_summary()
+    assert summary["launches"] >= 1
+    assert summary["h2d_bytes"] == 300 and summary["d2h_bytes"] == 70
+    # rides a multiprocessing reply: must pickle cleanly
+    wire = pickle.loads(pickle.dumps(summary))
+    assert wire == summary
+
+    # parent side: transfer totals fold in under the current scope,
+    # launch counts deliberately do not (they cannot join per-scope
+    # costs across the process boundary, and a fake uncosted entry
+    # would poison the parent's honest stamps)
+    ledger.reset()
+    with scoped_timer("serving"):
+        with scoped_timer("request"):
+            ledger.absorb(wire)
+    snap = ledger.snapshot()
+    assert snap["totals"]["launches"] == 0
+    t = snap["transfers"]
+    assert t["totals"]["h2d_bytes"] == 300
+    assert t["totals"]["d2h_bytes"] == 70
+    kinds = {(r["direction"], r["kind"]) for r in t["rows"]}
+    assert kinds == {("h2d", "worker"), ("d2h", "worker")}
+    assert all(r["scope"] == "serving.request" for r in t["rows"])
+
+
+def test_absorb_tolerates_missing_and_none():
+    telemetry.enable()
+    ledger.reset()
+    ledger.absorb(None)
+    ledger.absorb({})
+    ledger.absorb({"launches": 3})  # no byte keys — nothing to fold
+    totals = ledger.snapshot()["transfers"]["totals"]
+    assert totals["h2d_bytes"] == 0 and totals["d2h_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# schema v13 report section (+ v12 fixture pin)
+# ---------------------------------------------------------------------------
+
+
+def test_report_carries_v13_ledger_section():
+    import kaminpar_tpu as ktp
+    from kaminpar_tpu.graphs import factories
+    from kaminpar_tpu.telemetry.report import build_run_report
+    from kaminpar_tpu.utils.logger import OutputLevel
+
+    telemetry.enable()
+    g = factories.make_grid_graph(16, 16)
+    p = ktp.KaMinPar("default")
+    p.set_output_level(OutputLevel.QUIET)
+    part = p.set_graph(g).compute_partition(k=4, epsilon=0.05, seed=1)
+    assert len(part) == g.n
+
+    report = build_run_report()
+    assert report["schema_version"] == 13
+    led = report["ledger"]
+    assert led["enabled"] is True
+    assert led["totals"]["launches"] >= 1
+    assert led["transfers"]["totals"]["h2d_bytes"] > 0
+
+    checker = _load_checker()
+    assert checker.version_checks(report) == []
+    schema = json.load(open(os.path.join(
+        _REPO, "kaminpar_tpu", "telemetry", "run_report.schema.json"
+    )))
+    assert checker.validate_instance(report, schema) == []
+
+    # v12 fixture pin: a pre-ledger report stays valid at its own
+    # version, and v13 without the ledger section is a hard error
+    v12 = {k: v for k, v in report.items() if k != "ledger"}
+    v12["schema_version"] = 12
+    assert checker.version_checks(v12) == []
+    v13_missing = dict(v12, schema_version=13)
+    assert any("ledger" in e for e in checker.version_checks(v13_missing))

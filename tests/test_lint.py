@@ -55,6 +55,11 @@ BAD_EXPECT = {
     # deep — the span body only makes function calls, the call graph
     # flags the call sites
     "r1_helper_bad.py": [("R1", 24), ("R1", 25)],
+    # the PR-19 execution-ledger hook shape: transfer metering fed by
+    # device-value pulls lexically inside the measured upload span
+    # (ledger pulls inside a driver span = R1; the factored chokepoint
+    # helpers metering from host metadata are clean)
+    "r1_ledger_bad.py": [("R1", 22), ("R1", 23)],
     "r2_bad.py": [("R2", 5), ("R2", 9)],
     "r3_bad.py": [("R3", 7), ("R3", 11), ("R3", 16), ("R3", 21)],
     "r4_bad.py": [("R4", 10), ("R4", 17), ("R4", 23)],
@@ -76,7 +81,7 @@ def test_rule_fires_on_bad_fixture(name):
 
 @pytest.mark.parametrize(
     "name", ["r1_good.py", "r1_quality_good.py", "r1_stream_good.py",
-             "r1_dynamic_good.py", "r1_helper_good.py",
+             "r1_dynamic_good.py", "r1_helper_good.py", "r1_ledger_good.py",
              "r1_supervisor_good.py", "r1_metrics_good.py", "r2_good.py",
              "r3_good.py", "r4_good.py", "r5_good.py", "r6_good.py",
              "r7_good.py", "r8_good.py"]

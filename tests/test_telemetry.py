@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 12
+    assert loaded["schema_version"] == 13
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -456,13 +456,19 @@ def test_chrome_trace_metadata_and_counter_tracks(tmp_path):
     assert "process_name" in names and "thread_name" in names
     proc = next(e for e in meta if e["name"] == "process_name")
     assert "rank" in proc["args"]["name"]
-    counters = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    counters = [e for e in trace["traceEvents"]
+                if e["ph"] == "C" and e["cat"] == "progress"]
     assert len(counters) == 3
     assert counters[0]["name"] == "lp.moved"
     assert [c["args"]["moved"] for c in counters] == [9, 8, 7]
     # counter timestamps are monotone within the series window
     ts = [c["ts"] for c in counters]
     assert ts == sorted(ts) and all(x >= 0 for x in ts)
+    # the series pull itself is metered (schema v13): the execution
+    # ledger's cumulative transfer-bytes track rides the same trace
+    xfer = [e for e in trace["traceEvents"]
+            if e["ph"] == "C" and e["name"] == "transfer-bytes"]
+    assert xfer and xfer[-1]["args"]["d2h_total"] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -692,10 +698,17 @@ def test_schema_accepts_v1_through_v7(tmp_path):
     v12 = dict(v12_missing, tracing={"enabled": False, "traces": []})
     assert checker.validate_instance(v12, schema) == []
     assert checker.version_checks(v12) == []
-    # v13 is not a known version
-    v13 = dict(v1, schema_version=13)
+    # v13 additionally requires the ledger section
+    v13_missing = dict(v12, schema_version=13)
+    assert any("ledger" in e
+               for e in checker.version_checks(v13_missing))
+    v13 = dict(v13_missing, ledger={"enabled": False})
+    assert checker.validate_instance(v13, schema) == []
+    assert checker.version_checks(v13) == []
+    # v14 is not a known version
+    v14 = dict(v1, schema_version=14)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v13, schema))
+               for e in checker.validate_instance(v14, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
