@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -152,6 +152,10 @@ class ChunkStore:
         self.decoded_bytes = 0
         self.uploaded_bytes = 0
         self.spilled_bytes = 0
+        # per-chunk content digest of the spilled file (written by this
+        # process), verified on every re-read — a corrupted spill is a
+        # classified IntegrityViolation recovered by re-decoding
+        self._spill_sha: Dict[int, str] = {}
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
             self._validate_spill_dir()
@@ -165,8 +169,10 @@ class ChunkStore:
 
         xadj = np.asarray(self.source.xadj, dtype=np.int64)
         h = hashlib.sha256()
+        # "v2": spill files moved from bare np.savez to the checksummed
+        # io/snapshot format — a v1 dir must be dropped, not re-read
         h.update(
-            f"n={self.n};m={self.m};chunks={self.num_chunks};"
+            f"v2;n={self.n};m={self.m};chunks={self.num_chunks};"
             f"span={self.span};e_pad={self.e_pad};".encode()
         )
         h.update(xadj[:2048].tobytes())
@@ -215,24 +221,50 @@ class ChunkStore:
         later passes re-read it instead of re-decoding/regenerating."""
         v0, v1 = self.ranges[c]
         if self.spill_dir:
+            from ..io.snapshot import (
+                SnapshotError, read_snapshot, write_snapshot,
+            )
+            from ..resilience import integrity
+
             path = os.path.join(self.spill_dir, f"chunk-{c}.npz")
             if os.path.exists(path):
-                with np.load(path) as z:
-                    adj = z["adjncy"]
-                    ew = z["edge_w"] if "edge_w" in z else None
-                self.decoded_bytes += int(adj.nbytes) + (
-                    0 if ew is None else int(ew.nbytes)
+                # `spill-corrupt` chaos mutates the at-rest bytes; the
+                # per-chunk digest recorded at spill time is what the
+                # re-read verifies (sha checked BEFORE np.load, so a
+                # flipped bit is a digest mismatch, not a zip error)
+                integrity.chaos_flip_file("spill-corrupt", path)
+                expect = (
+                    self._spill_sha.get(c) if integrity.enabled() else None
                 )
-                return adj, ew
+                try:
+                    z = read_snapshot(path, expect)
+                except (SnapshotError, OSError, ValueError) as exc:
+                    # corrupted spill file: a classified integrity
+                    # violation with a LOCAL recovery — drop the file
+                    # and re-decode from the source (the spill tier is
+                    # a cache; with_fallback has no business here)
+                    integrity.note_digest_mismatch(
+                        f"spill:chunk-{c}", str(exc), site="spill-corrupt"
+                    )
+                    self._spill_sha.pop(c, None)
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                else:
+                    adj = z["adjncy"]
+                    ew = z.get("edge_w")
+                    self.decoded_bytes += int(adj.nbytes) + (
+                        0 if ew is None else int(ew.nbytes)
+                    )
+                    return adj, ew
             adj, ew = self.source.rows(v0, v1)
             arrays = {"adjncy": adj}
             if ew is not None:
                 arrays["edge_w"] = ew
-            # np.savez appends .npz to bare names — keep the suffix on
-            # the temp file so the atomic replace finds what was written
-            tmp = path + f".{os.getpid()}.tmp.npz"
-            np.savez(tmp, **arrays)
-            os.replace(tmp, path)
+            # checksummed snapshot format (io/snapshot.py): atomic
+            # write, content sha stored for the re-read verification
+            _, self._spill_sha[c] = write_snapshot(path, arrays)
             self.spilled_bytes += int(adj.nbytes) + (
                 0 if ew is None else int(ew.nbytes)
             )

@@ -431,11 +431,19 @@ class KaMinPar:
         host-spilled hierarchy, semi-external streaming, host-only)
         instead of surfacing RESOURCE_EXHAUSTED.  A plain try-through
         when the governor is dormant and nothing OOMs."""
+        from .resilience import integrity as integrity_mod
         from .resilience import memory as mem_mod
 
-        return mem_mod.run_ladder(
-            lambda: self._partition_core_resilient(graph, ctx),
-            graph, ctx, facade=self,
+        # corruption-recovery ladder OUTSIDE the OOM ladder: a sentinel
+        # violation (silent data corruption detected at a phase boundary)
+        # re-executes once from the last clean checkpoint barrier; a
+        # second violation is the `corrupt-result` verdict and propagates
+        return integrity_mod.run_with_retry(
+            lambda: mem_mod.run_ladder(
+                lambda: self._partition_core_resilient(graph, ctx),
+                graph, ctx, facade=self,
+            ),
+            where="partition-core",
         )
 
     def _partition_core_resilient(self, graph, ctx: Context) -> np.ndarray:

@@ -298,6 +298,22 @@ class Coarsener:
             # coarsest graph directly, so declare convergence instead of
             # limping to the threshold.
             return False
+        # integrity sentinels (resilience/integrity.py): corruption
+        # chaos first — `bit-flip:contraction` genuinely mutates a
+        # coarse edge weight in flight — then the conservation / range /
+        # surjectivity / symmetry checks on the accepted level.  A
+        # violation fires BEFORE this level's barrier, so the manifest
+        # still points at the last clean one and the retry ladder
+        # (integrity.run_with_retry) resumes there.  One separate small
+        # jitted reduction, host compares; the LP/contraction jaxprs
+        # above are untouched whether integrity is on or off.
+        from ..resilience import integrity as integrity_mod
+
+        coarse = integrity_mod.chaos_corrupt_contraction(coarse)
+        integrity_mod.check_contraction(
+            self.current, coarse.cmap, coarse.graph,
+            level=self.level, fine_n=self.current_n, coarse_n=c_n,
+        )
         self.levels.append(
             CoarseningLevel(
                 fine_graph=self.current,

@@ -75,14 +75,39 @@ class RefinerPipeline:
         # outer hierarchy's verdict join.
         from ..telemetry import quality as quality_mod
 
+        from ..resilience import integrity as integrity_mod
+
         with progress_mod.tag(
             level=level, num_levels=num_levels,
             quality_hierarchy=quality_mod.current_id(),
         ):
-            return self._refine_tagged(
+            # refinement sentinels (resilience/integrity.py): probe
+            # (cut, feasibility, label range) before and after the
+            # accepted pass — a feasible->feasible pass that RAISED the
+            # cut, or a label outside [0, k), is silent corruption, not
+            # a degradation.  `bit-flip:partition` chaos mutates the
+            # refined vector in flight so the detector is exercised
+            # end-to-end.  Separate small jitted reductions; the
+            # LP/Jet/balancer jaxprs are untouched either way.
+            before = integrity_mod.refine_probe(
+                graph, partition, max_block_weights, min_block_weights
+            )
+            refined = self._refine_tagged(
                 graph, partition, k, max_block_weights, min_block_weights,
                 seed, level, num_levels,
             )
+            refined = integrity_mod.chaos_corrupt_partition(refined)
+            after = integrity_mod.refine_probe(
+                graph, refined, max_block_weights, min_block_weights
+            )
+            integrity_mod.check_refinement(
+                before, after, k=int(k), level=level
+            )
+            if after is not None:
+                integrity_mod.audit_refine_cut(
+                    graph, refined, after[0], level=level
+                )
+            return refined
 
     def _refine_tagged(
         self, graph, partition, k, max_block_weights, min_block_weights,

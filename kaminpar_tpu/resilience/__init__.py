@@ -35,6 +35,7 @@ from .errors import (  # noqa: F401
     DegradationError,
     DeltaApplyFailed,
     DeviceOOM,
+    IntegrityViolation,
     NativeUnavailable,
     PlanBlowup,
     RankDivergence,
@@ -60,6 +61,7 @@ from .policy import (  # noqa: F401
     with_fallback,
 )
 from . import gate  # noqa: F401
+from . import integrity  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import deadline  # noqa: F401
 from . import agreement  # noqa: F401
@@ -74,6 +76,7 @@ def reset() -> None:
 
     _faults.reset()
     reset_breakers()
+    integrity.reset()
     checkpoint.deactivate()
     deadline.clear()
     agreement.disarm()

@@ -195,6 +195,40 @@ class StageHang(DegradationError):
         self.ceiling_s = ceiling_s
 
 
+class IntegrityViolation(DegradationError):
+    """An integrity sentinel or exchange digest detected silent data
+    corruption (resilience/integrity.py): a conservation invariant
+    broken across a contraction, a partition vector out of range, an
+    accepted refinement pass that *increased* the cut, a content digest
+    that no longer matches its bytes (spill re-read, worker reply,
+    cached result), or a sampled re-execution audit that disagreed with
+    the device bitwise.
+
+    ``invariant`` names the violated check (the degradation-matrix row),
+    ``level`` the hierarchy level it fired at (None outside the
+    multilevel drivers), ``scope_path`` the phase boundary.  NEVER
+    absorbed by ``policy.with_fallback`` — a corrupted value has no
+    documented fallback twin; the only safe responses are the bounded
+    retry-from-last-good-barrier ladder (integrity.run_with_retry) or,
+    for exchange digests, a re-fetch from the source of truth.
+    Crash-shaped: it advances the circuit breaker."""
+
+    def __init__(
+        self,
+        message: str = "",
+        *,
+        invariant: str = "",
+        level: Optional[int] = None,
+        scope_path: str = "",
+        site: Optional[str] = None,
+        injected: bool = False,
+    ) -> None:
+        super().__init__(message, site=site, injected=injected)
+        self.invariant = invariant
+        self.level = level
+        self.scope_path = scope_path
+
+
 class WorkerCrash(DegradationError):
     """A supervised worker subprocess died — segfault in the native
     library, allocator kill, or an injected SIGKILL (the

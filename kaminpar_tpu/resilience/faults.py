@@ -22,6 +22,14 @@ resilience/agreement.py), and ``spec`` is
   * ``nth=K``            — exactly the K-th call at the site fails
                            (1-based; ``all:nth=1`` is the chaos smoke
                            plan: first call at EVERY site fails once),
+
+``all`` covers the degradation-contract sites only: the corruption-chaos
+sites (exception type :class:`IntegrityViolation` — ``bit-flip:*``,
+``spill-corrupt``, ``cache-poison``, ``worker-reply-corrupt``) must be
+named explicitly.  Their detectors RETRY from the last clean barrier
+rather than degrade in place, so a batch of them in one run exceeds the
+bounded recovery ladder by design (integrity.MAX_RETRIES); the
+integrity smoke in check_all.sh exercises them one plan at a time.
   * a float in (0, 1]    — each call fails with that probability,
                            drawn deterministically from the global seed
                            (utils.rng), the site name, and the per-site
@@ -46,6 +54,7 @@ from .errors import (
     DegradationError,
     DeltaApplyFailed,
     DeviceOOM,
+    IntegrityViolation,
     NativeUnavailable,
     PlanBlowup,
     RankDivergence,
@@ -176,6 +185,48 @@ _register(SiteSpec(
     "(dynamic/session.py; deltas that fit the padded bucket's slack "
     "reuse the compiled executables)",
 ))
+# corruption-chaos sites (resilience/integrity.py): injection here does
+# NOT raise at the site — the integrity chaos helpers catch the injected
+# IntegrityViolation and genuinely mutate bytes in flight, so the
+# DETECTORS (sentinels / digests) are what the chaos suite exercises
+_register(SiteSpec(
+    "bit-flip:contraction", IntegrityViolation,
+    "none at the site — the flipped projection-map bit is DETECTED by "
+    "the contraction sentinels (edge-weight conservation / cmap range) "
+    "and recovered by one retry from the last clean barrier",
+    "silent bit-flip in a contraction's projection map "
+    "(partitioning/coarsener.py; chaos mutates a cmap entry in flight)",
+))
+_register(SiteSpec(
+    "bit-flip:partition", IntegrityViolation,
+    "none at the site — the corrupted partition entry is DETECTED by "
+    "the refinement sentinels (partition-range) and recovered by one "
+    "retry from the last clean barrier",
+    "silent bit-flip in a refined partition vector "
+    "(partitioning/refiner.py; chaos mutates a partition entry)",
+))
+_register(SiteSpec(
+    "spill-corrupt", IntegrityViolation,
+    "digest mismatch on re-read -> drop the spill file, re-decode the "
+    "chunk from its source, rewrite (local recovery; never garbage rows)",
+    "chunkstore spill-tier file corruption "
+    "(external/chunkstore.py; chaos flips a byte in the spilled file)",
+))
+_register(SiteSpec(
+    "cache-poison", IntegrityViolation,
+    "digest mismatch on hit -> forced miss + evict; the request "
+    "recomputes (a poisoned entry is never served)",
+    "serving result-cache entry corruption "
+    "(serving/service.py; chaos flips a bit in the cached partition)",
+))
+_register(SiteSpec(
+    "worker-reply-corrupt", IntegrityViolation,
+    "reply digest mismatch -> classified IntegrityViolation for that "
+    "request (verdict `failed`/reason `corrupt-result`); the worker "
+    "keeps serving",
+    "supervised-worker npz reply corruption "
+    "(resilience/supervisor.py; chaos flips a byte in the reply file)",
+))
 _register(SiteSpec(
     "rank-divergence", RankDivergence,
     "none — structured abort with the per-rank state dump (divergence "
@@ -237,6 +288,15 @@ def parse_plan(raw: str) -> List[_FaultRule]:
                 )
             if rank < 0:
                 raise FaultPlanError(f"rank must be >= 0 in {part!r}")
+        if site not in SITES and site != "all":
+            # colon-named sites (`bit-flip:contraction`): the first-colon
+            # split above took the site's own second segment as the spec
+            # — rejoin it when that yields a registered name, leaving the
+            # remainder (if any) as the real spec
+            head, _, rest = spec.partition(":")
+            cand = f"{site}:{head.strip()}"
+            if cand in SITES:
+                site, spec = cand, rest
         if site != "all" and site not in SITES:
             raise FaultPlanError(
                 f"unknown fault site {site!r} (registered: "
@@ -303,6 +363,12 @@ def maybe_inject(site: str, **attrs) -> None:
     local_rank: Optional[int] = None
     for rule in plan.rules:
         if rule.site != "all" and rule.site != site:
+            continue
+        if rule.site == "all" and issubclass(spec.exc, IntegrityViolation):
+            # `all` plans cover the degradation contract; corruption
+            # chaos is opt-in by name (see module docstring) — two
+            # corruption sites firing in one run would exhaust the
+            # bounded retry ladder by construction, not by bug
             continue
         if rule.rank is not None:
             if local_rank is None:

@@ -138,8 +138,16 @@ def with_fallback(
                 faults.maybe_inject(site)
             result = primary()
         except Exception as exc:  # classified below; unknowns re-raise
-            from .errors import StageHang
+            from .errors import IntegrityViolation, StageHang
 
+            if isinstance(exc, IntegrityViolation):
+                # detected silent data corruption has no documented
+                # fallback twin — absorbing it would serve a wrong
+                # answer under a `degraded` verdict.  It propagates to
+                # the retry-from-last-good-barrier ladder
+                # (integrity.run_with_retry) or the caller's explicit
+                # re-fetch path, never into this site's fallback.
+                raise
             if isinstance(exc, StageHang) and not exc.injected:
                 # an async-delivered watchdog verdict (a hung stage)
                 # is a process-level failure that happened to LAND

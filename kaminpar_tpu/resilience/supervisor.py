@@ -696,11 +696,39 @@ class WorkerPool:
     def _finish(self, worker: _WorkerHandle, request_id: str, reply: dict):
         import numpy as np
 
-        from ..io.snapshot import read_snapshot
+        from ..io.snapshot import SnapshotError, read_snapshot
+        from . import integrity
 
-        part = np.asarray(
-            read_snapshot(reply["path"])["partition"], dtype=np.int32
+        # `worker-reply-corrupt` chaos mutates the spool file after the
+        # worker wrote it; the digest the reply carries is what the
+        # parent-side verification catches it with.  A mismatch is a
+        # classified IntegrityViolation (`corrupt-result` taxonomy at
+        # the serving layer), NOT malformed-input — the worker finished
+        # cleanly, the bytes rotted in the exchange.
+        integrity.chaos_flip_file("worker-reply-corrupt", reply["path"])
+        expect = (
+            reply.get("sha256") if integrity.enabled() else None
         )
+        try:
+            part = np.asarray(
+                read_snapshot(reply["path"], expect)["partition"],
+                dtype=np.int32,
+            )
+        except (SnapshotError, ValueError) as exc:
+            # keep the worker bookkeeping honest before propagating:
+            # the worker itself behaved, only the reply bytes are bad
+            try:
+                os.unlink(reply["path"])
+            except OSError:
+                pass
+            worker.requests += 1
+            self.stats["requests"] += 1
+            self._maybe_recycle(worker)
+            heartbeat_touch()
+            raise integrity.note_digest_mismatch(
+                f"worker-reply:{request_id}", str(exc),
+                site="worker-reply-corrupt",
+            ) from exc
         try:
             os.unlink(reply["path"])
         except OSError:
@@ -920,7 +948,7 @@ def _worker_compute(msg: dict, send) -> dict:
     degraded = sorted({
         e.attrs.get("site", "") for e in telemetry.events("degraded")
     } - {""})
-    write_snapshot(
+    _, result_sha = write_snapshot(
         msg["result_path"],
         {"partition": np.asarray(part, dtype=np.int32)},
     )
@@ -953,6 +981,10 @@ def _worker_compute(msg: dict, send) -> dict:
     return {
         "type": "result",
         "path": msg["result_path"],
+        # content digest of the written reply file: the parent verifies
+        # it on re-read (resilience/integrity.py exchange contract), so
+        # spool-file corruption between processes cannot serve silently
+        "sha256": result_sha,
         "metrics": {
             "cut": int(metrics["cut"]),
             "imbalance": float(metrics["imbalance"]),

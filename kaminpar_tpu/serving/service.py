@@ -767,10 +767,43 @@ class PartitionService:
             pre_degraded.append("serving-cache")
             return None
 
-        return with_fallback(
+        cached = with_fallback(
             lambda: self._result_cache.get(key), forced_miss,
             site="serving-cache", where=req.request_id,
         )
+        if cached is None:
+            return None
+        from ..resilience import integrity
+        from ..resilience.errors import IntegrityViolation
+
+        # entries written before the digest upgrade verify vacuously
+        if len(cached) == 3:
+            part, metrics, digest = cached
+        else:
+            part, metrics = cached
+            digest = ""
+        # `cache-poison` chaos flips a bit of the array ABOUT to be
+        # served; the stored content digest is what catches it.  A
+        # poisoned entry must read as a forced miss + evict — served
+        # stale bytes are the one cache failure mode worse than a miss.
+        part = integrity.chaos_flip_array("cache-poison", part)
+        try:
+            integrity.verify_digest(
+                digest, part,
+                what=f"result-cache:{req.request_id}",
+                site="cache-poison",
+            )
+        except IntegrityViolation:
+            self._result_cache.evict(key)
+            pre_degraded.append("cache-poison")
+            from ..utils.logger import log_warning
+
+            log_warning(
+                f"serving[{req.request_id}]: result-cache entry failed "
+                "digest verification; evicted, recomputing"
+            )
+            return None
+        return part, metrics
 
     def _note_failure(self, rec: RequestRecord, exc: BaseException,
                       cls: str, cls_submit: str) -> None:
@@ -800,6 +833,12 @@ class PartitionService:
                 "worker-hang" if self._pool is not None
                 else "stage-hang"
             )
+        elif isinstance(err, res_errors.IntegrityViolation):
+            # detected silent data corruption that exhausted the
+            # retry-from-barrier ladder (or a corrupted worker reply):
+            # its own taxonomy row — NOT malformed-input, the input was
+            # fine; the bytes rotted in compute or exchange
+            rec.reason = "corrupt-result"
         else:
             rec.reason = (
                 "malformed-input" if _input_shaped(exc)
@@ -1179,11 +1218,17 @@ class PartitionService:
             # only clean full-effort results are worth replaying; an
             # anytime/degraded answer must not be served to a request
             # that had the time to do better
+            from ..resilience import integrity
+
+            part_arr = np.asarray(part)
+            # entry digest stamped at put, verified on every hit
+            # (resilience/integrity.py exchange contract)
             self._result_cache.put(
                 key,
-                (np.asarray(part), {**metrics,
-                                    "gate_valid": rec.gate_valid}),
-                nbytes=np.asarray(part).nbytes,
+                (part_arr,
+                 {**metrics, "gate_valid": rec.gate_valid},
+                 integrity.content_digest(part_arr)),
+                nbytes=part_arr.nbytes,
             )
         return rec
 

@@ -25,7 +25,7 @@ from . import jsonable
 from . import progress_series as _progress_series
 from . import run_info as _run_info
 
-SCHEMA_VERSION = 13
+SCHEMA_VERSION = 14
 SCHEMA_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "run_report.schema.json"
 )
@@ -110,6 +110,21 @@ def _ledger_section() -> dict:
     except Exception:
         return {"enabled": False,
                 "caveat": "execution ledger unavailable"}
+
+
+def _integrity_section() -> dict:
+    """Schema v14 ``integrity`` section: sentinel check/violation
+    counts, the retry-from-barrier ladder outcome (verdict clean /
+    detected / recovered / corrupt-result), exchange-digest tallies,
+    and the sampled re-execution audits per scope
+    (resilience/integrity.py).  Well-formed disabled default when the
+    kill switch is set and nothing ran."""
+    try:
+        from ..resilience import integrity
+
+        return integrity.summary()
+    except Exception:
+        return {"enabled": False}
 
 
 def _quality_section(ranks=None) -> dict:
@@ -403,6 +418,14 @@ def build_run_report(extra_run: Optional[dict] = None) -> dict:
         # bytes_saved} per scope (telemetry/ledger.py,
         # docs/observability.md "Execution ledger")
         "ledger": _ledger_section(),
+        # schema v14: the integrity audit — invariant-sentinel checks
+        # and violations (named invariant + level + scope), the
+        # retry-from-last-good-barrier outcome, exchange-digest
+        # computed/verified/mismatched tallies, and the sampled
+        # re-execution audits {audited, mismatched} per scope
+        # (resilience/integrity.py, docs/robustness.md "Integrity
+        # contract")
+        "integrity": _integrity_section(),
     }
     if agg is not None:
         report["timers_aggregated"] = agg

@@ -105,6 +105,15 @@ LINT_COVERAGE_KEYS = ("tpulint_seconds",)
 LEDGER_COVERAGE_KEYS = ("util_honest", "launches_total",
                         "transfer_bytes_per_phase")
 
+#: Integrity-sentinel key (round 20, resilience/integrity.py): the
+#: BENCH line must always carry the sentinel-overhead percentage from
+#: r06 on (0.0 = the kill switch disabled the layer, absence = silent
+#: coverage loss of the corruption-defense cost trend — the r05
+#: regression class).  The VALUE is advisory only (printed as a
+#: column, never gated): the < 3% dormancy budget is a test assertion
+#: (tests/test_integrity.py), not a trend gate.
+INTEGRITY_COVERAGE_KEYS = ("integrity_overhead_pct",)
+
 #: Platforms whose wall/utilization figures are meaningful (the CPU
 #: fallback's walls are smoke signals by repo doctrine — bench.py
 #: stamps `platform` exactly so gates can tell).
@@ -331,6 +340,10 @@ def _row(path: str, entry: dict) -> Dict[str, Any]:
         # ledger totals as the fallback)
         "honest": parsed.get("util_honest"),
         "xfer_b": _transfer_bytes(parsed, report),
+        # round-20 integrity sentinels (advisory column): host-side
+        # sentinel wall as % of the partition wall — the dormancy
+        # budget as a trend line
+        "integ_pct": parsed.get("integrity_overhead_pct"),
         "schema": report.get("schema_version"),
     }
 
@@ -368,7 +381,7 @@ def render(rows: List[Dict[str, Any]]) -> str:
             "pad_waste", "locked", "left", "external_s", "overlap",
             "p95_ms", "sup_p95", "rps", "occupancy",
             "dyn_speedup", "dyn_drift", "honest", "xfer_b",
-            "platform", "schema")
+            "integ_pct", "platform", "schema")
     table = [cols] + [tuple(_fmt(r[c]) for c in cols) for r in rows]
     widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
     lines = [
@@ -534,6 +547,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"{name}: ledger coverage key {key!r} missing "
                         "(bench.py must emit it every run; null marks "
                         "a report without a ledger section)"
+                    )
+            for key in INTEGRITY_COVERAGE_KEYS:
+                if key not in parsed:
+                    errors.append(
+                        f"{name}: integrity coverage key {key!r} "
+                        "missing (bench.py must emit it every run; 0.0 "
+                        "marks a kill-switched integrity layer)"
                     )
             errors.extend(_roofline_honesty_errors(name, parsed))
     # kernel/cut regression gate on the LATEST parsed round (--check):

@@ -93,8 +93,9 @@ def version_checks(report: Any) -> List[str]:
     v8+ additionally the `dist_resilience` section, v9+ additionally
     the `external` section, v10+ additionally the `supervision`
     section, v11+ additionally the `dynamic` section, v12+ additionally
-    the `tracing` section, v13+ additionally the `ledger` section;
-    older reports remain valid without them during the transition."""
+    the `tracing` section, v13+ additionally the `ledger` section,
+    v14+ additionally the `integrity` section; older reports remain
+    valid without them during the transition."""
     errors: List[str] = []
     if not isinstance(report, dict):
         return errors
@@ -114,6 +115,7 @@ def version_checks(report: Any) -> List[str]:
         (11, ("dynamic",)),
         (12, ("tracing",)),
         (13, ("ledger",)),
+        (14, ("integrity",)),
     ]
     for min_version, keys in required_by_version:
         if version < min_version:
@@ -249,6 +251,15 @@ def _minimal_v12_report() -> dict:
     r = _minimal_v11_report()
     r["schema_version"] = 12
     r["tracing"] = {"enabled": False, "traces": []}
+    return r
+
+
+def _minimal_v13_report() -> dict:
+    """A minimal schema_version-13 report (ledger present, no
+    integrity section) — the thirteenth transition fixture."""
+    r = _minimal_v12_report()
+    r["schema_version"] = 13
+    r["ledger"] = {"enabled": False}
     return r
 
 
@@ -399,7 +410,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--selftest", action="store_true",
         help="generate a minimal report from the live producer (schema "
-        "v13) and validate it plus the embedded v1-v12 transition "
+        "v14) and validate it plus the embedded v1-v13 transition "
         "fixtures (no report file needed)",
     )
     args = ap.parse_args(argv)
@@ -423,22 +434,22 @@ def main(argv=None) -> int:
                 report = json.load(f)
         finally:
             os.unlink(args.report)
-        # live producer must emit v13 (progress/compile +
+        # live producer must emit v14 (progress/compile +
         # checkpoint/anytime + serving + perf + memory_budget +
         # quality + dist_resilience + external + supervision +
-        # dynamic + tracing + ledger)
-        if report.get("schema_version") != 13:
+        # dynamic + tracing + ledger + integrity)
+        if report.get("schema_version") != 14:
             print(
                 f"SCHEMA VIOLATION $: selftest producer emitted "
                 f"schema_version {report.get('schema_version')!r}, "
-                f"expected 13",
+                f"expected 14",
                 file=sys.stderr,
             )
             return 1
         for key in ("checkpoint", "anytime", "serving", "perf",
                     "memory_budget", "quality", "dist_resilience",
                     "external", "supervision", "dynamic", "tracing",
-                    "ledger"):
+                    "ledger", "integrity"):
             if key not in report:
                 print(
                     f"SCHEMA VIOLATION $: selftest producer emitted no "
@@ -478,6 +489,7 @@ def main(argv=None) -> int:
             ("v7", _minimal_v7_report()), ("v8", _minimal_v8_report()),
             ("v9", _minimal_v9_report()), ("v10", _minimal_v10_report()),
             ("v11", _minimal_v11_report()), ("v12", _minimal_v12_report()),
+            ("v13", _minimal_v13_report()),
         ):
             fx_errors = (
                 validate_instance(fixture, schema) + version_checks(fixture)

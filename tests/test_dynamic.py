@@ -668,7 +668,7 @@ def test_dynamic_section_schema_valid():
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH, build_run_report
 
     report = build_run_report()
-    assert report["schema_version"] == 13
+    assert report["schema_version"] == 14
     assert report["dynamic"]["enabled"]
     schema = json.load(open(SCHEMA_PATH))
     errors = (checker.validate_instance(report["dynamic"],

@@ -378,7 +378,7 @@ def test_external_report_section_is_schema_valid(monkeypatch):
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH, build_run_report
 
     report = build_run_report()
-    assert report["schema_version"] == 13
+    assert report["schema_version"] == 14
     assert report["external"]["enabled"] is True
     spec = importlib.util.spec_from_file_location(
         "check_report_schema",

@@ -346,7 +346,7 @@ def test_comm_section_schema_valid_on_dist_smoke(tmp_path):
 def test_schema_version_pins():
     from kaminpar_tpu.telemetry.report import SCHEMA_PATH, SCHEMA_VERSION
 
-    assert SCHEMA_VERSION == 13
+    assert SCHEMA_VERSION == 14
     checker = _load_checker()
     schema = json.load(open(SCHEMA_PATH))
     # the v11 fixture (pre-tracing) still validates untouched
@@ -369,9 +369,17 @@ def test_schema_version_pins():
     v13 = dict(v13_missing, ledger={"enabled": False})
     assert checker.validate_instance(v13, schema) == []
     assert checker.version_checks(v13) == []
+    # claiming v14 without an integrity section is flagged
+    v14_missing = dict(v13, schema_version=14)
+    assert any(
+        "integrity" in e for e in checker.version_checks(v14_missing)
+    )
+    v14 = dict(v14_missing, integrity={"enabled": False})
+    assert checker.validate_instance(v14, schema) == []
+    assert checker.version_checks(v14) == []
     # an unknown future version is rejected, not silently accepted
-    v14 = dict(v13, schema_version=14)
+    v15 = dict(v14, schema_version=15)
     assert any(
         "schema_version" in e
-        for e in checker.validate_instance(v14, schema)
+        for e in checker.validate_instance(v15, schema)
     )

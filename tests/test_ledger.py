@@ -413,7 +413,7 @@ def test_report_carries_v13_ledger_section():
     assert len(part) == g.n
 
     report = build_run_report()
-    assert report["schema_version"] == 13
+    assert report["schema_version"] == 14
     led = report["ledger"]
     assert led["enabled"] is True
     assert led["totals"]["launches"] >= 1

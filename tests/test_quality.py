@@ -511,7 +511,7 @@ def test_report_quality_section_disabled_default():
 
     telemetry.enable()
     report = build_run_report()
-    assert report["schema_version"] == 13
+    assert report["schema_version"] == 14
     assert report["quality"] == {"enabled": False}
 
 

@@ -458,7 +458,7 @@ def test_supervision_disabled_default_for_single_shot_runs():
     from kaminpar_tpu.telemetry.report import build_run_report
 
     report = build_run_report()
-    assert report["schema_version"] == 13
+    assert report["schema_version"] == 14
     assert report["supervision"] == {"enabled": False}
 
 

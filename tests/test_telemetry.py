@@ -162,7 +162,7 @@ def test_run_report_roundtrip_and_schema(tmp_path):
     assert loaded == json.loads(json.dumps(report))
 
     # headline content
-    assert loaded["schema_version"] == 13
+    assert loaded["schema_version"] == 14
     assert loaded["run"]["k"] == 4
     assert loaded["run"]["graph"]["n"] == g.n
     assert loaded["result"]["cut"] >= 0
@@ -705,10 +705,17 @@ def test_schema_accepts_v1_through_v7(tmp_path):
     v13 = dict(v13_missing, ledger={"enabled": False})
     assert checker.validate_instance(v13, schema) == []
     assert checker.version_checks(v13) == []
-    # v14 is not a known version
-    v14 = dict(v1, schema_version=14)
+    # v14 additionally requires the integrity section
+    v14_missing = dict(v13, schema_version=14)
+    assert any("integrity" in e
+               for e in checker.version_checks(v14_missing))
+    v14 = dict(v14_missing, integrity={"enabled": False})
+    assert checker.validate_instance(v14, schema) == []
+    assert checker.version_checks(v14) == []
+    # v15 is not a known version
+    v15 = dict(v1, schema_version=15)
     assert any("schema_version" in e
-               for e in checker.validate_instance(v14, schema))
+               for e in checker.validate_instance(v15, schema))
     # CLI path: the v1 fixture as a file validates end to end
     p = tmp_path / "v1.json"
     p.write_text(json.dumps(v1))
